@@ -1,0 +1,16 @@
+from .config import (
+    DeepSpeedConfig,
+    DeepSpeedConfigModel,
+    DeepSpeedZeroConfig,
+    FP16Config,
+    BF16Config,
+    OptimizerConfig,
+    SchedulerConfig,
+    PipelineConfig,
+    TensorParallelConfig,
+    SequenceParallelConfig,
+    MoEConfig,
+    SparseAttentionConfig,
+    load_config,
+)
+from . import constants

@@ -1,0 +1,54 @@
+"""Inference config. Capability parity with reference deepspeed/inference/config.py
+(DeepSpeedInferenceConfig pydantic model, :124-240)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from pydantic import Field
+
+from ..config.config import DeepSpeedConfigModel
+
+
+class InferenceTPConfig(DeepSpeedConfigModel):
+    tp_size: int = 1
+    enabled: bool = True
+
+
+class QuantConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    bits: int = 8
+
+
+class MoEInferenceConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    ep_size: int = 1
+    moe_experts: list = Field(default_factory=lambda: [1])
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    dtype: str = "bfloat16"   # reference default fp16; bf16 is the TPU-native choice
+    tensor_parallel: InferenceTPConfig = Field(default_factory=InferenceTPConfig,
+                                               alias="tp")
+    moe: MoEInferenceConfig = Field(default_factory=MoEInferenceConfig)
+    quant: QuantConfig = Field(default_factory=QuantConfig)
+    replace_with_kernel_inject: bool = False
+    injection_policy: Optional[Dict[Any, Any]] = None
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    max_tokens: int = 1024
+    checkpoint: Optional[str] = None
+    enable_cuda_graph: bool = False   # accepted for parity; XLA always "graph-captures"
+    replace_method: str = "auto"
+
+
+def load_inference_config(config) -> DeepSpeedInferenceConfig:
+    if config is None:
+        return DeepSpeedInferenceConfig()
+    if isinstance(config, DeepSpeedInferenceConfig):
+        return config
+    if isinstance(config, dict):
+        return DeepSpeedInferenceConfig(**config)
+    import json
+    with open(config) as f:
+        return DeepSpeedInferenceConfig(**json.load(f))

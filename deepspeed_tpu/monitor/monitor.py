@@ -1,0 +1,106 @@
+"""Monitoring: fan out scalar events to TensorBoard / WandB / CSV.
+
+Capability parity with the reference's ``deepspeed/monitor/*`` (MonitorMaster
+dispatching to TensorboardMonitor / WandbMonitor / csvMonitor on rank 0).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+import jax
+
+from ..utils.logging import logger
+
+# event = (tag, value, step)
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = False
+
+    def write_events(self, events: List[Event]):
+        raise NotImplementedError
+
+
+class CSVMonitor(Monitor):
+    """reference: monitor/csv_monitor.py — one csv file per tag."""
+
+    def __init__(self, config):
+        self.enabled = config.enabled and jax.process_index() == 0
+        self._files = {}
+        if self.enabled:
+            self.out_dir = os.path.join(config.output_path or "csv_monitor_output",
+                                        config.job_name)
+            os.makedirs(self.out_dir, exist_ok=True)
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            fname = os.path.join(self.out_dir, tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([step, float(value)])
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        self.enabled = False
+        self.writer = None
+        if config.enabled and jax.process_index() == 0:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self.writer = SummaryWriter(
+                    log_dir=os.path.join(config.output_path or "tb_logs", config.job_name))
+                self.enabled = True
+            except Exception as e:  # tensorboard not installed
+                logger.warning(f"tensorboard unavailable, disabling: {e}")
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            self.writer.add_scalar(tag, float(value), step)
+        self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        self.enabled = False
+        if config.enabled and jax.process_index() == 0:
+            try:
+                import wandb
+                wandb.init(project=config.project, group=config.group, entity=config.team)
+                self._wandb = wandb
+                self.enabled = True
+            except Exception as e:
+                logger.warning(f"wandb unavailable, disabling: {e}")
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            self._wandb.log({tag: float(value)}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """reference: monitor/monitor.py:24 — dispatches to all enabled backends."""
+
+    def __init__(self, ds_config):
+        self.monitors: List[Monitor] = [
+            CSVMonitor(ds_config.csv_monitor),
+            TensorBoardMonitor(ds_config.tensorboard),
+            WandbMonitor(ds_config.wandb),
+        ]
+        self.enabled = any(m.enabled for m in self.monitors)
+
+    def write_events(self, events: List[Event]):
+        for m in self.monitors:
+            m.write_events(events)

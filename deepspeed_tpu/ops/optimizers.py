@@ -1,0 +1,222 @@
+"""Optimizer library — functional (init, update) pairs over parameter pytrees.
+
+Capability parity with the reference's optimizer families:
+  FusedAdam / CPUAdam     csrc/adam/* + ops/adam/*        -> `adam` / `adamw`
+  FusedLamb               csrc/lamb/*                     -> `lamb`
+  CPUAdagrad              csrc/adagrad/*                  -> `adagrad`
+  torch SGD passthrough                                   -> `sgd`
+
+On TPU "fused multi-tensor" is what XLA produces for free: a single jitted
+update over the whole pytree fuses into large elementwise kernels (the role of
+multi_tensor_apply.cuh). Master weights stay fp32 and are sharded by the ZeRO
+policy; updates run on the local shard only — exactly the reference's
+"optimizer steps on its partition" (stage_1_and_2.py:1750).
+
+All state lives in a plain dict-of-pytrees so checkpointing is dtype/shape
+introspectable (universal-checkpoint-friendly by construction).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Optimizer(NamedTuple):
+    """A functional optimizer: state = init(params); params, state = update(...)."""
+    init: Callable[[Any], Dict[str, Any]]
+    update: Callable[..., Tuple[Any, Dict[str, Any]]]
+    name: str
+
+
+def _tree_zeros_like(params, dtype=jnp.float32):
+    return jax.tree.map(lambda p: jnp.zeros(p.shape, dtype), params)
+
+
+def adam(lr: float = 1e-3,
+         betas: Tuple[float, float] = (0.9, 0.999),
+         eps: float = 1e-8,
+         weight_decay: float = 0.0,
+         bias_correction: bool = True,
+         adamw_mode: bool = False) -> Optimizer:
+    """Adam/AdamW. reference: csrc/adam/multi_tensor_adam.cu + cpu_adam.h Step_AVX;
+    adamw_mode matches the reference's decoupled weight-decay switch."""
+    b1, b2 = betas
+
+    def init(params):
+        return {"m": _tree_zeros_like(params), "v": _tree_zeros_like(params)}
+
+    def update(grads, state, params, step, lr_t=None):
+        lr_eff = lr if lr_t is None else lr_t
+        t = step.astype(jnp.float32) + 1.0
+        bc1 = 1.0 - b1 ** t if bias_correction else 1.0
+        bc2 = 1.0 - b2 ** t if bias_correction else 1.0
+
+        def leaf(g, m, v, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if weight_decay != 0.0 and not adamw_mode:
+                g = g + weight_decay * p32
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * g * g
+            upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if weight_decay != 0.0 and adamw_mode:
+                upd = upd + weight_decay * p32
+            return p32 - lr_eff * upd, m_new, v_new
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state["m"])
+        flat_v = treedef.flatten_up_to(state["v"])
+        out = [leaf(g, m, v, p) for g, m, v, p in zip(flat_g, flat_m, flat_v, flat_p)]
+        new_p = treedef.unflatten([o[0] for o in out])
+        new_m = treedef.unflatten([o[1] for o in out])
+        new_v = treedef.unflatten([o[2] for o in out])
+        return new_p, {"m": new_m, "v": new_v}
+
+    return Optimizer(init, update, "adamw" if adamw_mode else "adam")
+
+
+def adamw(lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+          weight_decay: float = 0.01, bias_correction: bool = True) -> Optimizer:
+    return adam(lr, betas, eps, weight_decay, bias_correction, adamw_mode=True)
+
+
+def lamb(lr: float = 1e-3,
+         betas: Tuple[float, float] = (0.9, 0.999),
+         eps: float = 1e-6,
+         weight_decay: float = 0.0,
+         min_coeff: float = 0.01,
+         max_coeff: float = 10.0) -> Optimizer:
+    """LAMB with per-param trust ratio. reference: csrc/lamb/fused_lamb_cuda_kernel.cu.
+
+    The per-tensor L2 norms that the CUDA kernel computes in a two-pass reduction
+    are plain jnp.norm calls here; when params are ZeRO-sharded XLA inserts the
+    cross-shard psum automatically (the reference needs explicit allreduce)."""
+    b1, b2 = betas
+
+    def init(params):
+        return {"m": _tree_zeros_like(params), "v": _tree_zeros_like(params)}
+
+    def update(grads, state, params, step, lr_t=None):
+        lr_eff = lr if lr_t is None else lr_t
+
+        def leaf(g, m, v, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * g * g
+            upd = m_new / (jnp.sqrt(v_new) + eps) + weight_decay * p32
+            w_norm = jnp.linalg.norm(p32)
+            u_norm = jnp.linalg.norm(upd)
+            trust = jnp.where((w_norm > 0) & (u_norm > 0),
+                              jnp.clip(w_norm / u_norm, min_coeff, max_coeff), 1.0)
+            return p32 - lr_eff * trust * upd, m_new, v_new
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state["m"])
+        flat_v = treedef.flatten_up_to(state["v"])
+        out = [leaf(g, m, v, p) for g, m, v, p in zip(flat_g, flat_m, flat_v, flat_p)]
+        return (treedef.unflatten([o[0] for o in out]),
+                {"m": treedef.unflatten([o[1] for o in out]),
+                 "v": treedef.unflatten([o[2] for o in out])})
+
+    return Optimizer(init, update, "lamb")
+
+
+def sgd(lr: float = 1e-3, momentum: float = 0.0, weight_decay: float = 0.0,
+        nesterov: bool = False) -> Optimizer:
+    def init(params):
+        if momentum == 0.0:
+            return {}
+        return {"momentum": _tree_zeros_like(params)}
+
+    def update(grads, state, params, step, lr_t=None):
+        lr_eff = lr if lr_t is None else lr_t
+
+        def leaf(g, p, buf):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if weight_decay != 0.0:
+                g = g + weight_decay * p32
+            if momentum != 0.0:
+                buf_new = momentum * buf + g
+                g = g + momentum * buf_new if nesterov else buf_new
+                return p32 - lr_eff * g, buf_new
+            return p32 - lr_eff * g, None
+
+        if momentum == 0.0:
+            new_p = jax.tree.map(lambda g, p: leaf(g, p, None)[0], grads, params)
+            return new_p, {}
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_b = treedef.flatten_up_to(state["momentum"])
+        out = [leaf(g, p, b) for g, p, b in zip(flat_g, flat_p, flat_b)]
+        return (treedef.unflatten([o[0] for o in out]),
+                {"momentum": treedef.unflatten([o[1] for o in out])})
+
+    return Optimizer(init, update, "sgd")
+
+
+def adagrad(lr: float = 1e-2, eps: float = 1e-10, weight_decay: float = 0.0) -> Optimizer:
+    """reference: csrc/adagrad/cpu_adagrad.cpp."""
+
+    def init(params):
+        return {"sum": _tree_zeros_like(params)}
+
+    def update(grads, state, params, step, lr_t=None):
+        lr_eff = lr if lr_t is None else lr_t
+
+        def leaf(g, s, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if weight_decay != 0.0:
+                g = g + weight_decay * p32
+            s_new = s + g * g
+            return p32 - lr_eff * g / (jnp.sqrt(s_new) + eps), s_new
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_s = treedef.flatten_up_to(state["sum"])
+        out = [leaf(g, s, p) for g, s, p in zip(flat_g, flat_s, flat_p)]
+        return (treedef.unflatten([o[0] for o in out]),
+                {"sum": treedef.unflatten([o[1] for o in out])})
+
+    return Optimizer(init, update, "adagrad")
+
+
+# Registry keyed by the optimizer `type` names the reference engine accepts
+# (engine.py:1042-1054 / _configure_basic_optimizer engine.py:1315).
+_REGISTRY: Dict[str, Callable[..., Optimizer]] = {
+    "adam": adam,
+    "adamw": adamw,
+    "fusedadam": adam,
+    "lamb": lamb,
+    "fusedlamb": lamb,
+    "sgd": sgd,
+    "adagrad": adagrad,
+    # 1-bit variants fall back to their dense parents until the compressed
+    # collective path (ops/onebit.py) is wired into the engine step.
+    "onebitadam": adam,
+    "zerooneadam": adam,
+    "onebitlamb": lamb,
+}
+
+
+def build_optimizer(opt_type: str, params: Optional[dict] = None) -> Optimizer:
+    key = opt_type.lower().replace("_", "")
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown optimizer type '{opt_type}'. Known: {sorted(_REGISTRY)}")
+    kwargs = dict(params or {})
+    # the reference accepts torch-style names; normalize
+    if "betas" in kwargs:
+        kwargs["betas"] = tuple(kwargs["betas"])
+    kwargs.pop("torch_adam", None)
+    kwargs.pop("adam_w_mode", None)
+    if key in ("onebitadam", "zerooneadam", "onebitlamb"):
+        for k in ("freeze_step", "cuda_aware", "comm_backend_name"):
+            kwargs.pop(k, None)
+    return _REGISTRY[key](**kwargs)

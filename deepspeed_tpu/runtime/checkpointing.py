@@ -1,0 +1,182 @@
+"""Checkpoint save/load for TrainState pytrees.
+
+Capability parity with the reference's checkpoint layer (engine.py:2712-3489 +
+runtime/checkpoint_engine/): tagged checkpoint dirs, a ``latest`` tag file,
+model/optimizer state separation, client (lr-scheduler etc.) state, and
+consolidation of sharded weights to a single fp32/16-bit state dict
+(zero_to_fp32 / save_16bit_model equivalents).
+
+Format: one ``.npz`` per state group + a JSON manifest of paths/dtypes/shapes.
+Parameters are stored under their /-joined pytree paths — names, not partition
+indices — so a checkpoint written under one mesh/ZeRO topology loads under any
+other ("universal checkpoint by construction"; the reference needs the whole
+``deepspeed/checkpoint/`` reshape machinery for this).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..utils.logging import logger
+from ..utils.partitioning import path_str
+
+LATEST_FILE = "latest"
+
+
+def _gather_leaf(leaf) -> np.ndarray:
+    """Host copy of a (possibly multi-host-sharded) array."""
+    if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(jax.device_get(leaf))
+
+
+def _tree_to_flat_dict(tree) -> Dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = _gather_leaf(leaf)
+        # npz has no bfloat16 (ml_dtypes) support — store as f32 (lossless up-cast);
+        # load_tree casts back to the model's dtype.
+        if arr.dtype not in (np.float32, np.float64, np.float16, np.int32, np.int64,
+                             np.int8, np.uint8, np.bool_):
+            arr = arr.astype(np.float32)
+        flat[path_str(path)] = arr
+    return flat
+
+
+def _flat_dict_to_tree(flat: Dict[str, np.ndarray], like):
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+    new_leaves = []
+    for path, leaf in leaves_with_paths:
+        key = path_str(path)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing parameter '{key}'")
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(f"shape mismatch for '{key}': ckpt {arr.shape} vs "
+                             f"model {np.shape(leaf)}")
+        new_leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def save_tree(tree, path: str) -> None:
+    np.savez(path, **_tree_to_flat_dict(tree))
+
+
+def load_tree(path: str, like, shardings=None):
+    import jax.numpy as jnp
+    with np.load(path) as data:
+        flat = {k: data[k] for k in data.files}
+    tree = _flat_dict_to_tree(flat, like)
+
+    def restore(arr, ref, sh=None):
+        dtype = ref.dtype if hasattr(ref, "dtype") else arr.dtype
+        out = jnp.asarray(arr, dtype=dtype)
+        return jax.device_put(out, sh) if sh is not None else out
+
+    if shardings is not None:
+        return jax.tree.map(lambda arr, sh, ref: restore(arr, ref, sh),
+                            tree, shardings, like)
+    return jax.tree.map(lambda arr, ref: restore(arr, ref), tree, like)
+
+
+def save_checkpoint(save_dir: str,
+                    tag: str,
+                    state,
+                    client_state: Optional[Dict[str, Any]] = None,
+                    master_aliases_params: bool = False) -> str:
+    """Write {save_dir}/{tag}/ with model+optim npz and metadata; update `latest`.
+
+    ``master_aliases_params``: fp32 training stores params once (the master copy
+    IS the param tree); the alias is re-established at load."""
+    ckpt_dir = os.path.join(save_dir, tag)
+    if jax.process_index() == 0:
+        os.makedirs(ckpt_dir, exist_ok=True)
+        save_tree(state.params, os.path.join(ckpt_dir, "model_states.npz"))
+        optim_group = {"opt_state": state.opt_state}
+        if not master_aliases_params:
+            optim_group["master"] = state.master
+        save_tree(optim_group, os.path.join(ckpt_dir, "optim_states.npz"))
+        meta = {
+            "master_aliases_params": master_aliases_params,
+            "step": int(jax.device_get(state.step)),
+            "skipped_steps": int(jax.device_get(state.skipped_steps)),
+            "loss_scale": float(jax.device_get(state.scale.scale)),
+            "scale_good_steps": int(jax.device_get(state.scale.good_steps)),
+            "scale_hysteresis": int(jax.device_get(state.scale.hysteresis)),
+            "client_state": client_state or {},
+        }
+        with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+            f.write(tag)
+        logger.info(f"saved checkpoint {ckpt_dir}")
+    return ckpt_dir
+
+
+def get_latest_tag(load_dir: str) -> Optional[str]:
+    latest = os.path.join(load_dir, LATEST_FILE)
+    if not os.path.exists(latest):
+        return None
+    with open(latest) as f:
+        return f.read().strip()
+
+
+def load_checkpoint(load_dir: str,
+                    tag: Optional[str],
+                    state,
+                    param_shardings=None,
+                    master_shardings=None,
+                    opt_shardings=None) -> Tuple[Any, Dict[str, Any]]:
+    """Load into the structure of ``state`` (shardings reapplied). Returns
+    (new_state, client_state)."""
+    import jax.numpy as jnp
+    if tag is None:
+        tag = get_latest_tag(load_dir)
+        if tag is None:
+            raise FileNotFoundError(f"no 'latest' tag file in {load_dir}")
+    ckpt_dir = os.path.join(load_dir, tag)
+    with open(os.path.join(ckpt_dir, "meta.json")) as f:
+        meta = json.load(f)
+    params = load_tree(os.path.join(ckpt_dir, "model_states.npz"), state.params,
+                       param_shardings)
+    if meta.get("master_aliases_params"):
+        optim = {"master": params,
+                 "opt_state": load_tree(os.path.join(ckpt_dir, "optim_states.npz"),
+                                        {"opt_state": state.opt_state},
+                                        {"opt_state": opt_shardings}
+                                        if opt_shardings is not None else None)["opt_state"]}
+    else:
+        optim = load_tree(os.path.join(ckpt_dir, "optim_states.npz"),
+                          {"master": state.master, "opt_state": state.opt_state},
+                          {"master": master_shardings, "opt_state": opt_shardings}
+                          if master_shardings is not None else None)
+    from .loss_scaler import LossScaleState
+    new_state = state.replace(
+        step=jnp.asarray(meta["step"], jnp.int32),
+        skipped_steps=jnp.asarray(meta["skipped_steps"], jnp.int32),
+        params=params,
+        master=optim["master"],
+        opt_state=optim["opt_state"],
+        scale=LossScaleState(
+            scale=jnp.asarray(meta["loss_scale"], jnp.float32),
+            good_steps=jnp.asarray(meta["scale_good_steps"], jnp.int32),
+            hysteresis=jnp.asarray(meta["scale_hysteresis"], jnp.int32)))
+    logger.info(f"loaded checkpoint {ckpt_dir} at step {meta['step']}")
+    return new_state, meta.get("client_state", {})
+
+
+def consolidated_fp32_state_dict(state) -> Dict[str, np.ndarray]:
+    """Gather master weights to one host fp32 dict (zero_to_fp32 equivalent,
+    reference utils/zero_to_fp32.py + _zero3_consolidated_16bit_state_dict)."""
+    return _tree_to_flat_dict(state.master)
+
+
+def save_16bit_model(state, path: str) -> None:
+    """reference: engine.save_16bit_model (engine.py:3479)."""
+    save_tree(state.params, path)

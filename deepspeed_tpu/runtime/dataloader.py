@@ -1,0 +1,86 @@
+"""Data loading: deterministic distributed batching over indexable datasets.
+
+Capability parity with the reference's ``runtime/dataloader.py``
+(DeepSpeedDataLoader wiring DistributedSampler, RepeatingLoader). On TPU with a
+single-controller jit step, every process loads the *global* batch layout and
+the engine shards it over the mesh — so the "sampler" is a deterministic
+permutation shared by seed, not a per-rank torch sampler.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """reference: runtime/dataloader.py:16 — wraps an iterator to restart on StopIteration."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+def _default_collate(samples):
+    """Stack a list of samples (tuples/dicts/arrays) into batch arrays."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(np.stack([np.asarray(s[i]) for s in samples])
+                           for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+    """Batched, shuffled, epoch-aware loader. reference: runtime/dataloader.py:39."""
+
+    def __init__(self,
+                 dataset,
+                 batch_size: int,
+                 shuffle: bool = True,
+                 seed: int = 42,
+                 drop_last: bool = True,
+                 collate_fn: Optional[Callable] = None,
+                 data_sampler=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or _default_collate
+        self.data_sampler = data_sampler
+        self.epoch = 0
+        self.len = len(dataset) // batch_size if drop_last else \
+            (len(dataset) + batch_size - 1) // batch_size
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self) -> Iterator[Any]:
+        n = len(self.dataset)
+        if self.data_sampler is not None:
+            order = np.asarray(list(iter(self.data_sampler)))
+        elif self.shuffle:
+            order = np.random.RandomState(self.seed + self.epoch).permutation(n)
+        else:
+            order = np.arange(n)
+        limit = self.len * self.batch_size if self.drop_last else n
+        for start in range(0, limit, self.batch_size):
+            idx = order[start:start + self.batch_size]
+            yield self.collate_fn([self.dataset[int(i)] for i in idx])
+        self.epoch += 1

@@ -1,0 +1,554 @@
+"""DeepSpeedEngine — config-driven training engine, TPU-native.
+
+Capability parity with the reference's ``deepspeed/runtime/engine.py``
+(DeepSpeedEngine: forward/backward/step, train_batch, checkpoint save/load,
+monitor/timer integration, ZeRO dispatch) — rebuilt around one jitted,
+donated, sharded train step instead of module hooks + streams + buckets:
+
+  reference mechanism                          TPU-native replacement
+  -------------------------------------------  --------------------------------
+  per-param grad hooks + bucketed allreduce    grads are scan-carried; a sharding
+    (stage_1_and_2.py:836,942)                 constraint makes XLA emit fused
+                                               reduce-scatter/all-reduce, overlapped
+                                               by the latency-hiding scheduler
+  ZeRO-3 submodule hooks + prefetch trace      params sharded by NamedSharding;
+    (parameter_offload.py, coordinator)        XLA all-gathers per layer and
+                                               prefetches automatically
+  fp16 flat master buffers (fused_optimizer)   fp32 master pytree, ZeRO-sharded
+  DynamicLossScaler python branch              lax.cond inside the compiled step
+  CPU optimizer offload (CPUAdam + pinned)     host-memory donation (future: C++
+                                               AVX path in ops/cpu)
+
+The public surface keeps the reference's names: ``forward``/``backward``/
+``step`` (micro-batch API), ``train_batch``/``eval_batch`` (fused API),
+``save_checkpoint``/``load_checkpoint``, ``save_16bit_model``, plus the config
+accessor properties user code relies on (engine.py:498-879).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config import DeepSpeedConfig, load_config
+from ..monitor.monitor import MonitorMaster
+from ..ops.optimizers import Optimizer, build_optimizer
+from ..parallel.mesh import MeshManager, build_mesh_from_config
+from ..utils.logging import log_dist, logger
+from ..utils.partitioning import build_tp_specs
+from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from . import checkpointing as ckpt_lib
+from .loss_scaler import LossScaler
+from .lr_schedules import LRScheduler, build_schedule
+from .state import TrainState
+from .zero.stages import ZeroShardingPolicy
+
+PyTree = Any
+
+
+def _default_loss_fn(outputs, batch):
+    """By default the model is assumed to return the scalar loss (the usual
+    DeepSpeed contract: loss = engine(batch))."""
+    return outputs
+
+
+class DeepSpeedEngine:
+    def __init__(self,
+                 model,
+                 config: Optional[DeepSpeedConfig | dict | str] = None,
+                 model_parameters: Optional[PyTree] = None,
+                 loss_fn: Optional[Callable] = None,
+                 apply_fn: Optional[Callable] = None,
+                 example_batch: Optional[PyTree] = None,
+                 rng: Optional[jax.Array] = None,
+                 sharding_rules: Optional[Dict[str, P]] = None,
+                 mesh_manager: Optional[MeshManager] = None,
+                 optimizer: Optional[Optimizer] = None,
+                 lr_scheduler=None,
+                 mpu=None):
+        self.module = model
+        self.config = load_config(config)
+        self.mesh_mgr = mesh_manager or build_mesh_from_config(self.config)
+        self.mesh = self.mesh_mgr.mesh
+        # ranks that receive distinct batch slices (the reference's DP world size)
+        dp = self.mesh_mgr.shape["data"] * self.mesh_mgr.shape["expert"]
+        self.config.resolve_batch_sizes(dp_world_size=dp)
+        self.dp_world_size = dp
+
+        # precision ----------------------------------------------------------
+        self.compute_dtype = {"float16": jnp.float16, "bfloat16": jnp.bfloat16,
+                              "float32": jnp.float32}[self.config.precision_dtype]
+        self.keep_master = self.compute_dtype != jnp.float32
+        fp16 = self.config.fp16
+        self.loss_scaler = LossScaler(
+            static_scale=fp16.loss_scale,
+            initial_scale_power=fp16.initial_scale_power,
+            scale_window=fp16.loss_scale_window,
+            min_scale=fp16.min_loss_scale,
+            hysteresis=fp16.hysteresis,
+            enabled=fp16.enabled)
+
+        # model fns ----------------------------------------------------------
+        self.loss_fn = loss_fn or _default_loss_fn
+        self._rng = rng if rng is not None else jax.random.PRNGKey(self.config.seed)
+        self.apply_fn = apply_fn or self._build_apply_fn(model)
+
+        # params -------------------------------------------------------------
+        if model_parameters is None:
+            if example_batch is None:
+                raise ValueError("need model_parameters or example_batch to initialize")
+            model_parameters = self._init_params(example_batch)
+        params_f32 = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), model_parameters)
+
+        # sharding policy ----------------------------------------------------
+        stage = self.config.zero_optimization.stage
+        self.zero_policy = ZeroShardingPolicy(stage, self.mesh_mgr)
+        self.tp_specs = build_tp_specs(params_f32, sharding_rules)
+        self.param_shardings = self.zero_policy.param_shardings(params_f32, self.tp_specs)
+        self.master_shardings = self.zero_policy.master_shardings(params_f32, self.tp_specs)
+        self.grad_shardings = self.zero_policy.grad_shardings(params_f32, self.tp_specs)
+        self.batch_sharding = self.mesh_mgr.batch_sharding()
+
+        # optimizer ----------------------------------------------------------
+        # client-passed functional optimizer wins over the config section
+        # (reference: deepspeed.initialize honors the client optimizer object)
+        opt_cfg = self.config.optimizer
+        if optimizer is not None:
+            if not isinstance(optimizer, Optimizer):
+                raise TypeError(
+                    "optimizer must be a deepspeed_tpu.ops.optimizers.Optimizer "
+                    "(build one with e.g. ops.optimizers.adamw(lr=...)); torch "
+                    f"optimizers are not usable on TPU. Got {type(optimizer)}")
+            self.optimizer: Optional[Optimizer] = optimizer
+            self.base_lr = float(opt_cfg.params.get("lr", 1e-3)) if opt_cfg else 1e-3
+        elif opt_cfg is not None:
+            self.optimizer = build_optimizer(opt_cfg.type, opt_cfg.params)
+            self.base_lr = float(opt_cfg.params.get("lr", 1e-3))
+        else:
+            self.optimizer = None
+            self.base_lr = 0.0
+
+        # lr schedule --------------------------------------------------------
+        # lr_fn (step->lr, evaluated in-jit) when we own the schedule; an
+        # external scheduler object instead feeds its lr into the step as an arg.
+        self.lr_fn = None
+        if lr_scheduler is not None:
+            self.lr_scheduler = lr_scheduler
+            if isinstance(lr_scheduler, LRScheduler):
+                self.lr_fn = lr_scheduler.fn
+        elif self.config.scheduler is not None and self.config.scheduler.type:
+            self.lr_fn = build_schedule(self.config.scheduler.type,
+                                        self.config.scheduler.params)
+            self.lr_scheduler = LRScheduler(self.lr_fn)
+        else:
+            self.lr_scheduler = None
+
+        # device placement of state -----------------------------------------
+        # fp32 training: params ARE the master copy — TrainState.master is kept
+        # empty so the same buffers aren't donated twice through the pytree.
+        if self.keep_master:
+            master = jax.device_put(params_f32, self.master_shardings)
+            params = jax.jit(
+                lambda m: jax.tree.map(lambda x: x.astype(self.compute_dtype), m),
+                out_shardings=self.param_shardings)(master)
+        else:
+            params = jax.device_put(params_f32, self.param_shardings)
+            master = ()
+        opt_state = {}
+        self.opt_shardings = self._opt_state_shardings(params_f32)
+        if self.optimizer is not None:
+            opt_state = jax.jit(self.optimizer.init,
+                                out_shardings=self.opt_shardings)(
+                                    master if self.keep_master else params)
+        self.state = TrainState(
+            step=jnp.asarray(0, jnp.int32),
+            params=params,
+            master=master,
+            opt_state=opt_state,
+            scale=self.loss_scaler.init(),
+            skipped_steps=jnp.asarray(0, jnp.int32))
+
+        # compiled fns -------------------------------------------------------
+        self._train_step = self._make_train_step()
+        self._micro_grad = self._make_micro_grad()
+        self._apply_update = self._make_apply_update()
+        self._eval_step = self._make_eval_step()
+
+        # fwd/bwd/step emulation buffers -------------------------------------
+        self._accum_grads = None
+        self._accum_losses = []
+        self._micro_count = 0
+        self._last_metrics: Dict[str, Any] = {}
+
+        # observability ------------------------------------------------------
+        self.monitor = MonitorMaster(self.config)
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(batch_size=self.config.train_batch_size)
+        self.global_steps = 0
+        self.skipped_steps = 0
+        self.micro_steps = 0
+
+        log_dist(f"DeepSpeedEngine initialized: ZeRO stage {stage}, "
+                 f"dtype {self.config.precision_dtype}, mesh {self.mesh_mgr.describe()}, "
+                 f"batch {self.config.train_batch_size} "
+                 f"(micro {self.config.train_micro_batch_size_per_gpu} x gas "
+                 f"{self.config.gradient_accumulation_steps} x dp {dp})", ranks=[0])
+
+    # ------------------------------------------------------------------ setup
+
+    def _build_apply_fn(self, model) -> Callable:
+        """Adapt a flax module (or raw callable) to (params, batch, rng, train)."""
+        if model is None:
+            raise ValueError("model must be a flax module or apply_fn given")
+        if not hasattr(model, "apply"):
+            # raw callable(params, batch) -> outputs
+            return lambda params, batch, rng, train: model(params, batch)
+        sig = None
+        try:
+            sig = inspect.signature(model.__call__)
+        except (TypeError, ValueError):
+            pass
+        takes_train = sig is not None and "train" in sig.parameters
+
+        def apply_fn(params, batch, rng, train):
+            kwargs = {"train": train} if takes_train else {}
+            rngs = {"dropout": rng} if train else None
+            try:
+                return model.apply({"params": params}, batch, rngs=rngs, **kwargs)
+            except TypeError:
+                return model.apply({"params": params}, batch, **kwargs)
+
+        return apply_fn
+
+    def _init_params(self, example_batch) -> PyTree:
+        init_rng, self._rng = jax.random.split(self._rng)
+        sig = None
+        try:
+            sig = inspect.signature(self.module.__call__)
+        except (TypeError, ValueError):
+            pass
+        kwargs = {"train": False} if sig is not None and "train" in sig.parameters else {}
+        variables = self.module.init(init_rng, example_batch, **kwargs)
+        return variables["params"]
+
+    def _opt_state_shardings(self, params_f32):
+        if self.optimizer is None:
+            return {}
+        shape_state = jax.eval_shape(self.optimizer.init, params_f32)
+        return jax.tree.map(
+            lambda leaf_shape: NamedSharding(
+                self.mesh, self.zero_policy.master_spec(leaf_shape.shape, None)),
+            shape_state)
+
+    # ----------------------------------------------------------- compiled fns
+
+    def _grads_of_micro(self, params, scale_state, micro, rng):
+        """Scaled-loss grads for one microbatch; returns (grads, unscaled loss)."""
+
+        def scaled_loss(p):
+            out = self.apply_fn(p, micro, rng, True)
+            loss = self.loss_fn(out, micro)
+            return (loss * scale_state.scale).astype(jnp.float32), loss
+
+        grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
+        grads = jax.tree.map(lambda g, s: lax.with_sharding_constraint(
+            g.astype(jnp.float32), s), grads, self.grad_shardings)
+        return grads, loss
+
+    def _finalize_step(self, state: TrainState, grads_sum, n_micro, lr_arg):
+        """Shared tail: unscale, clip, optimize, loss-scale bookkeeping.
+
+        ``lr_arg``: host-computed lr (external scheduler objects); ignored when
+        the schedule is an in-jit lr_fn."""
+        master = state.master if self.keep_master else state.params
+        denom = n_micro * state.scale.scale
+        grads = jax.tree.map(lambda g: g / denom, grads_sum)
+        overflow = LossScaler.has_overflow(grads)
+
+        # global grad norm: at jit level grads are logically global, so this IS
+        # the global norm; XLA inserts cross-shard reductions (reference:
+        # get_global_norm + clip_grad_norm_ w/ allreduce, runtime/utils.py)
+        sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
+        global_norm = jnp.sqrt(sq)
+        clip = self.config.gradient_clipping
+        if clip > 0:
+            coef = jnp.minimum(clip / (global_norm + 1e-6), 1.0)
+            grads = jax.tree.map(lambda g: g * coef, grads)
+
+        lr = self.lr_fn(state.step) if self.lr_fn is not None else lr_arg
+
+        new_master, new_opt = self.optimizer.update(
+            grads, state.opt_state, master, state.step, lr_t=lr)
+        master_sh = self.master_shardings if self.keep_master else self.param_shardings
+        new_master = jax.tree.map(lambda x, s: lax.with_sharding_constraint(x, s),
+                                  new_master, master_sh)
+
+        # overflow → keep old state, count a skipped step (reference: engine.step
+        # overflow path engine.py:2105-2112)
+        keep = lambda old, new: jax.tree.map(
+            lambda a, b: jnp.where(overflow, a, b), old, new)
+        new_master = keep(master, new_master)
+        new_opt = keep(state.opt_state, new_opt)
+
+        if self.keep_master:
+            new_params = jax.tree.map(
+                lambda m, s: lax.with_sharding_constraint(
+                    m.astype(self.compute_dtype), s),
+                new_master, self.param_shardings)
+        else:
+            new_params = new_master
+
+        # overflow does not advance the optimizer step (Adam bias correction /
+        # in-jit lr schedules stay put), matching the reference's skip path
+        new_state = TrainState(
+            step=state.step + 1 - overflow.astype(jnp.int32),
+            params=new_params,
+            master=new_master if self.keep_master else (),
+            opt_state=new_opt,
+            scale=self.loss_scaler.update(state.scale, overflow),
+            skipped_steps=state.skipped_steps + overflow.astype(jnp.int32))
+        metrics = {"grad_norm": global_norm, "lr": lr, "overflow": overflow,
+                   "loss_scale": state.scale.scale}
+        return new_state, metrics
+
+    def _make_train_step(self):
+        gas = self.config.gradient_accumulation_steps
+
+        def train_step(state: TrainState, micros, rng, lr_arg):
+            # micros: [gas, global_micro, ...], dim 1 sharded over the DP axes
+            rngs = jax.random.split(rng, gas)
+            zero_grads = jax.tree.map(
+                lambda p, s: lax.with_sharding_constraint(
+                    jnp.zeros(p.shape, jnp.float32), s),
+                state.params, self.grad_shardings)
+
+            def micro_step(acc, xs):
+                micro, r = xs
+                grads, loss = self._grads_of_micro(state.params, state.scale, micro, r)
+                acc = jax.tree.map(lambda a, g, s: lax.with_sharding_constraint(a + g, s),
+                                   acc, grads, self.grad_shardings)
+                return acc, loss
+
+            grads_sum, losses = lax.scan(micro_step, zero_grads, (micros, rngs))
+            new_state, metrics = self._finalize_step(state, grads_sum, float(gas), lr_arg)
+            metrics["loss"] = jnp.mean(losses)
+            return new_state, metrics
+
+        return jax.jit(train_step, donate_argnums=(0,))
+
+    def _make_micro_grad(self):
+        def micro_grad(params, scale_state, batch, rng):
+            grads, loss = self._grads_of_micro(params, scale_state, batch, rng)
+            return grads, loss
+
+        return jax.jit(micro_grad)
+
+    def _make_apply_update(self):
+        def apply_update(state, grads_sum, n_micro, lr_arg):
+            return self._finalize_step(state, grads_sum, n_micro, lr_arg)
+
+        return jax.jit(apply_update, donate_argnums=(0,))
+
+    def _make_eval_step(self):
+        def eval_step(params, batch, rng):
+            out = self.apply_fn(params, batch, rng, False)
+            return out
+
+        return jax.jit(eval_step)
+
+    # -------------------------------------------------------------- public API
+
+    def _current_lr(self):
+        """Host-side lr for the next step (used when no in-jit lr_fn owns it)."""
+        if self.lr_fn is None and self.lr_scheduler is not None and \
+                hasattr(self.lr_scheduler, "get_lr"):
+            return jnp.asarray(float(self.lr_scheduler.get_lr()[0]), jnp.float32)
+        return jnp.asarray(self.base_lr, jnp.float32)
+
+    def shard_batch(self, batch):
+        """Place a host batch onto the mesh, split over the DP axes."""
+        return jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self.batch_sharding), batch)
+
+    def next_rng(self):
+        self._rng, out = jax.random.split(self._rng)
+        return out
+
+    def train_batch(self, batch) -> Dict[str, Any]:
+        """Run one full global batch (all gas microbatches) in one compiled step.
+
+        The fused fast path — equivalent to gas x (forward+backward) + step of
+        the reference, with comm/compute overlap handled by XLA. The batch's
+        leading dim is the global batch size; it is split [gas, micro] on the
+        host so each microbatch stays contiguous per DP shard."""
+        if self.optimizer is None:
+            raise RuntimeError(
+                "engine has no optimizer: add an 'optimizer' section to the "
+                "config or pass optimizer= to initialize()")
+        from ..parallel.mesh import BATCH_AXES
+        gas = self.config.gradient_accumulation_steps
+        micro_sharding = NamedSharding(self.mesh, P(None, BATCH_AXES))
+        micros = jax.tree.map(
+            lambda x: jax.device_put(
+                jnp.asarray(x).reshape((gas, x.shape[0] // gas) + x.shape[1:]),
+                micro_sharding),
+            batch)
+        self.tput_timer.start()
+        self.state, metrics = self._train_step(self.state, micros, self.next_rng(),
+                                               self._current_lr())
+        self.tput_timer.stop(sync=metrics["loss"])
+        self._after_step(metrics)
+        return metrics
+
+    def eval_batch(self, batch):
+        batch = self.shard_batch(batch)
+        return self._eval_step(self.state.params, batch, self.next_rng())
+
+    # --- micro-batch API (reference forward/backward/step contract) ----------
+
+    def forward(self, batch):
+        """Compute loss for one microbatch; grads are cached for backward()."""
+        batch = self.shard_batch(batch)
+        grads, loss = self._micro_grad(self.state.params, self.state.scale, batch,
+                                       self.next_rng())
+        self._pending = (grads, loss)
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss=None):
+        """Accumulate the cached grads (reference: engine.backward scales by
+        1/gas and fires reduction hooks; here accumulation is explicit)."""
+        if not hasattr(self, "_pending") or self._pending is None:
+            raise RuntimeError("backward() called before forward()")
+        grads, loss_val = self._pending
+        self._pending = None
+        if self._accum_grads is None:
+            self._accum_grads = grads
+        else:
+            self._accum_grads = jax.tree.map(jnp.add, self._accum_grads, grads)
+        self._accum_losses.append(loss_val)
+        self._micro_count += 1
+        self.micro_steps += 1
+        return loss_val
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return self._micro_count >= self.config.gradient_accumulation_steps
+
+    def step(self):
+        """Apply the optimizer at the gas boundary; no-op otherwise."""
+        if not self.is_gradient_accumulation_boundary():
+            return
+        n = jnp.asarray(float(self._micro_count), jnp.float32)
+        self.state, metrics = self._apply_update(self.state, self._accum_grads, n,
+                                                 self._current_lr())
+        metrics["loss"] = jnp.mean(jnp.stack(self._accum_losses))
+        self._accum_grads = None
+        self._accum_losses = []
+        self._micro_count = 0
+        self._after_step(metrics)
+        return metrics
+
+    def _after_step(self, metrics):
+        self.global_steps += 1
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self._last_metrics = metrics
+        if self.monitor.enabled and self.global_steps % self.config.steps_per_print == 0:
+            events = [("Train/Samples/train_loss", float(metrics["loss"]),
+                       self.global_steps),
+                      ("Train/Samples/lr", float(metrics["lr"]), self.global_steps)]
+            if self.loss_scaler.enabled:
+                events.append(("Train/Samples/loss_scale",
+                               float(metrics["loss_scale"]), self.global_steps))
+            self.monitor.write_events(events)
+        if self.global_steps % self.config.steps_per_print == 0:
+            log_dist(f"step={self.global_steps} loss={float(metrics['loss']):.4f} "
+                     f"lr={float(metrics['lr']):.3e} "
+                     f"grad_norm={float(metrics['grad_norm']):.3f}", ranks=[0])
+
+    # ------------------------------------------------------------- accessors
+
+    def get_lr(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler.get_lr()
+        return [self.base_lr]
+
+    def get_global_grad_norm(self) -> float:
+        m = self._last_metrics.get("grad_norm")
+        return float(m) if m is not None else 0.0
+
+    def get_loss_scale(self) -> float:
+        return float(jax.device_get(self.state.scale.scale))
+
+    @property
+    def train_batch_size(self):
+        return self.config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self.config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self.config.gradient_accumulation_steps
+
+    def zero_optimization_stage(self):
+        return self.config.zero_optimization.stage
+
+    def set_train_batch_size(self, train_batch_size: int):
+        """reference: engine.set_train_batch_size (engine.py:440) — adjusts gas."""
+        if train_batch_size % (self.config.train_micro_batch_size_per_gpu *
+                               self.dp_world_size) != 0:
+            raise ValueError(f"train_batch_size {train_batch_size} incompatible")
+        self.config.gradient_accumulation_steps = train_batch_size // (
+            self.config.train_micro_batch_size_per_gpu * self.dp_world_size)
+        self.config.train_batch_size = train_batch_size
+        self._train_step = self._make_train_step()
+
+    def module_state_dict(self) -> Dict[str, np.ndarray]:
+        return ckpt_lib._tree_to_flat_dict(self.state.params)
+
+    # ----------------------------------------------------------- checkpointing
+
+    def _ckpt_view(self):
+        """State as checkpointed: fp32 mode aliases params into the master slot."""
+        return self.state if self.keep_master else self.state.replace(
+            master=self.state.params)
+
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[dict] = None):
+        tag = tag or f"global_step{self.global_steps}"
+        client_state = dict(client_state or {})
+        client_state["global_steps"] = self.global_steps
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "state_dict"):
+            client_state["lr_scheduler"] = self.lr_scheduler.state_dict()
+        return ckpt_lib.save_checkpoint(save_dir, tag, self._ckpt_view(), client_state,
+                                        master_aliases_params=not self.keep_master)
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        load_module_only: bool = False):
+        loaded, client_state = ckpt_lib.load_checkpoint(
+            load_dir, tag, self._ckpt_view(),
+            param_shardings=self.param_shardings,
+            master_shardings=(self.master_shardings if self.keep_master
+                              else self.param_shardings),
+            opt_shardings=self.opt_shardings)
+        if self.keep_master:
+            self.state = loaded
+        else:
+            self.state = loaded.replace(params=loaded.master, master=())
+        if not load_module_only:
+            self.global_steps = client_state.get("global_steps", 0)
+            if self.lr_scheduler is not None and "lr_scheduler" in client_state:
+                self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+        return load_dir, client_state
+
+    def save_16bit_model(self, save_dir: str, save_filename: str = "pytorch_model.npz"):
+        import os
+        os.makedirs(save_dir, exist_ok=True)
+        ckpt_lib.save_16bit_model(self.state, os.path.join(save_dir, save_filename))

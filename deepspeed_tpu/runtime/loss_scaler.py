@@ -1,0 +1,89 @@
+"""Static + dynamic fp16 loss scaling, functional-style.
+
+Capability parity with the reference's ``runtime/fp16/loss_scaler.py``
+(LossScaler / DynamicLossScaler): scale the loss before backward, detect
+inf/nan in grads, skip the step and halve the scale on overflow, double after
+``scale_window`` clean steps. State is a small pytree carried through the
+jitted train step (no Python-side branching — overflow handling is lax.cond
+inside the compiled program, so the TPU never syncs to host mid-step).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray          # f32 scalar
+    good_steps: jnp.ndarray     # i32 scalar — consecutive overflow-free steps
+    hysteresis: jnp.ndarray     # i32 scalar — remaining tolerated overflows
+
+
+class LossScaler:
+    """Unified static/dynamic scaler. static = dynamic with growth disabled."""
+
+    def __init__(self,
+                 static_scale: float = 0.0,
+                 initial_scale_power: int = 16,
+                 scale_window: int = 1000,
+                 min_scale: float = 1.0,
+                 hysteresis: int = 2,
+                 scale_factor: float = 2.0,
+                 enabled: bool = True):
+        self.enabled = enabled
+        self.dynamic = enabled and static_scale == 0.0
+        self.initial_scale = (static_scale if static_scale > 0.0 else
+                              2.0 ** initial_scale_power) if enabled else 1.0
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.init_hysteresis = hysteresis
+        self.scale_factor = scale_factor
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            scale=jnp.asarray(self.initial_scale, jnp.float32),
+            good_steps=jnp.asarray(0, jnp.int32),
+            hysteresis=jnp.asarray(self.init_hysteresis, jnp.int32))
+
+    def scale_loss(self, loss, state: LossScaleState):
+        return loss * state.scale if self.enabled else loss
+
+    def unscale(self, grads, state: LossScaleState):
+        if not self.enabled:
+            return grads
+        inv = 1.0 / state.scale
+        return jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+
+    @staticmethod
+    def has_overflow(grads) -> jnp.ndarray:
+        """Global inf/nan check over the grad pytree (reference:
+        CHECK_OVERFLOW / has_overflow_serial, stage_1_and_2.py:1710)."""
+        leaves = jax.tree.leaves(grads)
+        finite = jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves]).all()
+        return ~finite
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:
+        """Post-step scale adjustment (reference: DynamicLossScaler.update_scale)."""
+        if not self.dynamic:
+            return state
+
+        def on_overflow(s):
+            hyst = s.hysteresis - 1
+            new_scale = jnp.where(hyst <= 0,
+                                  jnp.maximum(s.scale / self.scale_factor, self.min_scale),
+                                  s.scale)
+            return LossScaleState(scale=new_scale, good_steps=jnp.asarray(0, jnp.int32),
+                                  hysteresis=jnp.maximum(hyst, 1))
+
+        def on_ok(s):
+            good = s.good_steps + 1
+            grow = good >= self.scale_window
+            return LossScaleState(
+                scale=jnp.where(grow, s.scale * self.scale_factor, s.scale),
+                good_steps=jnp.where(grow, 0, good).astype(jnp.int32),
+                hysteresis=jnp.asarray(self.init_hysteresis, jnp.int32))
+
+        return jax.lax.cond(overflow, on_overflow, on_ok, state)

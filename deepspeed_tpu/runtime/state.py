@@ -1,0 +1,26 @@
+"""TrainState — the engine's complete training state as one pytree.
+
+Replaces the mutable state scattered across the reference's engine/optimizer
+objects (fp16 flat buffers, partitioned master weights, loss-scale counters,
+global step) with a single immutable pytree that flows through the jitted
+train step and is the unit of checkpointing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.struct
+import jax.numpy as jnp
+
+from .loss_scaler import LossScaleState
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray                 # i32 global step counter
+    params: Any                       # compute-dtype params (ZeRO-3: sharded)
+    master: Any                       # fp32 master params (ZeRO>=1: sharded); may alias params
+    opt_state: Dict[str, Any]         # optimizer state (ZeRO>=1: sharded)
+    scale: LossScaleState             # fp16 loss-scale state
+    skipped_steps: jnp.ndarray        # i32 count of overflow-skipped steps

@@ -1,0 +1,53 @@
+"""Rank-aware logging utilities.
+
+Capability parity with the reference's ``deepspeed/utils/logging.py`` (log_dist,
+rank-filtered logger); implemented against jax process indices instead of torch
+distributed ranks.
+"""
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+_LOGGER_NAME = "deepspeed_tpu"
+
+_log_level = os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper()
+
+
+def _create_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    if logger.handlers:
+        return logger
+    logger.setLevel(getattr(logging, _log_level, logging.INFO))
+    logger.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setFormatter(
+        logging.Formatter("[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",
+                          datefmt="%Y-%m-%d %H:%M:%S"))
+    logger.addHandler(handler)
+    return logger
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the listed process ranks (None / [-1] = all)."""
+    my_rank = _process_index()
+    if ranks is None or -1 in list(ranks) or my_rank in list(ranks):
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str, _seen=set()) -> None:
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

@@ -1,0 +1,47 @@
+"""Tensor-parallel sharding rules: regex on param path -> PartitionSpec.
+
+The reference delegates training TP to an external Megatron `mpu` object and
+does inference TP by per-architecture weight-name policies
+(module_inject/replace_policy.py). Here TP is first-class: models ship a rule
+table mapping parameter-path patterns to PartitionSpecs over the "model" axis,
+and this module applies it to a params pytree.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def path_str(path) -> str:
+    """'transformer/h_0/attn/c_attn/kernel'-style key path string."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def build_tp_specs(params, rules: Optional[Dict[str, P]]):
+    """Pytree of PartitionSpecs (or None) matching ``params``.
+
+    ``rules`` maps regex patterns (searched against the /-joined path) to specs;
+    first match wins, in insertion order. None → no TP sharding for that param.
+    """
+    compiled = [(re.compile(k), v) for k, v in (rules or {}).items()]
+
+    def spec_for(path, leaf):
+        s = path_str(path)
+        for pat, spec in compiled:
+            if pat.search(s):
+                return spec
+        return None
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -1,0 +1,111 @@
+"""Wall-clock + throughput timers.
+
+Capability parity with the reference's ``deepspeed/utils/timer.py``
+(SynchronizedWallClockTimer, ThroughputTimer). "Synchronized" here means
+blocking on the last dispatched jax computation (block_until_ready) rather
+than cuda events.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import jax
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed_ = 0.0
+        self.started = False
+        self._start_t = 0.0
+        self.count = 0
+
+    def start(self):
+        self.started = True
+        self._start_t = time.time()
+
+    def stop(self, sync=None):
+        if not self.started:
+            return
+        if sync is not None:
+            jax.block_until_ready(sync)
+        self.elapsed_ += time.time() - self._start_t
+        self.started = False
+        self.count += 1
+
+    def elapsed(self, reset: bool = True) -> float:
+        e = self.elapsed_
+        if reset:
+            self.elapsed_ = 0.0
+            self.count = 0
+        return e
+
+    def mean(self) -> float:
+        return self.elapsed_ / max(self.count, 1)
+
+
+class SynchronizedWallClockTimer:
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True) -> str:
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}ms")
+        out = " | ".join(parts)
+        if out:
+            from .logging import log_dist
+            log_dist(out, ranks=[0])
+        return out
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPs estimation. reference: utils/timer.py ThroughputTimer."""
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: Optional[int] = None,
+                 model_flops_per_sample: Optional[float] = None):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.model_flops_per_sample = model_flops_per_sample
+        self.epoch_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self._start_t = None
+
+    def start(self):
+        self._start_t = time.time()
+
+    def stop(self, sync=None, report_speed: bool = True):
+        if self._start_t is None:
+            return
+        if sync is not None:
+            jax.block_until_ready(sync)
+        self.global_step_count += 1
+        if self.global_step_count > self.start_step:
+            self.total_elapsed_time += time.time() - self._start_t
+        self._start_t = None
+
+    @property
+    def avg_samples_per_sec(self) -> float:
+        steps = max(self.global_step_count - self.start_step, 1)
+        if self.total_elapsed_time == 0:
+            return 0.0
+        return steps * self.batch_size / self.total_elapsed_time
+
+    @property
+    def avg_tflops(self) -> Optional[float]:
+        if self.model_flops_per_sample is None:
+            return None
+        return self.avg_samples_per_sec * self.model_flops_per_sample / 1e12

@@ -1,0 +1,87 @@
+"""deepspeed_tpu.zero — user-facing ZeRO API.
+
+The reference exports ``zero.Init`` (partition-at-construction) and
+``zero.GatheredParameters`` (temporary full-weight access) from
+``runtime/zero/partition_parameters.py``. In the functional jax world:
+
+- ``Init`` is a context manager under which model *initialization* produces
+  already-sharded fp32 params: it records the target sharding policy so
+  ``DeepSpeedEngine`` (or the user via ``init_sharded``) materializes each
+  param directly on its owner shard — no single host ever holds the full
+  model, which is the reference's reason for Init's existence.
+- ``GatheredParameters`` yields fully-replicated host-accessible copies of
+  selected params (reference partition_parameters.py:1519).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+
+from ..runtime.zero.stages import ZeroShardingPolicy
+from ..parallel.mesh import MeshManager, get_global_mesh
+
+_ACTIVE_INIT = None
+
+
+class Init(contextlib.AbstractContextManager):
+    """with zero.Init(config=...): params = model.init(...) → sharded init.
+
+    reference: runtime/zero/partition_parameters.py:539 (Init / partition at
+    construction). Under jax, `jax.jit(model.init, out_shardings=...)`
+    materializes every parameter directly into its 1/N shard.
+    """
+
+    def __init__(self, config=None, mesh_manager: Optional[MeshManager] = None,
+                 enabled: bool = True):
+        from ..config import load_config
+        self.config = load_config(config)
+        self.mesh_mgr = mesh_manager or get_global_mesh()
+        self.enabled = enabled and self.config.zero_optimization.stage == 3
+
+    def __enter__(self):
+        global _ACTIVE_INIT
+        if self.enabled:
+            _ACTIVE_INIT = self
+        return self
+
+    def __exit__(self, *exc):
+        global _ACTIVE_INIT
+        _ACTIVE_INIT = None
+        return False
+
+    def sharded_init(self, init_fn, *args, **kwargs):
+        """Run ``init_fn`` jitted with ZeRO-3 out-shardings (no full replica)."""
+        if self.mesh_mgr is None:
+            from ..parallel.mesh import build_mesh_from_config
+            self.mesh_mgr = build_mesh_from_config(self.config)
+        policy = ZeroShardingPolicy(3, self.mesh_mgr)
+        shapes = jax.eval_shape(init_fn, *args, **kwargs)
+        shardings = policy.tree_shardings(
+            jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), shapes),
+            policy.param_spec)
+        return jax.jit(init_fn, out_shardings=shardings)(*args, **kwargs)
+
+
+def get_active_init() -> Optional[Init]:
+    return _ACTIVE_INIT
+
+
+@contextlib.contextmanager
+def GatheredParameters(params, modifier_rank: Optional[int] = None, fwd_module=None,
+                       enabled: bool = True):
+    """Yield fully-gathered (replicated) copies of ``params``.
+
+    reference: partition_parameters.py:1519. Mutation-write-back is not needed
+    in the functional model — callers rebuild state from the yielded values.
+    """
+    if not enabled:
+        yield params
+        return
+    gathered = jax.tree.map(lambda p: jax.device_get(p), params)
+    yield gathered
+
+
+__all__ = ["Init", "GatheredParameters", "ZeroShardingPolicy", "get_active_init"]

@@ -1,0 +1,27 @@
+"""Test harness: 8 virtual CPU devices simulate a multi-chip TPU mesh.
+
+Mirrors the reference's DistributedTest pattern (tests/unit/common.py) of
+simulating multi-node on localhost — here via XLA's host-platform device-count
+flag instead of forked NCCL processes. Set DSTPU_TEST_PLATFORM=tpu to run the
+suite against real chips.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+if os.environ.get("DSTPU_TEST_PLATFORM", "cpu") == "cpu":
+    # sitecustomize pins JAX_PLATFORMS=axon before pytest starts; config.update
+    # is the only override that still works after jax has been imported.
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

@@ -1,0 +1,103 @@
+"""Config parsing + batch triangulation tests.
+
+Mirrors reference tests/unit/runtime/test_ds_config_model.py and config tests.
+"""
+
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedConfig, load_config
+
+
+def test_defaults():
+    cfg = load_config(None)
+    assert cfg.zero_optimization.stage == 0
+    assert not cfg.fp16.enabled
+    assert not cfg.bf16.enabled
+    assert cfg.gradient_clipping == 0.0
+
+
+def test_ds_json_keys_parse():
+    """A representative reference-style ds_config must parse unchanged."""
+    cfg = load_config({
+        "train_batch_size": 64,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.00015, "betas": [0.9, 0.999]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 1000}},
+        "fp16": {"enabled": True, "loss_scale": 0, "initial_scale_power": 16,
+                 "loss_scale_window": 1000, "hysteresis": 2, "min_loss_scale": 1},
+        "zero_optimization": {
+            "stage": 2,
+            "allgather_partitions": True,
+            "reduce_scatter": True,
+            "allgather_bucket_size": 50000000,
+            "reduce_bucket_size": 50000000,
+            "overlap_comm": True,
+            "contiguous_gradients": True,
+            "cpu_offload": True,
+        },
+        "gradient_clipping": 1.0,
+        "wall_clock_breakdown": False,
+        "steps_per_print": 10,
+        "activation_checkpointing": {"partition_activations": True, "cpu_checkpointing": False},
+        "flops_profiler": {"enabled": True, "profile_step": 1},
+        "tensorboard": {"enabled": True, "output_path": "/tmp/tb"},
+        "comms_logger": {"enabled": True},
+        "aio": {"block_size": 1048576, "queue_depth": 8},
+        "elasticity": {"enabled": False},
+    })
+    assert cfg.train_batch_size == 64
+    assert cfg.optimizer.type == "Adam"
+    assert cfg.optimizer.params["lr"] == 0.00015
+    assert cfg.scheduler.type == "WarmupLR"
+    assert cfg.fp16.enabled and cfg.fp16.loss_scale == 0
+    assert cfg.zero_optimization.stage == 2
+    # deprecated cpu_offload migrates to offload_optimizer (reference config_utils.py)
+    assert cfg.zero_optimization.offload_optimizer.device == "cpu"
+    assert cfg.gradient_clipping == 1.0
+    assert cfg.activation_checkpointing.partition_activations
+
+
+def test_bf16_alias():
+    cfg = load_config({"train_batch_size": 8, "bfloat16": {"enabled": True}})
+    assert cfg.bf16.enabled
+    assert cfg.precision_dtype == "bfloat16"
+
+
+def test_stage3_aliases():
+    cfg = load_config({"zero_optimization": {
+        "stage": 3,
+        "stage3_prefetch_bucket_size": 1000,
+        "stage3_param_persistence_threshold": 5,
+        "stage3_gather_16bit_weights_on_model_save": True,
+    }})
+    z = cfg.zero_optimization
+    assert z.prefetch_bucket_size == 1000
+    assert z.param_persistence_threshold == 5
+    assert z.gather_16bit_weights_on_model_save
+
+
+@pytest.mark.parametrize("given,expected", [
+    ({"train_batch_size": 32}, (32, 4, 1)),
+    ({"train_batch_size": 32, "gradient_accumulation_steps": 2}, (32, 2, 2)),
+    ({"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2}, (64, 4, 2)),
+    ({"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4}, (64, 4, 2)),
+])
+def test_batch_triangulation(given, expected):
+    """reference: runtime/config.py _set_batch_related_parameters (dp=8)."""
+    cfg = load_config(given)
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert (cfg.train_batch_size, cfg.train_micro_batch_size_per_gpu,
+            cfg.gradient_accumulation_steps) == expected
+
+
+def test_batch_inconsistency_raises():
+    cfg = load_config({"train_batch_size": 10, "train_micro_batch_size_per_gpu": 4,
+                       "gradient_accumulation_steps": 4})
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_sizes(dp_world_size=8)
+
+
+def test_no_batch_raises():
+    cfg = load_config({})
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_sizes(dp_world_size=8)

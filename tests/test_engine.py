@@ -1,0 +1,174 @@
+"""Engine end-to-end tests across ZeRO stages / precisions.
+
+Mirrors the reference's tests/unit/runtime/zero/test_zero.py (training
+correctness per stage vs baseline) and half_precision tests, on an 8-device
+virtual mesh.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from tests.util import SimpleModel, random_batch, batch_stream
+
+
+def make_engine(stage=0, precision="bf16", extra=None, tp=1):
+    cfg = {
+        "train_batch_size": 32,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+        "gradient_clipping": 1.0,
+    }
+    if precision == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    elif precision == "fp16":
+        cfg["fp16"] = {"enabled": True}
+    if tp > 1:
+        cfg["tensor_parallel"] = {"tp_size": tp}
+    if extra:
+        cfg.update(extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(), config=cfg,
+        example_batch=random_batch(4))
+    return engine
+
+
+def train_n(engine, n=15):
+    losses = []
+    stream = batch_stream(engine.config.train_batch_size)
+    for _ in range(n):
+        m = engine.train_batch(next(stream))
+        losses.append(float(m["loss"]))
+    return losses
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stage_trains(stage):
+    engine = make_engine(stage=stage)
+    losses = train_n(engine, n=40)
+    assert losses[-1] < losses[0] * 0.8, f"stage {stage}: loss not decreasing: {losses}"
+
+
+@pytest.mark.parametrize("stage", [0, 3])
+def test_fp32_trains(stage):
+    engine = make_engine(stage=stage, precision="fp32")
+    losses = train_n(engine, n=40)
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_fp16_loss_scaling_trains():
+    engine = make_engine(stage=2, precision="fp16")
+    losses = train_n(engine, n=40)
+    assert losses[-1] < losses[0] * 0.8
+    assert engine.get_loss_scale() > 0
+
+
+def test_stages_agree():
+    """All ZeRO stages are pure resharding — same math, near-identical losses."""
+    ref = train_n(make_engine(stage=0, precision="fp32"), n=5)
+    for stage in (1, 2, 3):
+        got = train_n(make_engine(stage=stage, precision="fp32"), n=5)
+        np.testing.assert_allclose(got, ref, rtol=2e-4)
+
+
+def test_zero3_with_tp_composes():
+    engine = make_engine(stage=3, tp=2)
+    losses = train_n(engine, n=30)
+    assert losses[-1] < losses[0] * 0.85
+
+
+def test_forward_backward_step_api():
+    """Micro-batch API parity: forward/backward/step ≡ train_batch."""
+    engine = make_engine(stage=1, precision="fp32")
+    micro = engine.config.train_micro_batch_size_per_gpu * engine.dp_world_size
+    stream = batch_stream(micro)
+    for step in range(4):
+        for _ in range(engine.config.gradient_accumulation_steps):
+            loss = engine.forward(next(stream))
+            engine.backward(loss)
+        assert engine.is_gradient_accumulation_boundary()
+        metrics = engine.step()
+        assert metrics is not None
+    assert engine.global_steps == 4
+
+
+def test_overflow_skips_step():
+    """Inf grads must skip the update and shrink the loss scale."""
+    engine = make_engine(stage=1, precision="fp16",
+                         extra={"fp16": {"enabled": True, "initial_scale_power": 4,
+                                         "hysteresis": 1}})
+    params_before = engine.module_state_dict()
+    batch = random_batch(32)
+    batch["x"][:] = 1e30  # force overflow
+    scale_before = engine.get_loss_scale()
+    engine.train_batch(batch)
+    params_after = engine.module_state_dict()
+    assert int(engine.state.skipped_steps) == 1
+    assert engine.get_loss_scale() < scale_before
+    for k in params_before:
+        np.testing.assert_array_equal(params_before[k], params_after[k])
+
+
+def test_lr_schedule_wiring():
+    engine = make_engine(stage=0, extra={
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 10, "warmup_max_lr": 1e-2,
+                                 "warmup_type": "linear"}}})
+    m1 = engine.train_batch(random_batch(32))
+    lr_early = float(m1["lr"])
+    for _ in range(12):
+        m = engine.train_batch(random_batch(32))
+    assert float(m["lr"]) > lr_early
+    assert engine.get_lr()[0] == pytest.approx(1e-2, rel=1e-3)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    """Train → save → load into fresh engine → states identical; training continues.
+
+    Mirrors the reference's checkpoint_correctness_verification
+    (tests/unit/checkpoint/common.py:134)."""
+    engine = make_engine(stage=2)
+    train_n(engine, n=3)
+    engine.save_checkpoint(str(tmp_path), tag="tag1")
+    sd1 = engine.module_state_dict()
+    step1 = int(engine.state.step)
+
+    engine2 = make_engine(stage=2)
+    engine2.load_checkpoint(str(tmp_path), tag="tag1")
+    sd2 = engine2.module_state_dict()
+    assert int(engine2.state.step) == step1
+    for k in sd1:
+        np.testing.assert_array_equal(sd1[k], sd2[k])
+
+    # optimizer state must roundtrip bit-for-bit too
+    import jax
+    m1 = jax.tree.leaves(engine.state.opt_state)
+    m2 = jax.tree.leaves(engine2.state.opt_state)
+    for a, b in zip(m1, m2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # loading at a different ZeRO stage works (universal by construction)
+    engine3 = make_engine(stage=3)
+    engine3.load_checkpoint(str(tmp_path), tag="tag1")
+    sd3 = engine3.module_state_dict()
+    for k in sd1:
+        np.testing.assert_array_equal(sd1[k], sd3[k])
+    losses = train_n(engine3, n=3)
+    assert np.isfinite(losses).all()
+
+
+def test_latest_tag(tmp_path):
+    engine = make_engine(stage=1)
+    train_n(engine, n=2)
+    engine.save_checkpoint(str(tmp_path))
+    engine2 = make_engine(stage=1)
+    engine2.load_checkpoint(str(tmp_path))  # resolves via `latest` file
+    assert int(engine2.state.step) == int(engine.state.step)
+
+
+def test_save_16bit_model(tmp_path):
+    engine = make_engine(stage=3)
+    engine.save_16bit_model(str(tmp_path))
+    import os
+    assert os.path.exists(os.path.join(str(tmp_path), "pytorch_model.npz"))

@@ -1,0 +1,65 @@
+"""ProcessTopology / PipelineParallelGrid tests.
+
+Mirrors reference tests/unit/runtime/pipe/test_topology.py (pure python, no devices).
+"""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import (
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    PipelineParallelGrid,
+    ProcessTopology,
+)
+
+
+def test_rank_coord_roundtrip():
+    topo = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert topo.world_size() == 8
+    for rank in range(8):
+        c = topo.get_coord(rank)
+        assert topo.get_rank(pipe=c.pipe, data=c.data, model=c.model) == rank
+
+
+def test_row_major_layout():
+    # last axis varies fastest (reference topology.py layout)
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 4])
+    assert topo.get_rank(pipe=0, data=0) == 0
+    assert topo.get_rank(pipe=0, data=3) == 3
+    assert topo.get_rank(pipe=1, data=0) == 4
+
+
+def test_axis_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    dp_lists = topo.get_axis_comm_lists("data")
+    assert [0, 1, 2, 3] in dp_lists and [4, 5, 6, 7] in dp_lists
+    pp_lists = topo.get_axis_comm_lists("pipe")
+    assert [0, 4] in pp_lists
+
+
+def test_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.filter_match(pipe=0) == [0, 1, 2, 3]
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert "pipe" in topo.get_rank_repr(0) and "model" in topo.get_rank_repr(0)
+    assert "data" not in topo.get_rank_repr(0)  # data axis omitted in ckpt names
+
+
+def test_grid_stage_mapping():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    grid = PipelineParallelGrid(topo, global_rank=0)
+    assert grid.get_pipe_parallel_world_size() == 2
+    assert grid.get_data_parallel_world_size() == 2
+    assert grid.get_model_parallel_world_size() == 2
+    assert grid.is_first_stage()
+    nxt = grid.stage_to_global(1)
+    c = topo.get_coord(nxt)
+    assert c.pipe == 1 and c.data == 0 and c.model == 0
+
+
+def test_duplicate_axes_raise():
+    with pytest.raises(ValueError):
+        ProcessTopology(axes=["a", "a"], dims=[2, 2])

@@ -1,0 +1,46 @@
+"""Shared test fixtures: tiny models + random data.
+
+Mirrors the reference's tests/unit/simple_model.py model zoo.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.linen as nn
+
+
+class SimpleModel(nn.Module):
+    """Classification MLP whose loss is directly returned (DeepSpeed contract)."""
+    hidden: int = 32
+    nclass: int = 8
+    nlayers: int = 2
+
+    @nn.compact
+    def __call__(self, batch, train=False):
+        x, y = batch["x"], batch["y"]
+        h = x
+        for _ in range(self.nlayers):
+            h = nn.relu(nn.Dense(self.hidden)(h))
+        logits = nn.Dense(self.nclass)(h)
+        logp = nn.log_softmax(logits)
+        return -jnp.mean(jnp.sum(jax.nn.one_hot(y, self.nclass) * logp, axis=-1))
+
+
+def random_batch(batch_size: int, dim: int = 16, nclass: int = 8, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(batch_size, dim).astype(np.float32)
+    y = (x[:, :nclass].argmax(-1)).astype(np.int32)  # learnable labels
+    return {"x": x, "y": y}
+
+
+def batch_stream(batch_size: int, dim: int = 16, nclass: int = 8, seed: int = 0):
+    i = seed
+    while True:
+        yield random_batch(batch_size, dim, nclass, seed=i)
+        i += 1
+
+
+def tree_allclose(a, b, rtol=1e-5, atol=1e-6):
+    ok = jax.tree.map(
+        lambda x, y: np.allclose(np.asarray(x), np.asarray(y), rtol=rtol, atol=atol), a, b)
+    return all(jax.tree.leaves(ok))
