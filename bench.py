@@ -1,0 +1,75 @@
+"""Benchmark: flagship GPT training-step throughput on the available chip(s).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Baseline: the reference's headline sustained training throughput of
+50 TFLOPS/GPU (ZeRO-3 Offload on V100, docs/_posts/2021-03-08-zero3-offload.md:65;
+see BASELINE.md). vs_baseline = our model TFLOPs/chip / 50.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_TFLOPS_PER_CHIP = 50.0
+
+
+def main():
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, causal_lm_loss
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_chips = len(jax.devices())
+
+    if on_tpu:
+        preset, batch_size, seq, steps = "gpt2-350m", 8, 1024, 10
+    else:  # smoke path for CPU-only environments
+        preset, batch_size, seq, steps = "gpt2-tiny", 8, 128, 3
+
+    model, cfg = build_model(preset, max_seq_len=seq, remat=on_tpu)
+    config = {
+        "train_batch_size": batch_size * max(n_chips, 1),
+        "train_micro_batch_size_per_gpu": batch_size,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+    }
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        return {"input_ids": rng.integers(
+            0, cfg.vocab_size, size=(batch_size * max(n_chips, 1), seq))}
+
+    engine, *_ = ds.initialize(model=model, config=config,
+                               loss_fn=causal_lm_loss,
+                               example_batch=make_batch())
+    engine.train_batch(make_batch())  # compile + warmup
+    jax.block_until_ready(engine.state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = engine.train_batch(make_batch())
+    jax.block_until_ready(engine.state.params)
+    dt = (time.perf_counter() - t0) / steps
+
+    # 6 * N * T model flops per token-step (fwd 2NT + bwd 4NT)
+    n_params = cfg.num_params()
+    tokens = batch_size * max(n_chips, 1) * seq
+    flops = 6.0 * n_params * tokens
+    tflops_per_chip = flops / dt / max(n_chips, 1) / 1e12
+
+    print(json.dumps({
+        "metric": "gpt2_train_tflops_per_chip",
+        "value": round(tflops_per_chip, 3),
+        "unit": "TFLOPs/chip",
+        "vs_baseline": round(tflops_per_chip / BASELINE_TFLOPS_PER_CHIP, 4),
+        "detail": {"preset": preset, "batch": batch_size, "seq": seq,
+                   "chips": n_chips, "step_time_s": round(dt, 4),
+                   "loss": round(float(m["loss"]), 4), "backend": jax.default_backend()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

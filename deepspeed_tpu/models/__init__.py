@@ -1,0 +1,17 @@
+"""In-tree model family (flagship GPT decoder / BERT encoder + presets)."""
+
+from .transformer import (
+    Transformer,
+    TransformerConfig,
+    Block,
+    build_model,
+    get_config,
+    causal_lm_loss,
+    masked_lm_loss,
+    cross_entropy,
+)
+
+__all__ = [
+    "Transformer", "TransformerConfig", "Block", "build_model", "get_config",
+    "causal_lm_loss", "masked_lm_loss", "cross_entropy",
+]

@@ -1,0 +1,262 @@
+"""Flagship transformer family — GPT-style decoder / BERT-style encoder, TPU-first.
+
+The reference wraps *user* torch models and ships only fused kernels for them
+(DeepSpeedTransformerLayer, csrc/transformer/*; model zoo in tests:
+tests/unit/simple_model.py, tests/unit/modeling.py BERT). Here the model family
+is in-tree and TPU-native:
+
+  - flax.linen modules, bf16 compute / fp32 params (engine holds fp32 master)
+  - layers run under `nn.scan` (one compiled block body for all layers — the
+    XLA-friendly equivalent of the reference's per-layer CUDA kernel reuse) with
+    optional `nn.remat` (activation checkpointing, reference:
+    runtime/activation_checkpointing/checkpointing.py)
+  - Megatron-style tensor parallelism expressed as sharding *rules*
+    (`TransformerConfig.tp_rules()`): qkv/fc1 column-parallel, proj/fc2
+    row-parallel, vocab-parallel embedding. XLA inserts the psum/allgather the
+    reference delegates to an external mpu object.
+  - attention dispatches through ops.attention (Pallas flash on TPU)
+
+Batch contract: a dict with "input_ids" [B, S] (+ optional "labels",
+"attention_mask", "position_ids"); the module returns logits and
+`causal_lm_loss` / `masked_lm_loss` turn them into the scalar loss the engine
+expects (reference contract: loss = engine(batch)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import attention
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    causal: bool = True            # False => BERT-style bidirectional encoder
+    tie_embeddings: bool = True
+    use_bias: bool = True
+    dtype: Dtype = jnp.bfloat16    # compute dtype; params are fp32 (master in engine)
+    remat: bool = False            # activation checkpointing of each block
+    scan_layers: bool = True       # lax.scan over layers (fast compile, ZeRO-3-friendly)
+    attention_impl: str = "auto"   # "auto" | "flash" | "reference"
+    layer_norm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        return self.hidden_size * self.mlp_ratio
+
+    def num_params(self) -> int:
+        h, L, v = self.hidden_size, self.num_layers, self.vocab_size
+        per_layer = 4 * h * h + 2 * self.mlp_dim * h  # qkv+proj + fc1+fc2
+        return v * h + self.max_seq_len * h + L * per_layer
+
+    # -- tensor-parallel sharding rules (regex on param path -> PartitionSpec) --
+    def tp_rules(self) -> Dict[str, P]:
+        """Megatron-style TP over the 'model' mesh axis.
+
+        Scanned layers carry a leading layer dim, so block-param specs lead
+        with None. Column-parallel: qkv & fc1 (output dim sharded);
+        row-parallel: attn proj & fc2 (input dim sharded); embedding is
+        vocab-parallel (reference inference TP slices the same way:
+        module_inject/replace_policy.py).
+        """
+        # scanned layers live under "blocks/..." with a leading layer dim;
+        # unrolled layers are "blocks_<i>/..." without it
+        lead = (None,) if self.scan_layers else ()
+        prefix = r"blocks/" if self.scan_layers else r"blocks_\d+/"
+
+        def block(spec):
+            return P(*(lead + spec))
+
+        return {
+            prefix + r".*attn_qkv/kernel": block((None, "model")),
+            prefix + r".*attn_qkv/bias": block(("model",)),
+            prefix + r".*attn_proj/kernel": block(("model", None)),
+            prefix + r".*mlp_fc/kernel": block((None, "model")),
+            prefix + r".*mlp_fc/bias": block(("model",)),
+            prefix + r".*mlp_proj/kernel": block(("model", None)),
+            r"wte/embedding": P("model", None),
+            r"lm_head/kernel": P(None, "model"),
+        }
+
+
+# -- presets (sizes follow the reference's BASELINE ladder: GPT-2 125M→6.7B,
+#    BERT base/large; docs/_pages/training.md) --------------------------------
+_PRESETS = {
+    "gpt2-tiny": dict(hidden_size=128, num_layers=2, num_heads=4, vocab_size=1024,
+                      max_seq_len=256),
+    "gpt2-125m": dict(hidden_size=768, num_layers=12, num_heads=12),
+    "gpt2-350m": dict(hidden_size=1024, num_layers=24, num_heads=16),
+    "gpt2-760m": dict(hidden_size=1536, num_layers=24, num_heads=16),
+    "gpt2-1.3b": dict(hidden_size=2048, num_layers=24, num_heads=16),
+    "gpt2-2.7b": dict(hidden_size=2560, num_layers=32, num_heads=32),
+    "gpt2-6.7b": dict(hidden_size=4096, num_layers=32, num_heads=32),
+    "bert-base": dict(hidden_size=768, num_layers=12, num_heads=12, causal=False,
+                      vocab_size=30522, max_seq_len=512),
+    "bert-large": dict(hidden_size=1024, num_layers=24, num_heads=16, causal=False,
+                       vocab_size=30522, max_seq_len=512),
+}
+
+
+def get_config(name: str, **overrides) -> TransformerConfig:
+    if name not in _PRESETS:
+        raise ValueError(f"unknown preset '{name}'; have {sorted(_PRESETS)}")
+    kw = dict(_PRESETS[name])
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def _batch_constraint(x):
+    """Constrain activations [B, S, H] to the mesh's batch/seq layout."""
+    try:
+        return jax.lax.with_sharding_constraint(x, P(("data", "expert"), "seq", None))
+    except (ValueError, RuntimeError):  # no mesh in scope (plain CPU tests)
+        return x
+
+
+class Block(nn.Module):
+    """One pre-LN transformer block (attention + MLP)."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask=None, train: bool = False):
+        cfg = self.cfg
+        B, S, H = x.shape
+        nh, hd = cfg.num_heads, cfg.head_dim
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=cfg.use_bias, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+
+        # attention ----------------------------------------------------------
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="ln1")(x)
+        qkv = dense(3 * H, "attn_qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        drop_rng = (self.make_rng("dropout")
+                    if train and cfg.dropout > 0.0 else None)
+        out = attention(q, k, v, causal=cfg.causal, mask=attn_mask,
+                        dropout_rate=cfg.dropout if train else 0.0,
+                        dropout_rng=drop_rng, impl=cfg.attention_impl)
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, H)
+        out = dense(H, "attn_proj")(out)
+        if cfg.dropout > 0.0 and train:
+            out = nn.Dropout(cfg.dropout)(out, deterministic=False)
+        x = _batch_constraint(x + out)
+
+        # mlp ----------------------------------------------------------------
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="ln2")(x)
+        h = dense(cfg.mlp_dim, "mlp_fc")(h)
+        h = nn.gelu(h)
+        h = dense(H, "mlp_proj")(h)
+        if cfg.dropout > 0.0 and train:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=False)
+        return _batch_constraint(x + h)
+
+
+class Transformer(nn.Module):
+    """GPT-style LM (causal=True) or BERT-style encoder (causal=False)."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False):
+        cfg = self.cfg
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            attention_mask = batch.get("attention_mask")
+            position_ids = batch.get("position_ids")
+        else:
+            input_ids, attention_mask, position_ids = batch, None, None
+        B, S = input_ids.shape
+
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="wte")
+        wpe = nn.Embed(cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="wpe")
+        if position_ids is None:
+            position_ids = jnp.arange(S)[None, :]
+        x = wte(input_ids) + wpe(position_ids)
+        if cfg.dropout > 0.0 and train:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=False)
+        x = _batch_constraint(x)
+
+        # padding mask [B, 1, 1, S] broadcast over heads and query positions
+        attn_mask = (attention_mask[:, None, None, :].astype(bool)
+                     if attention_mask is not None else None)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=(3,),
+                             policy=jax.checkpoint_policies.nothing_saveable)
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, attn_mask, train), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block(cfg, name="blocks"), x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = block(cfg, name=f"blocks_{i}")(x, attn_mask, train)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = wte.attend(x)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                              param_dtype=jnp.float32, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Loss functions (engine `loss_fn` contract: loss_fn(outputs, batch) -> scalar)
+# ---------------------------------------------------------------------------
+
+def cross_entropy(logits, labels, ignore_index: int = -100):
+    """Token-level CE with ignore mask; fp32 accumulation."""
+    valid = labels != ignore_index
+    safe = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * valid
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def causal_lm_loss(logits, batch):
+    """Next-token prediction: shift logits/labels by one."""
+    labels = batch.get("labels", batch["input_ids"]) if isinstance(batch, dict) else batch
+    return cross_entropy(logits[:, :-1], labels[:, 1:])
+
+
+def masked_lm_loss(logits, batch):
+    """BERT-style: loss only where labels != -100."""
+    return cross_entropy(logits, batch["labels"])
+
+
+def build_model(name_or_cfg, **overrides) -> Tuple[Transformer, TransformerConfig]:
+    cfg = (name_or_cfg if isinstance(name_or_cfg, TransformerConfig)
+           else get_config(name_or_cfg, **overrides))
+    return Transformer(cfg), cfg

@@ -1,0 +1,98 @@
+"""Attention ops — jnp reference implementation + dispatch to Pallas kernels.
+
+Capability slot of the reference's attention kernel families:
+  csrc/transformer/softmax_kernels.cu + attn_*       -> fused by XLA / Pallas flash
+  deepspeed/ops/sparse_attention/* (Triton, block-sparse) -> block-sparse masks here,
+       Pallas block-skipping kernel in ops/pallas/flash_attention.py
+
+`attention(...)` is the single entry point models call; `impl=` selects
+  "reference" — pure jnp (always available, used as the parity oracle in tests)
+  "flash"     — Pallas TPU flash-attention kernel (ops/pallas/flash_attention.py)
+  "auto"      — flash on TPU, reference elsewhere
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def causal_mask(q_len: int, k_len: int) -> jnp.ndarray:
+    """[q_len, k_len] bool mask, True = attend. Offset so the last q row sees all k."""
+    offset = k_len - q_len
+    q_pos = jnp.arange(q_len)[:, None]
+    k_pos = jnp.arange(k_len)[None, :]
+    return k_pos <= q_pos + offset
+
+
+def mha_reference(q: jnp.ndarray,
+                  k: jnp.ndarray,
+                  v: jnp.ndarray,
+                  *,
+                  causal: bool = True,
+                  bias: Optional[jnp.ndarray] = None,
+                  mask: Optional[jnp.ndarray] = None,
+                  sm_scale: Optional[float] = None,
+                  dropout_rate: float = 0.0,
+                  dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Multi-head attention, jnp reference. q,k,v: [batch, heads, seq, head_dim].
+
+    The numerics oracle every Pallas kernel is tested against (mirrors the
+    reference's in-tree HF-BERT baseline used by tests/unit/ops/cuda/*).
+    softmax accumulates in fp32 regardless of input dtype (as the reference's
+    kernels do for fp16).
+    """
+    *_, q_len, head_dim = q.shape
+    k_len = k.shape[-2]
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(head_dim)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    neg = jnp.asarray(-1e30, jnp.float32)
+    if causal:
+        logits = jnp.where(causal_mask(q_len, k_len)[None, None], logits, neg)
+    if mask is not None:
+        logits = jnp.where(mask, logits, neg)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
+
+
+def attention(q: jnp.ndarray,
+              k: jnp.ndarray,
+              v: jnp.ndarray,
+              *,
+              causal: bool = True,
+              bias: Optional[jnp.ndarray] = None,
+              mask: Optional[jnp.ndarray] = None,
+              sm_scale: Optional[float] = None,
+              dropout_rate: float = 0.0,
+              dropout_rng: Optional[jax.Array] = None,
+              impl: str = "auto",
+              block_q: int = 128,
+              block_k: int = 128) -> jnp.ndarray:
+    """Dispatching attention entry point. Shapes: [batch, heads, seq, head_dim]."""
+    needs_reference = bias is not None or mask is not None or dropout_rate > 0.0
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        impl = "flash" if (on_tpu and not needs_reference) else "reference"
+    if impl == "flash":
+        if needs_reference:
+            # the flash kernel has no mask/bias/dropout path yet — honor the
+            # arguments rather than silently dropping them
+            from ..utils.logging import logger
+            logger.warning("attention impl='flash' does not support "
+                           "mask/bias/dropout; falling back to reference")
+            impl = "reference"
+        else:
+            from .pallas.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                                   block_q=block_q, block_k=block_k)
+    return mha_reference(q, k, v, causal=causal, bias=bias, mask=mask,
+                         sm_scale=sm_scale, dropout_rate=dropout_rate,
+                         dropout_rng=dropout_rng)

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels — the rebuild's equivalent of the reference's csrc/ CUDA
+kernel families (transformer attention, quantization, …). Every kernel has a
+jnp reference oracle in ops/ and interpreter-mode parity tests."""

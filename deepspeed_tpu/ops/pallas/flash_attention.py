@@ -1,0 +1,319 @@
+"""Flash attention (FlashAttention-2 schedule) as Pallas TPU kernels.
+
+Fills the slot of the reference's attention kernels: the fused softmax/attention
+CUDA path (csrc/transformer/softmax_kernels.cu, attn kernels) and the Triton
+block-sparse attention (deepspeed/ops/sparse_attention/) — block-sparse masks
+plug in via the same block-skip mechanism used for causal masking here
+(see ops/sparse.py).
+
+Layout: inputs [batch, heads, seq, head_dim] are flattened to [B*H, S, D];
+grid = (B*H, q_blocks, k_blocks) with the k dimension innermost (sequential on
+TPU), carrying the online-softmax running max/denominator in VMEM scratch.
+Backward recomputes probabilities from the saved logsumexp (no S×S
+materialization) in two kernels: dq (grid over q blocks) and dk/dv (grid over
+k blocks).
+
+Numerics: logits and softmax statistics in fp32; the P·V / dP matmuls cast P to
+the value dtype (bf16), matching standard flash implementations.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _causal_block_mask(s, iq, ik, block_q, block_k, offset):
+    """Apply the triangular mask inside a diagonal block. s: [block_q, block_k].
+
+    ``offset = k_len - q_len`` matches mha_reference's causal semantics: the
+    last query row attends all keys (used for decode where Sk > S)."""
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + offset
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
+                *, sm_scale, causal, block_q, block_k, offset):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    # causal: k blocks strictly above the diagonal contribute nothing
+    run = (ik * block_k <= iq * block_q + block_q - 1 + offset) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]  # [block_q, D]
+        k = k_ref[0]  # [block_k, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            diagonal = ik * block_k + block_k > iq * block_q + offset
+            s = jax.lax.cond(
+                diagonal,
+                lambda x: _causal_block_mask(x, iq, ik, block_q, block_k, offset),
+                lambda x: x, s)
+        m_prev = m_scr[:, :1]                       # [block_q, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)                      # [block_q, block_k] f32
+        l_scr[:, :1] = l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_scr[:, :1] = m_cur
+
+    last = (jnp.clip((iq * block_q + block_q - 1 + offset) // block_k, 0, nk - 1)
+            if causal else nk - 1)
+
+    @pl.when(ik == last)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-37)))
+
+
+def _fwd(q3, k3, v3, causal, sm_scale, block_q, block_k, interpret):
+    BH, S, D = q3.shape
+    Sk = k3.shape[1]
+    nq, nk = S // block_q, Sk // block_k
+    grid = (BH, nq, nk)
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=block_q, block_k=block_k, offset=Sk - S)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, sm_scale, causal, block_q, block_k, offset):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (ik * block_k <= iq * block_q + block_q - 1 + offset) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0, 0][:, None]                # [block_q, 1]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            diagonal = ik * block_k + block_k > iq * block_q + offset
+            s = jax.lax.cond(
+                diagonal,
+                lambda x: _causal_block_mask(x, iq, ik, block_q, block_k, offset),
+                lambda x: x, s)
+        p = jnp.exp(s - lse)                        # [block_q, block_k]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[:] += jax.lax.dot(ds.astype(k.dtype), k,
+                                 preferred_element_type=jnp.float32)
+
+    last = (jnp.clip((iq * block_q + block_q - 1 + offset) // block_k, 0, nk - 1)
+            if causal else nk - 1)
+
+    @pl.when(ik == last)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, sm_scale, causal, block_q, block_k, offset):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # causal: q blocks strictly before this k block never attend it
+    run = (iq * block_q + block_q - 1 + offset >= ik * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            diagonal = ik * block_k + block_k > iq * block_q + offset
+            s = jax.lax.cond(
+                diagonal,
+                lambda x: _causal_block_mask(x, iq, ik, block_q, block_k, offset),
+                lambda x: x, s)
+        p = jnp.exp(s - lse)                        # [block_q, block_k]
+        # dV += P^T dO
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale            # [block_q, block_k]
+        # dK += dS^T Q
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(q3, k3, v3, o3, do3, lse, causal, sm_scale, block_q, block_k,
+         interpret):
+    BH, S, D = q3.shape
+    Sk = k3.shape[1]
+    nq, nk = S // block_q, Sk // block_k
+    # delta_i = rowsum(dO * O) — small elementwise pass, XLA fuses it
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)[:, None, :]            # [BH, 1, S]
+
+    qspec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    kspec_for_dq = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    row_q = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, offset=Sk - S),
+        grid=(BH, nq, nk),
+        in_specs=[qspec, kspec_for_dq, kspec_for_dq, qspec, row_q, row_q],
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct((BH, S, D), q3.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)[0]
+
+    # dkv: grid dim 1 = k block, dim 2 (innermost) = q block
+    qspec2 = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
+    row_q2 = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, offset=Sk - S),
+        grid=(BH, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, row_q2, row_q2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[jax.ShapeDtypeStruct((BH, Sk, D), k3.dtype),
+                   jax.ShapeDtypeStruct((BH, Sk, D), v3.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    q3 = q.reshape(B * H, S, D)
+    k3 = k.reshape(B * H, Sk, D)
+    v3 = v.reshape(B * H, Sk, D)
+    o3, lse = _fwd(q3, k3, v3, causal, sm_scale, block_q, block_k, interpret)
+    return o3.reshape(B, H, S, D), (q3, k3, v3, o3, lse, (B, H, S, D))
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q3, k3, v3, o3, lse, (B, H, S, D) = res
+    do3 = g.reshape(B * H, S, D)
+    dq, dk, dv = _bwd(q3, k3, v3, o3, do3, lse, causal, sm_scale,
+                      block_q, block_k, interpret)
+    Sk = k3.shape[1]
+    return (dq.reshape(B, H, S, D), dk.reshape(B, H, Sk, D),
+            dv.reshape(B, H, Sk, D))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jnp.ndarray,
+                    k: jnp.ndarray,
+                    v: jnp.ndarray,
+                    *,
+                    causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Flash attention. q,k,v: [batch, heads, seq, head_dim] -> same shape.
+
+    Falls back to the jnp reference when shapes don't tile (short sequences):
+    kernels want seq % block == 0 and head_dim lane-friendly.
+    """
+    *_, S, D = q.shape
+    Sk = k.shape[-2]
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(D))
+    block_q = min(block_q, S)
+    block_k = min(block_k, Sk)
+    # fall back unless blocks tile the sequences AND are TPU-tile aligned
+    # (sublane multiple of 16 covers bf16; lane dim D padded by Mosaic)
+    aligned = (S % block_q == 0 and Sk % block_k == 0 and
+               block_q % 16 == 0 and block_k % 16 == 0 and D % 8 == 0)
+    if not aligned:
+        from ..attention import mha_reference
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)

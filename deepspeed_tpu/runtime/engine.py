@@ -216,13 +216,23 @@ class DeepSpeedEngine:
             pass
         takes_train = sig is not None and "train" in sig.parameters
 
+        # probe once whether .apply accepts rngs (flax does; plain objects with
+        # an .apply attribute may not) — a runtime try/except would swallow
+        # genuine TypeErrors raised inside the model
+        takes_rngs = True
+        try:
+            apply_sig = inspect.signature(model.apply)
+            takes_rngs = ("rngs" in apply_sig.parameters or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in apply_sig.parameters.values()))
+        except (TypeError, ValueError):
+            pass
+
         def apply_fn(params, batch, rng, train):
             kwargs = {"train": train} if takes_train else {}
-            rngs = {"dropout": rng} if train else None
-            try:
-                return model.apply({"params": params}, batch, rngs=rngs, **kwargs)
-            except TypeError:
-                return model.apply({"params": params}, batch, **kwargs)
+            if takes_rngs:
+                kwargs["rngs"] = {"dropout": rng} if train else None
+            return model.apply({"params": params}, batch, **kwargs)
 
         return apply_fn
 

@@ -1,0 +1,104 @@
+"""Flagship transformer: shapes, loss descent through the engine, TP rules."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import (Transformer, build_model, causal_lm_loss,
+                                  get_config)
+
+
+def tiny_batch(rng, cfg, batch=8, seq=32):
+    ids = rng.integers(0, cfg.vocab_size, size=(batch, seq))
+    return {"input_ids": ids}
+
+
+def test_forward_shapes():
+    model, cfg = build_model("gpt2-tiny", attention_impl="reference")
+    batch = tiny_batch(np.random.default_rng(0), cfg)
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    logits = model.apply({"params": params}, batch)
+    assert logits.shape == (8, 32, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_scan_and_loop_agree():
+    """nn.scan over layers must match the unrolled loop numerically."""
+    kw = dict(hidden_size=64, num_layers=3, num_heads=4, vocab_size=128,
+              max_seq_len=64, dtype=jnp.float32, attention_impl="reference")
+    m_scan, cfg = build_model("gpt2-tiny", scan_layers=True, **kw)
+    m_loop, _ = build_model("gpt2-tiny", scan_layers=False, **kw)
+    batch = tiny_batch(np.random.default_rng(1), cfg, batch=2, seq=16)
+    p_scan = m_scan.init(jax.random.PRNGKey(7), batch)["params"]
+    # map scanned params [L, ...] -> per-layer dicts for the loop model
+    p_loop = {k: v for k, v in p_scan.items() if k != "blocks"}
+    for i in range(cfg.num_layers):
+        p_loop[f"blocks_{i}"] = jax.tree.map(lambda x: x[i], p_scan["blocks"])
+    out_scan = m_scan.apply({"params": p_scan}, batch)
+    out_loop = m_loop.apply({"params": p_loop}, batch)
+    np.testing.assert_allclose(out_scan, out_loop, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("stage", [0, 2, 3])
+def test_engine_trains_transformer(stage):
+    model, cfg = build_model("gpt2-tiny", hidden_size=64, num_layers=2,
+                             num_heads=4, vocab_size=256, max_seq_len=64,
+                             attention_impl="reference")
+    config = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": stage},
+    }
+    rng = np.random.default_rng(2)
+    batch = tiny_batch(rng, cfg, batch=16, seq=32)
+    engine, *_ = ds.initialize(model=model, config=config,
+                               loss_fn=causal_lm_loss, example_batch=batch)
+    losses = [float(engine.train_batch(tiny_batch(rng, cfg, 16, 32))["loss"])
+              for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_tp_rules_cover_params():
+    model, cfg = build_model("gpt2-tiny", attention_impl="reference")
+    rules = cfg.tp_rules()
+    batch = tiny_batch(np.random.default_rng(0), cfg, batch=2, seq=16)
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    from deepspeed_tpu.utils.partitioning import build_tp_specs
+    specs = build_tp_specs(params, rules)
+    flat = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: x is None or hasattr(x, "index"))
+    matched = [s for s in flat if s is not None]
+    # qkv, qkv bias, proj, fc, fc bias, fc_proj, wte at minimum
+    assert len(matched) >= 6
+
+
+def test_tp_sharded_engine_matches_unsharded():
+    """2-way TP x 2-way DP on the 8-dev CPU mesh == single-device numerics."""
+    kw = dict(hidden_size=64, num_layers=2, num_heads=4, vocab_size=256,
+              max_seq_len=64, dtype=jnp.float32, attention_impl="reference")
+    model, cfg = build_model("gpt2-tiny", **kw)
+    rng = np.random.default_rng(3)
+    batch = tiny_batch(rng, cfg, batch=16, seq=32)
+    base = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+    }
+    cfg_tp = dict(base, tensor_parallel={"tp_size": 2},
+                  zero_optimization={"stage": 1})
+    eng_plain, *_ = ds.initialize(model=model, config=base,
+                                  loss_fn=causal_lm_loss, example_batch=batch,
+                                  rng=jax.random.PRNGKey(11))
+    eng_tp, *_ = ds.initialize(model=model, config=cfg_tp,
+                               loss_fn=causal_lm_loss, example_batch=batch,
+                               rng=jax.random.PRNGKey(11),
+                               sharding_rules=cfg.tp_rules())
+    m1 = eng_plain.train_batch(batch)
+    m2 = eng_tp.train_batch(batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-4, atol=1e-5)
