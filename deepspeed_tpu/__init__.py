@@ -50,7 +50,11 @@ def initialize(args=None,
     if dist_init_required is None or dist_init_required:
         init_distributed()
 
-    engine = DeepSpeedEngine(
+    from .models.pipeline import PipelinedTransformer
+    from .runtime.pipe.engine import PipelineEngine
+    engine_cls = (PipelineEngine if isinstance(model, PipelinedTransformer)
+                  else DeepSpeedEngine)
+    engine = engine_cls(
         model=model,
         config=config,
         model_parameters=model_parameters,

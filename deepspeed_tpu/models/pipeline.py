@@ -1,0 +1,130 @@
+"""Pipelined flagship transformer — the model-side of SPMD pipeline parallelism.
+
+Parameter structure is IDENTICAL to the non-pipelined scan-layers Transformer
+(models/transformer.py) — wte/wpe/blocks[L,...]/ln_f — so checkpoints move
+freely between pp=1 and pp=N topologies (the reference needs an offline
+3D-reshape tool for this, deepspeed/checkpoint/; here it is true by
+construction). The apply path differs: blocks are reshaped [L,...] ->
+[pp, L/pp, ...] and executed with runtime/pipe/spmd.pipeline_apply; embedding
+and head run replicated on every pipe rank (redundant compute, zero
+communication — tied-embedding gradients need no ReduceTiedGrads step, unlike
+the reference's tied-weight allreduce, pipe/engine.py _exec_reduce_tied_grads).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..runtime.pipe.spmd import pipeline_apply, stack_stage_params
+from .transformer import Block, Transformer, TransformerConfig
+
+PyTree = Any
+
+
+class PipelinedTransformer:
+    """Engine-compatible model object (init/apply) that pipelines its blocks.
+
+    n_micro: microbatches fed through the pipeline per train step (the
+    reference's gradient_accumulation_steps == pipeline micro_batches,
+    engine.py:  micro_batches = gas).
+    """
+
+    def __init__(self, cfg: TransformerConfig, pp: int, n_micro: int,
+                 mesh=None):
+        if cfg.num_layers % pp != 0:
+            raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
+                             f"pp {pp}")
+        if cfg.dropout != 0.0:
+            raise NotImplementedError("pipelined path does not thread dropout "
+                                      "rngs yet; set dropout=0")
+        self.cfg = cfg
+        self.pp = pp
+        self.n_micro = n_micro
+        self.mesh = mesh
+        # reference model for param init: identical param structure
+        self._ref = Transformer(
+            cfg if cfg.scan_layers else
+            TransformerConfig(**{**cfg.__dict__, "scan_layers": True}))
+        self._block = Block(cfg)
+        self._ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                                  param_dtype=jnp.float32, name="ln_f")
+
+    # -- engine model contract -----------------------------------------------
+
+    def init(self, rng, batch, **kwargs):
+        return self._ref.init(rng, batch, **kwargs)
+
+    def apply(self, variables, batch, train: bool = False, rngs=None,
+              mesh=None):
+        params = variables["params"]
+        cfg = self.cfg
+        mesh = mesh or self.mesh
+        if mesh is None:
+            from ..parallel.mesh import get_global_mesh
+            mesh = get_global_mesh().mesh
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        B, S = input_ids.shape
+        if B % self.n_micro != 0:
+            raise ValueError(f"batch {B} not divisible by n_micro {self.n_micro}")
+
+        wte = params["wte"]["embedding"]            # [V, H] fp32
+        wpe = params["wpe"]["embedding"]            # [T, H]
+        # reshape the INTEGER ids to microbatches first: ids carry no
+        # cotangent, so the data-axis reshard of the [B]->[n_micro, mb] split
+        # never transposes into a low-precision collective (XLA SPMD miscompiles
+        # bf16 resharding copies on some backends)
+        ids_micros = input_ids.reshape(self.n_micro, B // self.n_micro, S)
+        micros = (wte.astype(cfg.dtype)[ids_micros] +
+                  wpe.astype(cfg.dtype)[jnp.arange(S)][None, None, :])
+        stage_params = stack_stage_params(params["blocks"], self.pp)
+
+        def stage_fn(block_stack, h):
+            # scan this stage's L/pp blocks (same compiled body per layer)
+            def layer(carry, p):
+                out = self._block.apply({"params": p}, carry, None, train)
+                return out, None
+            h, _ = jax.lax.scan(layer, h, block_stack)
+            return h
+
+        outs = pipeline_apply(stage_fn, stage_params, micros, mesh=mesh,
+                              pp=self.pp, remat=cfg.remat)
+        # head runs per-micro; only the fp32 logits are reshaped back to the
+        # flat batch (fp32 resharding avoids the bf16 SPMD copy bug above)
+        h = self._ln_f.apply({"params": params["ln_f"]}, outs)
+        logits = jnp.einsum("nbsh,vh->nbsv", h,
+                            wte.astype(cfg.dtype)).astype(jnp.float32)
+        return logits.reshape((B, S, cfg.vocab_size))
+
+    __call__ = apply
+
+    # -- sharding rules ------------------------------------------------------
+
+    def tp_rules(self) -> Dict[str, P]:
+        """Blocks lead with the 'pipe' axis on the layer dim; embed/head as in
+        the non-pipelined rules."""
+        def block(*spec):
+            return P(*(("pipe",) + spec))
+
+        return {
+            r"blocks/.*attn_qkv/kernel": block(None, "model"),
+            r"blocks/.*attn_qkv/bias": block("model"),
+            r"blocks/.*attn_proj/kernel": block("model", None),
+            r"blocks/.*mlp_fc/kernel": block(None, "model"),
+            r"blocks/.*mlp_fc/bias": block("model"),
+            r"blocks/.*mlp_proj/kernel": block("model", None),
+            r"blocks/": P("pipe"),           # ln scales/biases: pipe only
+            r"wte/embedding": P("model", None),
+            r"lm_head/kernel": P(None, "model"),
+        }
+
+
+def build_pipelined_model(name_or_cfg, pp: int, n_micro: int, **overrides):
+    from .transformer import get_config
+    cfg = (name_or_cfg if isinstance(name_or_cfg, TransformerConfig)
+           else get_config(name_or_cfg, **overrides))
+    return PipelinedTransformer(cfg, pp=pp, n_micro=n_micro), cfg

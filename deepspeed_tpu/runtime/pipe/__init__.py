@@ -1,0 +1,15 @@
+"""Pipeline parallelism: layer specs, schedules, SPMD executor, engine."""
+
+from .module import (LayerSpec, TiedLayerSpec, PipelineModule,
+                     partition_uniform, partition_balanced)
+from .schedule import (PipeSchedule, TrainSchedule, InferenceSchedule,
+                       DataParallelSchedule, bubble_fraction)
+from .spmd import pipeline_apply, stack_stage_params, unstack_stage_params
+from .engine import PipelineEngine
+
+__all__ = [
+    "LayerSpec", "TiedLayerSpec", "PipelineModule", "partition_uniform",
+    "partition_balanced", "PipeSchedule", "TrainSchedule", "InferenceSchedule",
+    "DataParallelSchedule", "bubble_fraction", "pipeline_apply",
+    "stack_stage_params", "unstack_stage_params", "PipelineEngine",
+]

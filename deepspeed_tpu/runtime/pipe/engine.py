@@ -1,0 +1,81 @@
+"""PipelineEngine — train/eval over the SPMD pipeline.
+
+Capability parity with the reference's ``runtime/pipe/engine.py``
+(PipelineEngine(DeepSpeedEngine): train_batch/eval_batch as the only public
+step APIs, micro_batches == gradient_accumulation_steps, forward/backward/step
+redirected). The instruction interpreter + P2P layer (reference engine.py:1360,
+p2p.py) is replaced by one jitted train step whose pipeline loop lives inside
+the model's apply (models/pipeline.py + runtime/pipe/spmd.py); XLA overlaps the
+ppermute transfers with stage compute.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import DeepSpeedEngine
+
+
+class PipelineEngine(DeepSpeedEngine):
+    """Engine whose model pipelines its own microbatch loop.
+
+    The model's apply consumes the FULL global batch (splitting it into
+    pipeline microbatches internally), so the parent's gas-scan is bypassed:
+    one apply == gas microbatches == one optimizer step.
+    """
+
+    def _make_train_step(self):
+        def train_step(state, batch, rng, lr_arg):
+            def scaled_loss(p):
+                out = self.apply_fn(p, batch, rng, True)
+                loss = self.loss_fn(out, batch)
+                return (loss * state.scale.scale).astype(jnp.float32), loss
+
+            grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params)
+            grads = jax.tree.map(
+                lambda g, s: jax.lax.with_sharding_constraint(
+                    g.astype(jnp.float32), s), grads, self.grad_shardings)
+            # loss is already the mean over all microbatches -> n_micro=1
+            new_state, metrics = self._finalize_step(state, grads, 1.0, lr_arg)
+            metrics["loss"] = loss
+            return new_state, metrics
+
+        return jax.jit(train_step, donate_argnums=(0,))
+
+    def train_batch(self, data_iter_or_batch) -> Dict[str, Any]:
+        batch = (next(data_iter_or_batch)
+                 if hasattr(data_iter_or_batch, "__next__")
+                 else data_iter_or_batch)
+        if self.optimizer is None:
+            raise RuntimeError("PipelineEngine needs an optimizer")
+        batch = self.shard_batch(batch)
+        self.tput_timer.start()
+        self.state, metrics = self._train_step(self.state, batch,
+                                               self.next_rng(),
+                                               self._current_lr())
+        self.tput_timer.stop(sync=metrics["loss"])
+        self._after_step(metrics)
+        return metrics
+
+    def eval_batch(self, data_iter_or_batch):
+        batch = (next(data_iter_or_batch)
+                 if hasattr(data_iter_or_batch, "__next__")
+                 else data_iter_or_batch)
+        batch = self.shard_batch(batch)
+        return self._eval_step(self.state.params, batch, self.next_rng())
+
+    # the reference redirects these for pipeline engines (engine.py:1246-1256)
+    def forward(self, *a, **k):
+        raise RuntimeError("PipelineEngine: use train_batch/eval_batch instead "
+                           "of forward()")
+
+    def backward(self, *a, **k):
+        raise RuntimeError("PipelineEngine: use train_batch/eval_batch instead "
+                           "of backward()")
+
+    def step(self, *a, **k):
+        raise RuntimeError("PipelineEngine: use train_batch/eval_batch instead "
+                           "of step()")

@@ -1,0 +1,211 @@
+"""Pipeline instruction schedules — pure-Python, device-free.
+
+Capability parity with the reference's ``runtime/pipe/schedule.py``
+(PipeSchedule ABC, TrainSchedule 1F1B, InferenceSchedule, instruction vocab).
+On TPU the *execution* of pipeline parallelism is a single SPMD program
+(spmd.py: collective-permute microbatch loop compiled by XLA), so these
+schedules are not interpreted per-rank at runtime the way the reference's
+``_exec_schedule`` does — they exist as the analyzable/testable model of the
+pipeline (bubble accounting, buffer counts, schedule visualization) and for
+API parity. The instruction vocabulary matches the reference's names.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+
+# -- instruction vocabulary (reference: schedule.py:336-476) ------------------
+
+class PipeInstruction:
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{type(self).__name__}({args})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    pass
+
+
+class BufferOpInstruction(PipeInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+# -- schedules ---------------------------------------------------------------
+
+class PipeSchedule:
+    """Yields, per clock tick, the list of instructions one stage executes."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        if not 0 <= stage_id < stages:
+            raise ValueError(f"stage_id {stage_id} out of range for {stages} stages")
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def __iter__(self):
+        return self.steps()
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        return micro_batch_id % self.num_pipe_buffers()
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain: each tick forwards one microbatch downstream."""
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        for tick in range(total):
+            cmds: List[PipeInstruction] = []
+            mb = tick - self.stage_id
+            if 0 <= mb < self.micro_batches:
+                buf = self._buffer_idx(mb)
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=buf))
+                else:
+                    cmds.append(RecvActivation(buffer_id=buf))
+                cmds.append(ForwardPass(buffer_id=buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=buf))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B: warm up with (stages-1-stage_id) forwards, then alternate 1
+    forward / 1 backward, drain remaining backwards, then reduce + step.
+
+    Bubble fraction = (stages-1)/(micro_batches+stages-1), identical to the
+    reference's schedule (schedule.py:182-289).
+    """
+
+    def num_pipe_buffers(self) -> int:
+        # in-flight activations this stage must hold (reference: 289)
+        return max(2, min(self.micro_batches, self.stages - self.stage_id))
+
+    def _forwards_before_first_backward(self) -> int:
+        return min(self.micro_batches, self.stages - self.stage_id)
+
+    def steps(self):
+        m, s, sid = self.micro_batches, self.stages, self.stage_id
+        warmup = min(m, s - 1 - sid)
+        fwd_id, bwd_id = 0, 0
+        # clock-aligned: stage sid idles sid ticks before its first forward
+        for _ in range(sid):
+            yield []
+        # warmup forwards
+        for _ in range(warmup):
+            yield self._fwd_cmds(fwd_id)
+            fwd_id += 1
+        # steady state: 1F1B
+        while fwd_id < m:
+            yield self._fwd_cmds(fwd_id) + self._bwd_cmds(bwd_id)
+            fwd_id += 1
+            bwd_id += 1
+        # drain backwards
+        while bwd_id < m:
+            yield self._bwd_cmds(bwd_id)
+            bwd_id += 1
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+    def _fwd_cmds(self, mb: int) -> List[PipeInstruction]:
+        buf = self._buffer_idx(mb)
+        cmds: List[PipeInstruction] = []
+        if self.is_first_stage:
+            cmds.append(LoadMicroBatch(buffer_id=buf))
+        else:
+            cmds.append(RecvActivation(buffer_id=buf))
+        cmds.append(ForwardPass(buffer_id=buf))
+        if not self.is_last_stage:
+            cmds.append(SendActivation(buffer_id=buf))
+        return cmds
+
+    def _bwd_cmds(self, mb: int) -> List[PipeInstruction]:
+        buf = self._buffer_idx(mb)
+        cmds: List[PipeInstruction] = []
+        if not self.is_last_stage:
+            cmds.append(RecvGrad(buffer_id=buf))
+        cmds.append(BackwardPass(buffer_id=buf))
+        if not self.is_first_stage:
+            cmds.append(SendGrad(buffer_id=buf))
+        return cmds
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference: schedule.py:477+)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+    def steps(self):
+        for mb in range(self.micro_batches):
+            yield [LoadMicroBatch(buffer_id=0), ForwardPass(buffer_id=0),
+                   BackwardPass(buffer_id=0)]
+        yield [ReduceGrads(), OptimizerStep()]
+
+
+def bubble_fraction(micro_batches: int, stages: int) -> float:
+    """Idle fraction of the GPipe/1F1B pipeline."""
+    return (stages - 1) / (micro_batches + stages - 1)

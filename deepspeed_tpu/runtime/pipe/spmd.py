@@ -1,0 +1,129 @@
+"""SPMD pipeline execution — GPipe as one compiled program over the 'pipe' axis.
+
+The reference executes pipelines MPMD-style: each rank interprets an
+instruction schedule and exchanges activations over NCCL P2P
+(runtime/pipe/engine.py:1360 _exec_schedule + p2p.py). On TPU the idiomatic
+equivalent is a *single* SPMD program: stage bodies are stacked along a
+leading dim sharded over the mesh's 'pipe' axis, and a `lax.scan` over clock
+ticks moves activations stage→stage with `lax.ppermute` over ICI neighbors.
+Autodiff through the scan+ppermute yields the reverse pipeline (backward
+ticks) without hand-scheduling — XLA's transpose of a collective permute is
+the reversed permute, so the 1F1B-style interleave is recovered by the
+compiler's scheduler rather than an instruction interpreter.
+
+Bubble: (pp-1)/(n_micro+pp-1), identical to the reference's TrainSchedule
+(schedule.py — see runtime/pipe/schedule.py:bubble_fraction).
+
+Memory: like GPipe, live activations scale with in-flight microbatches;
+wrap `stage_fn` in `jax.checkpoint` (remat=True) to keep only per-tick
+boundaries, the analogue of the reference's per-layer activation
+checkpointing interleave (module.py:309).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+PyTree = Any
+
+
+def pipeline_apply(stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
+                   stage_params: PyTree,
+                   micros: jnp.ndarray,
+                   *,
+                   mesh,
+                   pp: int,
+                   remat: bool = False,
+                   pipe_axis: str = "pipe") -> jnp.ndarray:
+    """Run stacked pipeline stages over microbatches.
+
+    stage_fn(params_of_one_stage, x) -> y   applies ONE stage's layer stack
+    stage_params: pytree with leading dim pp on every leaf (sharded over pipe)
+    micros: [n_micro, micro_batch, ...] activations entering stage 0
+    returns [n_micro, micro_batch, ...] outputs of the last stage, replicated
+    over the pipe axis (so the head/loss can run everywhere).
+    """
+    n_micro = micros.shape[0]
+    if pp == 1:
+        body = jax.checkpoint(stage_fn) if remat else stage_fn
+        one = jax.tree.map(lambda x: x[0], stage_params)
+        return jax.lax.map(lambda m: body(one, m), micros)
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    compute_dtype = micros.dtype
+
+    def inner(params, micros):
+        # the boundary crossing is f32 (see psum note below); compute in the
+        # original dtype inside
+        micros = micros.astype(compute_dtype)
+        local = jax.tree.map(lambda x: x[0], params)  # this rank's stage
+        stage = jax.lax.axis_index(pipe_axis)
+        n_ticks = n_micro + pp - 1
+        state = jnp.zeros_like(micros[0])
+        outs = jnp.zeros_like(micros)
+
+        def tick(carry, t):
+            state, outs = carry
+            # shift activations downstream (stage pp-1 sends nowhere; the
+            # GPipe fill/drain means its output was already emitted)
+            recv = jax.lax.ppermute(state, pipe_axis,
+                                    [(i, i + 1) for i in range(pp - 1)])
+            inject = micros[jnp.clip(t, 0, n_micro - 1)]
+            is_first = (stage == 0)
+            x = jnp.where(is_first, inject, recv)
+            y = fn(local, x)
+            # last stage emits microbatch t-(pp-1) at tick t
+            emit_idx = jnp.clip(t - (pp - 1), 0, n_micro - 1)
+            emit = jnp.logical_and(stage == pp - 1, t >= pp - 1)
+            outs = jnp.where(
+                emit,
+                jax.lax.dynamic_update_index_in_dim(outs, y, emit_idx, 0),
+                outs)
+            return (y, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (state, outs), jnp.arange(n_ticks))
+        # replicate the last stage's buffer across pipe ranks. The psum runs
+        # in f32: low-precision collectives inside partial-auto shard_map hit
+        # an XLA SPMD bug ("Invalid binary instruction opcode copy") — the
+        # same reason the micros boundary is f32 (the transpose of a
+        # pipe-replicated input is a psum of its cotangent over pipe). The
+        # per-tick ppermute stays in the compute dtype, so steady-state ICI
+        # traffic is unaffected.
+        outs = jax.lax.psum(
+            jnp.where(stage == pp - 1, outs.astype(jnp.float32), 0.0),
+            pipe_axis)
+        return outs
+
+    out = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(pipe_axis), stage_params), P()),
+        out_specs=P(),
+        axis_names={pipe_axis},
+        check_vma=False,
+    )(stage_params, micros.astype(jnp.float32))
+    return out.astype(compute_dtype)
+
+
+def stack_stage_params(per_layer_params: PyTree, pp: int) -> PyTree:
+    """[L, ...]-stacked per-layer params -> [pp, L/pp, ...] per-stage stacks."""
+
+    def reshape(x):
+        L = x.shape[0]
+        if L % pp != 0:
+            raise ValueError(f"layer count {L} not divisible by {pp} stages")
+        return x.reshape((pp, L // pp) + x.shape[1:])
+
+    return jax.tree.map(reshape, per_layer_params)
+
+
+def unstack_stage_params(stage_params: PyTree) -> PyTree:
+    """[pp, L/pp, ...] -> [L, ...] (checkpoint/interop layout)."""
+    return jax.tree.map(
+        lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]),
+        stage_params)
