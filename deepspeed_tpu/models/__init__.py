@@ -8,10 +8,11 @@ from .transformer import (
     get_config,
     causal_lm_loss,
     masked_lm_loss,
+    make_moe_loss,
     cross_entropy,
 )
 
 __all__ = [
     "Transformer", "TransformerConfig", "Block", "build_model", "get_config",
-    "causal_lm_loss", "masked_lm_loss", "cross_entropy",
+    "causal_lm_loss", "masked_lm_loss", "make_moe_loss", "cross_entropy",
 ]

@@ -42,6 +42,10 @@ class PipelinedTransformer:
         if cfg.dropout != 0.0:
             raise NotImplementedError("pipelined path does not thread dropout "
                                       "rngs yet; set dropout=0")
+        if cfg.moe_experts > 0:
+            raise NotImplementedError("MoE + pipeline composition lands with "
+                                      "aux-loss threading through the pipe "
+                                      "loop; use pp=1 for MoE models")
         self.cfg = cfg
         self.pp = pp
         self.n_micro = n_micro
@@ -86,7 +90,7 @@ class PipelinedTransformer:
         def stage_fn(block_stack, h):
             # scan this stage's L/pp blocks (same compiled body per layer)
             def layer(carry, p):
-                out = self._block.apply({"params": p}, carry, None, train)
+                out, _aux = self._block.apply({"params": p}, carry, None, train)
                 return out, None
             h, _ = jax.lax.scan(layer, h, block_stack)
             return h

@@ -55,6 +55,12 @@ class TransformerConfig:
     scan_layers: bool = True       # lax.scan over layers (fast compile, ZeRO-3-friendly)
     attention_impl: str = "auto"   # "auto" | "flash" | "reference"
     layer_norm_eps: float = 1e-5
+    # MoE (reference: deepspeed/moe/*): >0 replaces every block's MLP with a
+    # mixture of moe_experts experts; aux loss returned next to the logits
+    moe_experts: int = 0
+    moe_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -96,6 +102,11 @@ class TransformerConfig:
             prefix + r".*mlp_proj/kernel": block(("model", None)),
             r"wte/embedding": P("model", None),
             r"lm_head/kernel": P(None, "model"),
+            # MoE expert stacks: [.., E, in, out] — expert axis + row/col TP
+            prefix + r".*experts/fc/kernel": block(("expert", None, "model")),
+            prefix + r".*experts/fc/bias": block(("expert", "model")),
+            prefix + r".*experts/proj/kernel": block(("expert", "model", None)),
+            prefix + r".*experts/proj/bias": block(("expert", None)),
         }
 
 
@@ -164,15 +175,30 @@ class Block(nn.Module):
             out = nn.Dropout(cfg.dropout)(out, deterministic=False)
         x = _batch_constraint(x + out)
 
-        # mlp ----------------------------------------------------------------
+        # mlp / moe ----------------------------------------------------------
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          param_dtype=jnp.float32, name="ln2")(x)
-        h = dense(cfg.mlp_dim, "mlp_fc")(h)
-        h = nn.gelu(h)
-        h = dense(H, "mlp_proj")(h)
+        aux = jnp.zeros((), jnp.float32)
+        if cfg.moe_experts > 0:
+            from ..moe.layer import ExpertMLP, MoE
+            h, aux = MoE(
+                hidden_size=H,
+                num_experts=cfg.moe_experts,
+                expert=lambda: ExpertMLP(H, cfg.mlp_dim, dtype=cfg.dtype,
+                                         use_bias=cfg.use_bias,
+                                         name="experts"),
+                k=cfg.moe_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                eval_capacity_factor=cfg.moe_capacity_factor,
+                dtype=cfg.dtype,
+                name="moe")(h, train=train)
+        else:
+            h = dense(cfg.mlp_dim, "mlp_fc")(h)
+            h = nn.gelu(h)
+            h = dense(H, "mlp_proj")(h)
         if cfg.dropout > 0.0 and train:
             h = nn.Dropout(cfg.dropout)(h, deterministic=False)
-        return _batch_constraint(x + h)
+        return _batch_constraint(x + h), aux
 
 
 class Transformer(nn.Module):
@@ -210,16 +236,19 @@ class Transformer(nn.Module):
             block = nn.remat(Block, static_argnums=(3,),
                              policy=jax.checkpoint_policies.nothing_saveable)
         if cfg.scan_layers:
-            x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, attn_mask, train), None),
+            x, auxes = nn.scan(
+                lambda mdl, carry, _: mdl(carry, attn_mask, train),
                 variable_axes={"params": 0},
-                split_rngs={"params": True, "dropout": True},
+                split_rngs={"params": True, "dropout": True, "gating": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(block(cfg, name="blocks"), x, None)
+            aux_total = jnp.sum(auxes)
         else:
+            aux_total = jnp.zeros((), jnp.float32)
             for i in range(cfg.num_layers):
-                x = block(cfg, name=f"blocks_{i}")(x, attn_mask, train)
+                x, aux = block(cfg, name=f"blocks_{i}")(x, attn_mask, train)
+                aux_total = aux_total + aux
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          param_dtype=jnp.float32, name="ln_f")(x)
@@ -228,7 +257,10 @@ class Transformer(nn.Module):
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                               param_dtype=jnp.float32, name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        if cfg.moe_experts > 0:
+            return logits, aux_total
+        return logits
 
 
 # ---------------------------------------------------------------------------
@@ -254,6 +286,19 @@ def causal_lm_loss(logits, batch):
 def masked_lm_loss(logits, batch):
     """BERT-style: loss only where labels != -100."""
     return cross_entropy(logits, batch["labels"])
+
+
+def make_moe_loss(aux_weight: float = 0.01, base_loss=None):
+    """Loss for MoE models returning (logits, aux): task loss + aux_weight*aux
+    (reference: l_aux scaled into the training loss by the client; the engine
+    keeps the same contract)."""
+    base = base_loss or causal_lm_loss
+
+    def moe_loss(outputs, batch):
+        logits, aux = outputs
+        return base(logits, batch) + aux_weight * aux
+
+    return moe_loss
 
 
 def build_model(name_or_cfg, **overrides) -> Tuple[Transformer, TransformerConfig]:

@@ -1,0 +1,8 @@
+"""Mixture-of-experts: gating, expert-parallel layer, explicit a2a executor."""
+
+from .layer import MoE, ExpertMLP, expert_parallel_apply
+from .sharded_moe import (top1_gating, top2_gating, compute_capacity,
+                          load_balance_loss)
+
+__all__ = ["MoE", "ExpertMLP", "expert_parallel_apply", "top1_gating",
+           "top2_gating", "compute_capacity", "load_balance_loss"]

@@ -1,0 +1,163 @@
+"""MoE layer — expert-parallel mixture of experts over the 'expert' mesh axis.
+
+Capability parity with the reference's ``deepspeed/moe/layer.py`` (MoE wrapper),
+``experts.py`` (local expert stack) and the MOELayer dispatch pipeline
+(sharded_moe.py:439: gate -> einsum dispatch -> all_to_all -> expert ->
+all_to_all -> einsum combine).
+
+TPU-native execution, two paths:
+  * The flax module uses sharding *constraints*: expert weights are stacked
+    [E, ...] and constrained to P("expert", ...); the dispatched queue
+    [E, C, H] is constrained to P("expert"). XLA's SPMD partitioner inserts
+    the token exchange (the reference's `_AllToAll` autograd fn over the
+    expert group, sharded_moe.py:89) automatically from the sharding
+    mismatch between token-sharded gating and expert-sharded compute.
+  * `expert_parallel_apply` is the explicit collective path — a partial-auto
+    shard_map whose `lax.all_to_all` pair is exactly GShard's exchange — used
+    where hand-placement beats the partitioner and as the comm-correctness
+    oracle in tests.
+
+The batch axis is sharded over ("data","expert") — EP is carved out of DP
+exactly as the reference carves expert groups from DP ranks
+(utils/groups.py:109-262).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .sharded_moe import compute_capacity, top1_gating, top2_gating
+
+
+def _constrain(x, *spec):
+    try:
+        return jax.lax.with_sharding_constraint(x, P(*spec))
+    except (ValueError, RuntimeError):   # no mesh in scope
+        return x
+
+
+class ExpertMLP(nn.Module):
+    """Default expert: the transformer MLP (fc -> gelu -> proj)."""
+    hidden_size: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.mlp_dim, use_bias=self.use_bias, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="fc")(x)
+        h = nn.gelu(h)
+        return nn.Dense(self.hidden_size, use_bias=self.use_bias,
+                        dtype=self.dtype, param_dtype=jnp.float32,
+                        name="proj")(h)
+
+
+class MoE(nn.Module):
+    """Mixture-of-experts block: gate + dispatch + expert-parallel compute.
+
+    Returns (y, aux_loss); callers fold aux_loss into the task loss
+    (reference: MoE.forward returns (output, l_aux, exp_counts), layer.py:15).
+    """
+    hidden_size: int
+    num_experts: int
+    expert: Optional[Callable[[], nn.Module]] = None
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, S, H = x.shape
+        E = self.num_experts
+        tokens = x.reshape(B * S, H)
+        T = B * S
+
+        gate_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                               param_dtype=jnp.float32, name="gate")(
+                                   tokens.astype(jnp.float32))
+        rng = (self.make_rng("gating")
+               if train and (self.noisy_gate_policy == "RSample") else None)
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        C = compute_capacity(T, E, cf, self.k, self.min_capacity)
+        gating = top1_gating if self.k == 1 else top2_gating
+        if self.k not in (1, 2):
+            raise ValueError(f"k must be 1 or 2, got {self.k}")
+        kwargs = ({"noisy_gate_policy": self.noisy_gate_policy}
+                  if self.k == 2 else {})
+        aux, combine, dispatch, _ = gating(gate_logits, cf, self.min_capacity,
+                                           rng=rng, capacity=C, **kwargs)
+
+        # dispatch: [T,E,C] x [T,H] -> [E,C,H], then pin the queue to the
+        # expert axis so XLA exchanges tokens instead of replicating experts
+        dispatched = jnp.einsum("tec,th->ech", dispatch.astype(self.dtype),
+                                tokens.astype(self.dtype))
+        dispatched = _constrain(dispatched, "expert", None, None)
+
+        expert_factory = self.expert or (lambda: ExpertMLP(
+            self.hidden_size, self.hidden_size * self.mlp_ratio,
+            dtype=self.dtype, name="experts"))
+        vexpert = nn.vmap(
+            lambda mdl, inp: mdl(inp),
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=0, out_axes=0,
+            metadata_params={nn.PARTITION_NAME: "expert"},
+        )
+        expert_out = vexpert(expert_factory(), dispatched)   # [E, C, H]
+        expert_out = _constrain(expert_out, "expert", None, None)
+
+        y = jnp.einsum("tec,ech->th", combine.astype(self.dtype),
+                       expert_out.astype(self.dtype))
+        return y.reshape(B, S, H), aux.astype(jnp.float32)
+
+
+def expert_parallel_apply(apply_fn: Callable,
+                          expert_params: Any,
+                          dispatched: jnp.ndarray,
+                          *,
+                          mesh,
+                          ep: int,
+                          expert_axis: str = "expert") -> jnp.ndarray:
+    """Explicit GShard exchange: all_to_all -> local experts -> all_to_all.
+
+    apply_fn(params_of_one_expert, x [n, H]) -> [n, H]
+    expert_params: stacked [E, ...] leaves, sharded P(expert_axis, ...)
+    dispatched: [E, Cq, H] expert queues with the QUEUE dim sharded over the
+    expert axis (each ep-rank built its own C = Cq/ep queue slots from its
+    token slice — the GShard pre-exchange layout).
+    Returns [E, Cq, H] with the same layout.
+    """
+    E, Cq, H = dispatched.shape
+    if E % ep != 0 or Cq % ep != 0:
+        raise ValueError(f"experts {E} / queue {Cq} not divisible by ep {ep}")
+
+    def inner(params, disp):
+        # disp local: [E, C, H] — this rank's queue slots for ALL experts.
+        # exchange: give each rank the full queues of ITS local experts
+        x = jax.lax.all_to_all(disp, expert_axis, split_axis=0, concat_axis=1,
+                               tiled=True)            # [El, ep*C, H]
+        y = jax.vmap(apply_fn)(params, x)             # [El, ep*C, H]
+        return jax.lax.all_to_all(y, expert_axis, split_axis=1, concat_axis=0,
+                                  tiled=True)         # [E, C, H] local again
+
+    mapped = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(expert_axis), expert_params),
+                  P(None, expert_axis)),
+        out_specs=P(None, expert_axis),
+        axis_names={expert_axis},
+        check_vma=False,
+    )
+    # partial-auto shard_map requires a jit context (its eager trace path
+    # rejects specs over auto axes); calling under jit is also the fast path
+    return jax.jit(mapped)(expert_params, dispatched)

@@ -1,0 +1,124 @@
+"""MoE gating math — top-1 / top-2 with capacity, jitter, load-balance loss.
+
+Capability parity with the reference's ``deepspeed/moe/sharded_moe.py``
+(top1gating:177 / top2gating:278: GShard-style dispatch/combine tensors,
+capacity + token dropping, load-balancing auxiliary loss, input jitter).
+Implemented from the GShard formulation in pure jnp: everything is
+einsum/one-hot/cumsum — no sorting networks — so XLA lowers it to MXU-friendly
+batched ops and it differentiates cleanly (the combine weights carry the
+gradient; the dispatch mask is a stopped-gradient boolean).
+
+Shapes: logits [T, E] -> combine [T, E, C], dispatch [T, E, C] bool,
+aux_loss scalar; C = ceil(k * T/E * capacity_factor).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _one_hot(idx, num, dtype=jnp.float32):
+    return jax.nn.one_hot(idx, num, dtype=dtype)
+
+
+def compute_capacity(tokens: int, experts: int, capacity_factor: float,
+                     k: int = 1, min_capacity: int = 4) -> int:
+    cap = int(math.ceil(k * tokens / experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def _positions_in_expert(mask: jnp.ndarray) -> jnp.ndarray:
+    """mask [T, E] 0/1 -> position of each token within its expert's queue."""
+    return (jnp.cumsum(mask, axis=0) - 1.0) * mask
+
+
+def load_balance_loss(gates: jnp.ndarray, mask1: jnp.ndarray) -> jnp.ndarray:
+    """l_aux = E * sum_e mean_t(gates[:,e]) * mean_t(mask1[:,e])
+    (reference: sharded_moe.py top1gating aux_loss; the GShard objective)."""
+    E = gates.shape[1]
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1.astype(gates.dtype), axis=0)
+    return jnp.sum(me * ce) * E
+
+
+def top1_gating(logits: jnp.ndarray,
+                capacity_factor: float = 1.0,
+                min_capacity: int = 4,
+                jitter_eps: float = 0.0,
+                rng: Optional[jax.Array] = None,
+                capacity: Optional[int] = None
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (aux_loss, combine [T,E,C], dispatch [T,E,C] bool, exp_counts [E])."""
+    T, E = logits.shape
+    if jitter_eps > 0.0 and rng is not None:
+        logits = logits * jax.random.uniform(
+            rng, logits.shape, minval=1.0 - jitter_eps, maxval=1.0 + jitter_eps)
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    C = capacity if capacity is not None else compute_capacity(
+        T, E, capacity_factor, 1, min_capacity)
+
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(idx1, E)
+    aux = load_balance_loss(gates, mask1)
+
+    pos1 = _positions_in_expert(mask1)
+    keep1 = (pos1 < C) * mask1                         # drop overflow tokens
+    gate1 = jnp.sum(gates * keep1, axis=-1)            # [T]
+
+    disp1 = keep1[:, :, None] * _one_hot(pos1.astype(jnp.int32), C)  # [T, E, C]
+    dispatch = disp1 > 0.0
+    combine = gate1[:, None, None] * jax.lax.stop_gradient(disp1)
+    exp_counts = jnp.sum(keep1, axis=0)
+    return aux, combine, dispatch, exp_counts
+
+
+def top2_gating(logits: jnp.ndarray,
+                capacity_factor: float = 1.0,
+                min_capacity: int = 4,
+                noisy_gate_policy: Optional[str] = None,
+                rng: Optional[jax.Array] = None,
+                capacity: Optional[int] = None
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """GShard top-2: second expert chosen from the top-1-masked logits; both
+    gate values renormalized. (reference: sharded_moe.py:278 top2gating.)"""
+    T, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    if noisy_gate_policy == "RSample" and rng is not None:
+        noise = jax.random.normal(rng, logits.shape) / E
+        logits_for_pick = logits + noise
+    else:
+        logits_for_pick = logits
+    gates = jax.nn.softmax(logits, axis=-1)
+    C = capacity if capacity is not None else compute_capacity(
+        T, E, capacity_factor, 2, min_capacity)
+
+    idx1 = jnp.argmax(logits_for_pick, axis=-1)
+    mask1 = _one_hot(idx1, E)
+    masked = jnp.where(mask1 > 0, -jnp.inf, logits_for_pick)
+    idx2 = jnp.argmax(masked, axis=-1)
+    mask2 = _one_hot(idx2, E)
+
+    aux = load_balance_loss(gates, mask1)
+
+    pos1 = _positions_in_expert(mask1)
+    # expert queues are shared: second choices queue after first choices
+    pos2 = _positions_in_expert(mask2) + jnp.sum(mask1, axis=0, keepdims=True)
+    keep1 = (pos1 < C) * mask1
+    keep2 = (pos2 < C) * mask2
+
+    g1 = jnp.sum(gates * keep1, axis=-1)
+    g2 = jnp.sum(gates * keep2, axis=-1)
+    denom = jnp.maximum(g1 + g2, jnp.finfo(jnp.float32).eps)
+    g1, g2 = g1 / denom, g2 / denom
+
+    disp1 = keep1[:, :, None] * _one_hot(pos1.astype(jnp.int32), C)
+    disp2 = keep2[:, :, None] * _one_hot(pos2.astype(jnp.int32), C)
+    dispatch = (disp1 + disp2) > 0.0
+    combine = (g1[:, None, None] * jax.lax.stop_gradient(disp1) +
+               g2[:, None, None] * jax.lax.stop_gradient(disp2))
+    exp_counts = jnp.sum(keep1 + keep2, axis=0)
+    return aux, combine, dispatch, exp_counts

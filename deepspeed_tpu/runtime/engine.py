@@ -110,9 +110,17 @@ class DeepSpeedEngine:
         stage = self.config.zero_optimization.stage
         self.zero_policy = ZeroShardingPolicy(stage, self.mesh_mgr)
         self.tp_specs = build_tp_specs(params_f32, sharding_rules)
-        self.param_shardings = self.zero_policy.param_shardings(params_f32, self.tp_specs)
-        self.master_shardings = self.zero_policy.master_shardings(params_f32, self.tp_specs)
-        self.grad_shardings = self.zero_policy.grad_shardings(params_f32, self.tp_specs)
+        # expert params (path under an "experts" module, reference: MoE expert
+        # groups carved from DP, utils/groups.py) shard ZeRO state over the
+        # non-expert DP axes only
+        from ..utils.partitioning import path_str
+        expert_fn = lambda path: "experts" in path_str(path)
+        self.param_shardings = self.zero_policy.param_shardings(
+            params_f32, self.tp_specs, expert_fn)
+        self.master_shardings = self.zero_policy.master_shardings(
+            params_f32, self.tp_specs, expert_fn)
+        self.grad_shardings = self.zero_policy.grad_shardings(
+            params_f32, self.tp_specs, expert_fn)
         self.batch_sharding = self.mesh_mgr.batch_sharding()
 
         # optimizer ----------------------------------------------------------
@@ -248,13 +256,25 @@ class DeepSpeedEngine:
         return variables["params"]
 
     def _opt_state_shardings(self, params_f32):
+        """Optimizer-state slots are param-shaped trees (m/v/momentum/...);
+        shard each exactly like the fp32 master so updates stay local."""
         if self.optimizer is None:
             return {}
         shape_state = jax.eval_shape(self.optimizer.init, params_f32)
-        return jax.tree.map(
-            lambda leaf_shape: NamedSharding(
-                self.mesh, self.zero_policy.master_spec(leaf_shape.shape, None)),
-            shape_state)
+        treedef = jax.tree.structure(params_f32)
+        master_flat = jax.tree.leaves(self.master_shardings)
+
+        def per_slot(sub):
+            try:
+                treedef.flatten_up_to(sub)
+                return jax.tree.unflatten(treedef, master_flat)
+            except (ValueError, TypeError):
+                return jax.tree.map(
+                    lambda ls: NamedSharding(
+                        self.mesh, self.zero_policy.master_spec(ls.shape, None)),
+                    sub)
+
+        return {k: per_slot(v) for k, v in shape_state.items()}
 
     # ----------------------------------------------------------- compiled fns
 
