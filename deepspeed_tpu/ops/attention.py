@@ -81,6 +81,17 @@ def attention(q: jnp.ndarray,
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         impl = "flash" if (on_tpu and not needs_reference) else "reference"
+    if impl in ("ring", "ulysses"):
+        if needs_reference:
+            from ..utils.logging import logger
+            logger.warning(f"attention impl='{impl}' does not support "
+                           "mask/bias/dropout; falling back to reference")
+            impl = "reference"
+        else:
+            from ..parallel.ring_attention import (ring_attention,
+                                                   ulysses_attention)
+            fn = ring_attention if impl == "ring" else ulysses_attention
+            return fn(q, k, v, causal=causal, sm_scale=sm_scale)
     if impl == "flash":
         if needs_reference:
             # the flash kernel has no mask/bias/dropout path yet — honor the

@@ -1,0 +1,173 @@
+"""Sequence/context parallelism: ring attention + Ulysses head-exchange.
+
+The reference (v0.8.1) has NO sequence parallelism — its long-context story is
+block-sparse attention kernels (ops/sparse_attention/, SURVEY §5). These two
+schemes are the TPU-native long-context mechanisms that exceed that bar:
+
+  ring_attention — K/V chunks rotate around the 'seq' ICI ring via ppermute
+    while each rank holds its Q chunk; online-softmax accumulation merges
+    per-chunk partial attention (same math as flash attention's k-loop, lifted
+    to the mesh level). Peak memory per chip: O(S/sp), comm fully overlapped
+    with the chunk matmuls by XLA's latency-hiding scheduler.
+
+  ulysses_attention — all_to_all converts seq-sharding to head-sharding
+    (each rank gets H/sp heads with the FULL sequence), runs dense/flash
+    attention locally, and converts back (DeepSpeed-Ulysses layout, which
+    landed in the reference line much later).
+
+Both run inside partial-auto shard_map: manual over 'seq', everything else
+(data/model/expert) stays with the auto partitioner. Accumulators and the
+boundary crossing are f32 (see runtime/pipe/spmd.py for the XLA low-precision
+collective bug); the rotating K/V stay in compute dtype on the wire.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _chunk_attn_update(q, k, v, m, l, acc, q_off, k_off, causal, sm_scale):
+    """One online-softmax accumulation step against a K/V chunk.
+
+    q [B,H,Sq,D]; k,v [B,H,Sk,D]; m,l [B,H,Sq,1] f32; acc [B,H,Sq,D] f32.
+    q_off/k_off: absolute position offsets of the chunks (for causal mask).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+    m_cur = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_cur)
+    p = jnp.exp(s - m_cur)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return m_cur, l_new, acc_new
+
+
+def ring_attention(q: jnp.ndarray,
+                   k: jnp.ndarray,
+                   v: jnp.ndarray,
+                   *,
+                   mesh=None,
+                   causal: bool = True,
+                   sm_scale: Optional[float] = None,
+                   seq_axis: str = "seq") -> jnp.ndarray:
+    """Ring attention over the seq mesh axis. q,k,v: [B, H, S, D], S sharded
+    over seq_axis; returns [B, H, S, D] with the same layout."""
+    if mesh is None:
+        from .mesh import get_global_mesh
+        mesh = get_global_mesh().mesh
+    sp = mesh.shape[seq_axis]
+    D = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / float(np.sqrt(D))
+    if sp == 1:
+        from ..ops.attention import mha_reference
+        return mha_reference(q, k, v, causal=causal, sm_scale=scale)
+
+    compute_dtype = q.dtype
+
+    def inner(q, k, v):
+        q = q.astype(compute_dtype)
+        k = k.astype(compute_dtype)
+        v = v.astype(compute_dtype)
+        r = jax.lax.axis_index(seq_axis)
+        B, H, Sl, _ = q.shape
+        q_off = r * Sl
+        m = jnp.full((B, H, Sl, 1), NEG_INF, jnp.float32)
+        l = jnp.zeros((B, H, Sl, 1), jnp.float32)
+        acc = jnp.zeros((B, H, Sl, D), jnp.float32)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def step(carry, t):
+            k_c, v_c, m, l, acc = carry
+            src = (r - t) % sp                 # origin rank of current chunk
+            m, l, acc = _chunk_attn_update(q, k_c, v_c, m, l, acc,
+                                           q_off, src * Sl, causal, scale)
+            k_c = jax.lax.ppermute(k_c, seq_axis, perm)
+            v_c = jax.lax.ppermute(v_c, seq_axis, perm)
+            return (k_c, v_c, m, l, acc), None
+
+        (k, v, m, l, acc), _ = jax.lax.scan(
+            step, (k, v, m, l, acc), jnp.arange(sp))
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        return (acc / l_safe).astype(jnp.float32)
+
+    out = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(None, None, seq_axis), P(None, None, seq_axis),
+                  P(None, None, seq_axis)),
+        out_specs=P(None, None, seq_axis),
+        axis_names={seq_axis},
+        check_vma=False,
+    )(q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32))
+    return out.astype(compute_dtype)
+
+
+def ulysses_attention(q: jnp.ndarray,
+                      k: jnp.ndarray,
+                      v: jnp.ndarray,
+                      *,
+                      mesh=None,
+                      causal: bool = True,
+                      sm_scale: Optional[float] = None,
+                      seq_axis: str = "seq",
+                      attn_impl: str = "reference") -> jnp.ndarray:
+    """Ulysses-style: a2a seq-shard -> head-shard, full-seq attention, a2a back.
+
+    Requires num_heads % sp == 0. q,k,v: [B, H, S, D], S sharded over seq_axis.
+    """
+    if mesh is None:
+        from .mesh import get_global_mesh
+        mesh = get_global_mesh().mesh
+    sp = mesh.shape[seq_axis]
+    D = q.shape[-1]
+    H = q.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / float(np.sqrt(D))
+    from ..ops.attention import mha_reference
+    if sp == 1:
+        return mha_reference(q, k, v, causal=causal, sm_scale=scale)
+    if H % sp != 0:
+        raise ValueError(f"ulysses needs heads {H} divisible by sp {sp}")
+
+    compute_dtype = q.dtype
+
+    def inner(q, k, v):
+        q = q.astype(compute_dtype)
+        k = k.astype(compute_dtype)
+        v = v.astype(compute_dtype)
+
+        def to_heads(t):   # [B, H, Sl, D] -> [B, H/sp, S, D]
+            return jax.lax.all_to_all(t, seq_axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        def to_seq(t):     # [B, H/sp, S, D] -> [B, H, Sl, D]
+            return jax.lax.all_to_all(t, seq_axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+        if attn_impl == "flash":
+            from ..ops.pallas.flash_attention import flash_attention
+            oh = flash_attention(qh, kh, vh, causal=causal, sm_scale=scale)
+        else:
+            oh = mha_reference(qh, kh, vh, causal=causal, sm_scale=scale)
+        return to_seq(oh).astype(jnp.float32)
+
+    out = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(None, None, seq_axis), P(None, None, seq_axis),
+                  P(None, None, seq_axis)),
+        out_specs=P(None, None, seq_axis),
+        axis_names={seq_axis},
+        check_vma=False,
+    )(q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32))
+    return out.astype(compute_dtype)

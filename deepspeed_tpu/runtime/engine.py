@@ -252,7 +252,12 @@ class DeepSpeedEngine:
         except (TypeError, ValueError):
             pass
         kwargs = {"train": False} if sig is not None and "train" in sig.parameters else {}
-        variables = self.module.init(init_rng, example_batch, **kwargs)
+        # jit: abstract init is faster and partial-auto shard_map regions in
+        # the model (ring attention, explicit-a2a MoE) require a jit context
+        example_batch = jax.tree.map(jnp.asarray, example_batch)
+        variables = jax.jit(
+            lambda rng, batch: self.module.init(rng, batch, **kwargs)
+        )(init_rng, example_batch)
         return variables["params"]
 
     def _opt_state_shardings(self, params_f32):

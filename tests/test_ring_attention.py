@@ -1,0 +1,90 @@
+"""Sequence parallelism: ring attention + Ulysses vs dense reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model, causal_lm_loss
+from deepspeed_tpu.ops.attention import mha_reference
+from deepspeed_tpu.parallel.mesh import MeshManager, set_global_mesh
+from deepspeed_tpu.parallel.ring_attention import (ring_attention,
+                                                  ulysses_attention)
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    mm = MeshManager(sp_size=4)   # seq=4, data=2
+    set_global_mesh(mm)
+    return mm
+
+
+def _qkv(rng, shape, dtype=jnp.float32):
+    return tuple(jnp.asarray(rng.standard_normal(shape), dtype)
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_reference(seq_mesh, causal):
+    rng = np.random.default_rng(0)
+    q, k, v = _qkv(rng, (2, 4, 64, 16))
+    sh = NamedSharding(seq_mesh.mesh, P(None, None, "seq"))
+    qs, ks, vs = (jax.device_put(t, sh) for t in (q, k, v))
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh=seq_mesh.mesh, causal=causal))(qs, ks, vs)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_reference(seq_mesh, causal):
+    rng = np.random.default_rng(1)
+    q, k, v = _qkv(rng, (2, 4, 64, 16))
+    sh = NamedSharding(seq_mesh.mesh, P(None, None, "seq"))
+    qs, ks, vs = (jax.device_put(t, sh) for t in (q, k, v))
+    out = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh=seq_mesh.mesh, causal=causal))(qs, ks, vs)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gradients_match(seq_mesh):
+    rng = np.random.default_rng(2)
+    q, k, v = _qkv(rng, (1, 2, 32, 16))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh=seq_mesh.mesh,
+                                      causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5, err_msg=f"d{n}")
+
+
+def test_transformer_with_ring_attention_trains(seq_mesh):
+    """Flagship model with impl='ring' on a seq-sharded mesh descends."""
+    model, cfg = build_model("gpt2-tiny", hidden_size=64, num_layers=2,
+                             num_heads=4, vocab_size=256, max_seq_len=64,
+                             attention_impl="ring", dtype=jnp.float32)
+    config = {
+        "train_batch_size": 4,
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+        "zero_optimization": {"stage": 1},
+        "sequence_parallel": {"sp_size": 4},
+    }
+    rng = np.random.default_rng(3)
+    mk = lambda: {"input_ids": rng.integers(0, 256, size=(4, 32))}
+    engine, *_ = ds.initialize(model=model, config=config,
+                               loss_fn=causal_lm_loss, example_batch=mk())
+    losses = [float(engine.train_batch(mk())["loss"]) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
