@@ -74,12 +74,19 @@ class InferenceEngine:
 
     __call__ = forward
 
-    def generate(self, *args, **kwargs):
-        """Autoregressive generation with KV cache — models built from
-        deepspeed_tpu.models provide `generate`; arbitrary flax modules must
-        expose their own (reference engine.generate guard, engine.py:537)."""
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k=None, rng=None,
+                 **kwargs):
+        """Autoregressive generation with KV cache (reference:
+        engine.generate guard + fused decode kernels, engine.py:537)."""
+        from ..models.transformer import Transformer
+        if isinstance(self.module, Transformer):
+            from ..models.generation import generate as _gen
+            return _gen(self.module.cfg, self.params,
+                        jnp.asarray(input_ids), max_new_tokens,
+                        temperature, rng, top_k)
         if hasattr(self.module, "generate"):
-            return self.module.generate(self.params, *args, **kwargs)
+            return self.module.generate(self.params, input_ids, **kwargs)
         raise NotImplementedError(
-            "generate() requires a model exposing a generate method "
-            "(see deepspeed_tpu.models)")
+            "generate() requires a deepspeed_tpu.models.Transformer or a "
+            "model exposing its own generate method")

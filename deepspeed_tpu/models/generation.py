@@ -1,0 +1,154 @@
+"""KV-cache decode + autoregressive generation for the flagship transformer.
+
+Capability slot of the reference's inference decode path: the fused
+`softmax_context` attention-with-cache kernels and preallocated KV workspace
+(csrc/transformer/inference/, inference_context.h) and `InferenceEngine.
+generate` (inference/engine.py:537). TPU-native shape: the cache is a
+scan-carried pytree of static-shape buffers ([L, B, heads, max_len, head_dim]),
+the decode step is one jitted function (XLA's compilation cache plays the role
+of CUDA-graph capture/replay), and sampling runs inside `lax.scan` so the
+whole generation loop is a single compiled program.
+
+All functions are pure: (params, cache, ids) -> (logits, cache). They mirror
+models/transformer.Block numerically (same params pytree, scan-layers layout).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import TransformerConfig
+
+PyTree = Any
+
+
+def _layer_norm(x, p, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _dense(x, p):
+    y = x @ p["kernel"].astype(x.dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
+
+
+def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
+               dtype=None) -> Dict[str, jnp.ndarray]:
+    """Preallocated KV workspace (reference: allocate_workspace, pt_binding)."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, batch_size, cfg.num_heads, max_len, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def forward_with_cache(cfg: TransformerConfig, params: PyTree,
+                       input_ids: jnp.ndarray, cache: Dict
+                       ) -> Tuple[jnp.ndarray, Dict]:
+    """Run T_new tokens at positions [cache.pos, cache.pos+T_new) against the
+    cache. Returns (logits [B, T_new, V], updated cache). Params must be the
+    scan-layers layout (blocks leaves [L, ...])."""
+    if cfg.moe_experts > 0:
+        raise NotImplementedError("KV-cache decode for MoE models lands later")
+    B, T_new = input_ids.shape
+    pos = cache["pos"]
+    max_len = cache["k"].shape[3]
+    nh, hd = cfg.num_heads, cfg.head_dim
+
+    wte = params["wte"]["embedding"]
+    wpe = params["wpe"]["embedding"]
+    x = (wte.astype(cfg.dtype)[input_ids] +
+         wpe.astype(cfg.dtype)[pos + jnp.arange(T_new)][None])
+
+    q_abs = pos + jnp.arange(T_new)                 # [T_new]
+    k_pos = jnp.arange(max_len)                     # [max_len]
+    # causal-with-cache mask [T_new, max_len]
+    mask = k_pos[None, :] <= q_abs[:, None]
+
+    def layer(x, xs):
+        p, k_cache, v_cache = xs                    # k/v: [B, nh, max_len, hd]
+        h = _layer_norm(x, p["ln1"], cfg.layer_norm_eps)
+        qkv = _dense(h, p["attn_qkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(B, T_new, nh, hd).transpose(0, 2, 1, 3)
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache).astype(jnp.float32)
+        s = s / np.sqrt(hd)
+        s = jnp.where(mask[None, None], s, -1e30)
+        prob = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", prob, v_cache)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T_new, nh * hd)
+        x = x + _dense(o, p["attn_proj"])
+        h = _layer_norm(x, p["ln2"], cfg.layer_norm_eps)
+        h = _dense(h, p["mlp_fc"])
+        h = jax.nn.gelu(h)
+        x = x + _dense(h, p["mlp_proj"])
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bth,vh->btv", x, wte.astype(x.dtype))
+    else:
+        logits = _dense(x, params["lm_head"])
+    new_cache = {"k": k_new, "v": v_new, "pos": pos + T_new}
+    return logits.astype(jnp.float32), new_cache
+
+
+def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+    """logits [B, V] -> token ids [B]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+@partial(jax.jit, static_argnums=(0, 3, 4, 6))
+def generate(cfg: TransformerConfig,
+             params: PyTree,
+             input_ids: jnp.ndarray,
+             max_new_tokens: int,
+             temperature: float = 0.0,
+             rng: Optional[jax.Array] = None,
+             top_k: Optional[int] = None) -> jnp.ndarray:
+    """Prefill + single-token decode loop, one compiled program.
+
+    input_ids [B, T_prompt] -> [B, T_prompt + max_new_tokens].
+    """
+    B, T_in = input_ids.shape
+    max_len = T_in + max_new_tokens
+    if max_len > cfg.max_seq_len:
+        raise ValueError(f"generation length {max_len} exceeds max_seq_len "
+                         f"{cfg.max_seq_len}")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    cache = init_cache(cfg, B, max_len)
+    logits, cache = forward_with_cache(cfg, params, input_ids, cache)
+    rng, r0 = jax.random.split(rng)
+    tok = _sample(logits[:, -1], r0, temperature, top_k)
+
+    def step(carry, _):
+        tok, cache, rng = carry
+        logits, cache = forward_with_cache(cfg, params, tok[:, None], cache)
+        rng, r = jax.random.split(rng)
+        nxt = _sample(logits[:, -1], r, temperature, top_k)
+        return (nxt, cache, rng), tok
+
+    (last, _, _), toks = jax.lax.scan(
+        step, (tok, cache, rng), None, length=max_new_tokens - 1)
+    out = jnp.concatenate([toks.T, last[:, None]], axis=1)  # [B, max_new]
+    return jnp.concatenate([input_ids, out], axis=1)

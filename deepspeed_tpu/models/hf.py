@@ -1,0 +1,105 @@
+"""HuggingFace weight import — the policy-based module-substitution surface.
+
+Capability parity with the reference's ``deepspeed/module_inject``
+(replace_policy.py per-arch weight-name policies + containers/* weight-name
+mapping). The reference walks a live torch model and rewires its layers to
+fused CUDA modules; here the model IS the TPU-native Transformer, so a
+"policy" is a weight-name mapping from a HF state dict into our params
+pytree. TP slicing happens downstream via sharding rules (the reference
+slices 1/tp_size by hand, containers/base.py:243).
+
+Policies implemented: GPT-2 (HFGPT2Policy). The reference ships ~10
+(replace_policy.py:18-32); further arches land as mappings here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import TransformerConfig
+
+PyTree = Any
+
+
+def _np(t):
+    # torch tensor / numpy array -> numpy
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t)
+
+
+def load_hf_gpt2(model_or_state_dict,
+                 config=None) -> Tuple[PyTree, TransformerConfig]:
+    """Convert a HF GPT2LMHeadModel (or its state_dict) to (params, cfg).
+
+    HF Conv1D stores weights [in, out] — identical to the flax Dense kernel
+    layout, so kernels map without transposition. Layout produced is the
+    scan-layers one (blocks leaves [L, ...]).
+    """
+    if hasattr(model_or_state_dict, "state_dict"):
+        sd = model_or_state_dict.state_dict()
+        config = config or model_or_state_dict.config
+    else:
+        sd = dict(model_or_state_dict)
+    if config is None:
+        raise ValueError("pass the HF config when giving a raw state_dict")
+
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    g = lambda name: _np(sd[prefix + name])
+
+    L = config.n_layer
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.n_positions,
+        hidden_size=config.n_embd,
+        num_layers=L,
+        num_heads=config.n_head,
+        tie_embeddings=True,
+        scan_layers=True,
+        layer_norm_eps=float(config.layer_norm_epsilon),
+    )
+
+    def stack(name):
+        return np.stack([g(f"h.{i}.{name}") for i in range(L)])
+
+    blocks = {
+        "ln1": {"scale": stack("ln_1.weight"), "bias": stack("ln_1.bias")},
+        "attn_qkv": {"kernel": stack("attn.c_attn.weight"),
+                     "bias": stack("attn.c_attn.bias")},
+        "attn_proj": {"kernel": stack("attn.c_proj.weight"),
+                      "bias": stack("attn.c_proj.bias")},
+        "ln2": {"scale": stack("ln_2.weight"), "bias": stack("ln_2.bias")},
+        "mlp_fc": {"kernel": stack("mlp.c_fc.weight"),
+                   "bias": stack("mlp.c_fc.bias")},
+        "mlp_proj": {"kernel": stack("mlp.c_proj.weight"),
+                     "bias": stack("mlp.c_proj.bias")},
+    }
+    import jax
+    params = jax.tree.map(
+        lambda a: jnp.asarray(a, jnp.float32),
+        {
+            "wte": {"embedding": g("wte.weight")},
+            "wpe": {"embedding": g("wpe.weight")},
+            "blocks": blocks,
+            "ln_f": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+        })
+    return params, cfg
+
+
+# policy registry (reference: replace_policy.py replace_policies list)
+HF_POLICIES = {
+    "gpt2": load_hf_gpt2,
+    "GPT2LMHeadModel": load_hf_gpt2,
+}
+
+
+def load_hf(model, arch: str = None):
+    """Dispatch on HF architecture name (reference: replace_module.py policy
+    matching by class)."""
+    arch = arch or type(model).__name__
+    for key, fn in HF_POLICIES.items():
+        if key.lower() in arch.lower():
+            return fn(model)
+    raise NotImplementedError(
+        f"no import policy for architecture '{arch}'; have {list(HF_POLICIES)}")
