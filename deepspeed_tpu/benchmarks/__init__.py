@@ -1,0 +1,1 @@
+"""Benchmark harnesses (collective sweeps = the reference's ds_bench)."""

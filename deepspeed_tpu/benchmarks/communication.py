@@ -1,0 +1,132 @@
+"""Collective benchmarks — latency / algorithm BW / bus BW sweeps.
+
+Capability parity with the reference's ``benchmarks/communication/*`` +
+``bin/ds_bench`` (all_reduce/all_gather/all_to_all/broadcast/pt2pt sweeps
+with algbw/busbw accounting). TPU edition: collectives run inside shard_map
+over the full device mesh; busbw factors follow the standard ring-algorithm
+accounting the reference uses (all_reduce busbw = 2(n-1)/n * algbw, etc.).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _mesh_all():
+    devs = jax.devices()
+    return Mesh(np.asarray(devs), ("all",))
+
+
+def _timed(fn, arg, iters: int, warmups: int = 2) -> float:
+    for _ in range(warmups):
+        out = fn(arg)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(arg)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _collective_fn(op: str, mesh) -> Callable:
+    n = mesh.devices.size
+
+    if op == "all_reduce":
+        return jax.jit(jax.shard_map(
+            lambda x: jax.lax.psum(x, "all"),
+            mesh=mesh, in_specs=P("all"), out_specs=P("all"), check_vma=False))
+    if op == "all_gather":
+        return jax.jit(jax.shard_map(
+            lambda x: jax.lax.all_gather(x, "all", tiled=True),
+            mesh=mesh, in_specs=P("all"), out_specs=P(), check_vma=False))
+    if op == "reduce_scatter":
+        return jax.jit(jax.shard_map(
+            lambda x: jax.lax.psum_scatter(x, "all", tiled=True),
+            mesh=mesh, in_specs=P(), out_specs=P("all"), check_vma=False))
+    if op == "all_to_all":
+        return jax.jit(jax.shard_map(
+            lambda x: jax.lax.all_to_all(
+                x.reshape(n, -1), "all", split_axis=0, concat_axis=0,
+                tiled=True).reshape(-1),
+            mesh=mesh, in_specs=P("all"), out_specs=P("all"), check_vma=False))
+    if op == "pt2pt":
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return jax.jit(jax.shard_map(
+            lambda x: jax.lax.ppermute(x, "all", perm),
+            mesh=mesh, in_specs=P("all"), out_specs=P("all"), check_vma=False))
+    raise ValueError(f"unknown op {op}")
+
+
+def busbw_factor(op: str, n: int) -> float:
+    """Ring-algorithm bus bandwidth factors (reference: communication/utils.py)."""
+    if n <= 1:
+        return 1.0
+    return {
+        "all_reduce": 2.0 * (n - 1) / n,
+        "all_gather": (n - 1) / n,
+        "reduce_scatter": (n - 1) / n,
+        "all_to_all": (n - 1) / n,
+        "pt2pt": 1.0,
+    }[op]
+
+
+def run_op_sweep(op: str, sizes_mb: List[float], dtype=jnp.bfloat16,
+                 iters: int = 10) -> List[Dict]:
+    mesh = _mesh_all()
+    n = mesh.devices.size
+    fn = _collective_fn(op, mesh)
+    itemsize = jnp.dtype(dtype).itemsize
+    rows = []
+    for mb in sizes_mb:
+        numel = max(int(mb * 2 ** 20 / itemsize) // n * n, n)
+        x = jax.device_put(jnp.ones((numel,), dtype),
+                           NamedSharding(mesh, P("all")))
+        dt = _timed(fn, x, iters)
+        size_bytes = numel * itemsize
+        algbw = size_bytes / dt / 1e9
+        rows.append({"op": op, "size_mb": round(size_bytes / 2 ** 20, 3),
+                     "latency_us": round(dt * 1e6, 1),
+                     "algbw_gbps": round(algbw, 3),
+                     "busbw_gbps": round(algbw * busbw_factor(op, n), 3)})
+    return rows
+
+
+def print_table(rows: List[Dict]):
+    if not rows:
+        return
+    cols = list(rows[0])
+    widths = [max(len(c), max(len(str(r[c])) for r in rows)) for c in cols]
+    line = "  ".join(c.ljust(w) for c, w in zip(cols, widths))
+    print(line)
+    print("-" * len(line))
+    for r in rows:
+        print("  ".join(str(r[c]).ljust(w) for c, w in zip(cols, widths)))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ds_bench",
+                                description="collective benchmark sweeps")
+    p.add_argument("--ops", default="all_reduce,all_gather,reduce_scatter,"
+                                    "all_to_all,pt2pt")
+    p.add_argument("--sizes-mb", default="1,16,64")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args(argv)
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+             "float16": jnp.float16}[args.dtype]
+    sizes = [float(s) for s in args.sizes_mb.split(",")]
+    all_rows = []
+    for op in args.ops.split(","):
+        all_rows += run_op_sweep(op.strip(), sizes, dtype, args.iters)
+    print_table(all_rows)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""Elastic training: world-size-compatible batch configuration math."""
+
+from .elasticity import (HCN_LIST, ElasticityError, compute_elastic_config,
+                         get_best_candidates, get_valid_gpus)
+
+__all__ = ["HCN_LIST", "ElasticityError", "compute_elastic_config",
+           "get_best_candidates", "get_valid_gpus"]

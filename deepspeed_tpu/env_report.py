@@ -1,0 +1,85 @@
+"""Environment / op-compatibility report — the reference's `ds_report` CLI
+(env_report.py: op compat matrix + torch/cuda versions). TPU edition: jax
+stack versions, device inventory, and a kernel-compatibility probe table
+(each Pallas/collective family compile-checked on the current backend).
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+GREEN_OK = "[OKAY]"
+RED_NO = "[NO]"
+
+
+def op_compat_table():
+    """Probe each kernel family with a tiny compile (returns list of rows)."""
+    import jax
+    import jax.numpy as jnp
+    rows = []
+
+    def probe(name, fn):
+        try:
+            fn()
+            rows.append((name, True, ""))
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            rows.append((name, False, type(e).__name__))
+
+    x = jnp.ones((4, 4), jnp.float32)
+    probe("jit", lambda: jax.jit(lambda a: a @ a)(x).block_until_ready())
+
+    def flash():
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        q = jnp.ones((1, 1, 128, 32), jnp.float32)
+        on_tpu = jax.default_backend() == "tpu"
+        flash_attention(q, q, q, causal=True, interpret=not on_tpu
+                        ).block_until_ready()
+    probe("pallas_flash_attention", flash)
+
+    def collectives():
+        import numpy as np
+        n = len(jax.devices())
+        jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(
+            jnp.ones((n, 2))).block_until_ready()
+    probe("collectives(psum)", collectives)
+
+    def moe_gate():
+        from deepspeed_tpu.moe import top1_gating
+        top1_gating(jnp.ones((8, 2)), capacity=4)
+    probe("moe_gating", moe_gate)
+    return rows
+
+
+def report_text() -> str:
+    import jax
+    import jaxlib
+    lines = ["-" * 60, "deepspeed_tpu report", "-" * 60]
+    import deepspeed_tpu
+    lines.append(f"deepspeed_tpu ........ {deepspeed_tpu.__version__}")
+    lines.append(f"jax .................. {jax.__version__}")
+    lines.append(f"jaxlib ............... {jaxlib.__version__}")
+    try:
+        import flax
+        lines.append(f"flax ................. {flax.__version__}")
+    except ImportError:
+        lines.append("flax ................. not installed")
+    lines.append(f"python ............... {sys.version.split()[0]}")
+    lines.append(f"backend .............. {jax.default_backend()}")
+    devs = jax.devices()
+    lines.append(f"devices .............. {len(devs)} x {devs[0].device_kind}")
+    lines.append("-" * 60)
+    lines.append("kernel/op compatibility")
+    for name, ok, err in op_compat_table():
+        status = GREEN_OK if ok else f"{RED_NO} ({err})"
+        lines.append(f"  {name:<28s} {status}")
+    lines.append("-" * 60)
+    return "\n".join(lines)
+
+
+def cli_main():
+    print(report_text())
+
+
+if __name__ == "__main__":
+    cli_main()

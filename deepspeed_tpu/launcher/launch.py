@@ -1,0 +1,45 @@
+"""Per-host bootstrap — `python -m deepspeed_tpu.launcher.launch`.
+
+Capability parity with ``deepspeed/launcher/launch.py`` (the per-node spawner
+that sets RANK/LOCAL_RANK/WORLD_SIZE and forks one process per GPU). On TPU
+each host runs ONE process owning all local chips; this module initializes
+the multi-host runtime via `jax.distributed.initialize` (coordinator
+rendezvous = the reference's MASTER_ADDR/MASTER_PORT TCP store) and then runs
+the user script in-process (runpy), so the user script sees the full
+multi-host `jax.devices()` world.
+"""
+
+from __future__ import annotations
+
+import argparse
+import runpy
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="deepspeed_tpu.launcher.launch")
+    p.add_argument("--node_rank", type=int, required=True)
+    p.add_argument("--nnodes", type=int, required=True)
+    p.add_argument("--coordinator", required=True,
+                   help="host:port of process 0")
+    p.add_argument("--world_info", default="",
+                   help="base64 host->slots map (informational on TPU)")
+    p.add_argument("user_script")
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax
+    if args.nnodes > 1:
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.nnodes,
+            process_id=args.node_rank)
+    sys.argv = [args.user_script] + args.user_args
+    runpy.run_path(args.user_script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

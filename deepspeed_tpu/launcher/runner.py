@@ -1,0 +1,209 @@
+"""Multi-node launcher CLI — `dstpu` (the reference's `deepspeed`/`ds` CLI).
+
+Capability parity with ``deepspeed/launcher/runner.py`` (hostfile parsing,
+--include/--exclude filters, single-node exec, multi-node per-host dispatch)
+re-targeted at TPU pods: instead of forking one process per GPU with
+RANK/LOCAL_RANK env (launch.py:129), TPU hosts run ONE process per host and
+`jax.distributed.initialize` wires the multi-host runtime (the per-host device
+set is what the reference calls the local world). Remote dispatch uses ssh
+(the reference's PDSH runner, multinode_runner.py:45) built as an argv list.
+
+Hostfile syntax is the reference's:
+    worker-1 slots=4
+    worker-2 slots=4
+and --include/--exclude use `host:slot1,slot2@host2:...` filters
+(runner.py:386-418).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+DSTPU_ENV_FILE = ".deepspeed_env"
+
+
+def parse_hostfile(lines) -> "OrderedDict[str, int]":
+    """'host slots=N' per line -> {host: N}; '#' comments allowed."""
+    resource_pool: "OrderedDict[str, int]" = OrderedDict()
+    for raw in lines:
+        line = raw.split("#")[0].strip()
+        if not line:
+            continue
+        try:
+            host, slots_str = line.split()
+            key, val = slots_str.split("=")
+            if key != "slots":
+                raise ValueError
+            slots = int(val)
+        except ValueError:
+            raise ValueError(f"invalid hostfile line: {raw!r} "
+                             "(expected 'hostname slots=N')")
+        if host in resource_pool:
+            raise ValueError(f"duplicate host {host} in hostfile")
+        resource_pool[host] = slots
+    return resource_pool
+
+
+def fetch_hostfile(path: Optional[str]) -> "OrderedDict[str, int]":
+    if not path or not os.path.isfile(path):
+        return OrderedDict()
+    with open(path) as f:
+        return parse_hostfile(f)
+
+
+def _parse_filter(s: str) -> Dict[str, Optional[List[int]]]:
+    """'host1:0,2@host2' -> {host1: [0,2], host2: None (all slots)}."""
+    out: Dict[str, Optional[List[int]]] = {}
+    for part in s.split("@"):
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.split(":")
+            out[host] = [int(x) for x in slots.split(",") if x != ""]
+        else:
+            out[part] = None
+    return out
+
+
+def parse_inclusion_exclusion(resource_pool: Dict[str, int],
+                              include_str: str = "",
+                              exclude_str: str = "") -> "OrderedDict[str, List[int]]":
+    """Apply --include/--exclude filters (reference: parse_resource_filter)."""
+    active: "OrderedDict[str, List[int]]" = OrderedDict(
+        (h, list(range(n))) for h, n in resource_pool.items())
+    if include_str and exclude_str:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    if include_str:
+        wanted = _parse_filter(include_str)
+        for h in wanted:
+            if h not in active:
+                raise ValueError(f"included host {h} not in hostfile")
+        active = OrderedDict(
+            (h, wanted[h] if wanted[h] is not None else list(range(resource_pool[h])))
+            for h in wanted)
+        for h, slots in active.items():
+            bad = [s for s in slots if s >= resource_pool[h]]
+            if bad:
+                raise ValueError(f"host {h} has no slots {bad}")
+    elif exclude_str:
+        banned = _parse_filter(exclude_str)
+        for h, slots in banned.items():
+            if h not in active:
+                raise ValueError(f"excluded host {h} not in hostfile")
+            if slots is None:
+                del active[h]
+            else:
+                active[h] = [s for s in active[h] if s not in slots]
+                if not active[h]:
+                    del active[h]
+    return active
+
+
+def encode_world_info(active: Dict[str, List[int]]) -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(active).encode()).decode()
+
+
+def decode_world_info(blob: str) -> Dict[str, List[int]]:
+    return json.loads(base64.urlsafe_b64decode(blob.encode()).decode())
+
+
+def build_launch_cmd(host_idx: int, num_hosts: int, coordinator: str,
+                     port: int, world_info: str, user_script: str,
+                     user_args: List[str]) -> List[str]:
+    """Per-host command: one process per host; jax.distributed wires chips."""
+    return [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+            f"--node_rank={host_idx}",
+            f"--nnodes={num_hosts}",
+            f"--coordinator={coordinator}:{port}",
+            f"--world_info={world_info}",
+            user_script] + list(user_args)
+
+
+def build_ssh_cmd(host: str, remote_cmd: List[str],
+                  env_exports: Dict[str, str]) -> List[str]:
+    exports = " ".join(f"export {k}={shlex.quote(v)};"
+                       for k, v in env_exports.items())
+    return ["ssh", "-o", "StrictHostKeyChecking=no", host,
+            f"cd {shlex.quote(os.getcwd())}; {exports} " +
+            " ".join(shlex.quote(c) for c in remote_cmd)]
+
+
+def collect_env_exports() -> Dict[str, str]:
+    """Env vars forwarded to workers (reference: runner.py:508-563 exports
+    NCCL_*/PYTHON* + .deepspeed_env file)."""
+    exports = {}
+    for key, val in os.environ.items():
+        if key.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU_", "PYTHONPATH")):
+            exports[key] = val
+    if os.path.isfile(DSTPU_ENV_FILE):
+        with open(DSTPU_ENV_FILE) as f:
+            for line in f:
+                line = line.strip()
+                if line and "=" in line and not line.startswith("#"):
+                    k, v = line.split("=", 1)
+                    exports[k] = v
+    return exports
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="dstpu", description="deepspeed_tpu multi-host launcher")
+    p.add_argument("-H", "--hostfile", default="/job/hostfile")
+    p.add_argument("-i", "--include", default="")
+    p.add_argument("-e", "--exclude", default="")
+    p.add_argument("--num_nodes", type=int, default=-1)
+    p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument("--master_addr", default="")
+    p.add_argument("--launcher", default="ssh", choices=["ssh", "local"])
+    p.add_argument("user_script")
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    pool = fetch_hostfile(args.hostfile)
+    if not pool:
+        # single node, all local chips
+        cmd = [sys.executable, args.user_script] + args.user_args
+        os.execvpe(cmd[0], cmd, os.environ.copy())
+        return
+    active = parse_inclusion_exclusion(pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = OrderedDict(list(active.items())[:args.num_nodes])
+    hosts = list(active)
+    coordinator = args.master_addr or hosts[0]
+    world_info = encode_world_info(active)
+    exports = collect_env_exports()
+    procs = []
+    for idx, host in enumerate(hosts):
+        remote = build_launch_cmd(idx, len(hosts), coordinator,
+                                  args.master_port, world_info,
+                                  args.user_script, args.user_args)
+        cmd = (remote if args.launcher == "local"
+               else build_ssh_cmd(host, remote, exports))
+        procs.append(subprocess.Popen(cmd))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    # kill stragglers if any rank failed (reference: launch.py
+    # terminate_process_tree supervision)
+    if rc:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""Profiling: XLA-cost-analysis flops profiler."""
+
+from .flops_profiler import (FlopsProfiler, compiled_cost, get_model_profile,
+                             params_breakdown, params_count)
+
+__all__ = ["FlopsProfiler", "compiled_cost", "get_model_profile",
+           "params_breakdown", "params_count"]

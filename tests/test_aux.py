@@ -1,0 +1,117 @@
+"""Aux subsystems: elasticity math, flops profiler, launcher parsing, ds_report."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityError, compute_elastic_config,
+                                      get_valid_gpus)
+from deepspeed_tpu.launcher.runner import (decode_world_info,
+                                           encode_world_info, fetch_hostfile,
+                                           parse_hostfile,
+                                           parse_inclusion_exclusion)
+from deepspeed_tpu.profiling import (FlopsProfiler, compiled_cost,
+                                     get_model_profile, params_count)
+
+
+# -- elasticity ---------------------------------------------------------------
+
+def test_valid_gpus():
+    # batch 24, micro 2 or 3: gpus g valid iff (24/2) % g == 0 or (24/3) % g == 0
+    assert get_valid_gpus(24, [2, 3], 1, 12) == [1, 2, 3, 4, 6, 8, 12]
+
+
+def test_compute_elastic_config():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 100,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 16, "version": 0.1}}
+    batch, valid, micro = compute_elastic_config(cfg, world_size=8)
+    assert batch <= 100
+    assert 8 in valid
+    assert micro in (2, 4)
+    assert batch % (micro * 8) == 0
+
+
+def test_elastic_config_rejects_bad_world():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 8,
+                          "micro_batch_sizes": [4], "min_gpus": 1,
+                          "max_gpus": 2}}
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(cfg, world_size=7)
+
+
+def test_elastic_disabled_raises():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+
+
+# -- launcher -----------------------------------------------------------------
+
+def test_parse_hostfile():
+    hf = ["# comment", "worker-1 slots=4", "", "worker-2 slots=8 # inline"]
+    pool = parse_hostfile(hf)
+    assert pool == {"worker-1": 4, "worker-2": 8}
+    with pytest.raises(ValueError):
+        parse_hostfile(["worker-1 gpus=4"])
+    with pytest.raises(ValueError):
+        parse_hostfile(["w slots=2", "w slots=2"])
+
+
+def test_include_exclude_filters():
+    pool = {"a": 4, "b": 4, "c": 2}
+    inc = parse_inclusion_exclusion(pool, include_str="a:0,2@c")
+    assert inc == {"a": [0, 2], "c": [0, 1]}
+    exc = parse_inclusion_exclusion(pool, exclude_str="b@c:0")
+    assert exc == {"a": [0, 1, 2, 3], "c": [1]}
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(pool, include_str="a", exclude_str="b")
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(pool, include_str="zzz")
+
+
+def test_world_info_roundtrip():
+    active = {"h1": [0, 1], "h2": [0]}
+    assert decode_world_info(encode_world_info(active)) == active
+
+
+# -- flops profiler -----------------------------------------------------------
+
+def test_compiled_cost_counts_matmul_flops():
+    a = jnp.ones((128, 256), jnp.float32)
+    b = jnp.ones((256, 64), jnp.float32)
+    cost = compiled_cost(lambda a, b: a @ b, a, b)
+    # 2*M*N*K flops
+    expected = 2 * 128 * 256 * 64
+    assert cost["flops"] == pytest.approx(expected, rel=0.1)
+
+
+def test_profiler_and_breakdown():
+    from deepspeed_tpu.models import build_model
+    model, cfg = build_model("gpt2-tiny", hidden_size=32, num_layers=2,
+                             num_heads=2, vocab_size=64, max_seq_len=32,
+                             dtype=jnp.float32, attention_impl="reference")
+    batch = {"input_ids": np.zeros((2, 16), np.int32)}
+    flops, macs, n_params = get_model_profile(model, batch)
+    assert flops > 0 and macs == flops / 2
+    assert n_params == params_count(
+        model.init(jax.random.PRNGKey(0), batch)["params"])
+
+    prof = FlopsProfiler()
+    stats = prof.profile(lambda x: jnp.sum(x @ x), jnp.ones((64, 64)))
+    assert stats["tflops_achieved"] >= 0
+    text = prof.print_model_profile(
+        model.init(jax.random.PRNGKey(0), batch)["params"])
+    assert "params total" in text
+
+
+# -- ds_report ----------------------------------------------------------------
+
+def test_env_report_runs():
+    from deepspeed_tpu.env_report import report_text
+    text = report_text()
+    assert "deepspeed_tpu report" in text
+    assert "jax" in text
+    assert "[OKAY]" in text
