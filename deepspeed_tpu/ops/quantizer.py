@@ -1,0 +1,104 @@
+"""Quantization ops — per-group int8/int4 quant/dequant, stochastic rounding.
+
+Capability parity with the reference's quantization kernel family
+(csrc/quantization/*: ds_quantizer sym/asym fake-quant, stochastic-rounding
+variants, dequant; pt_binding.cpp:136-155). jnp implementations lower to
+tight XLA elementwise+reduce fusions on TPU; the same math backs the
+compressed-collective path (runtime/comm/compressed.py) and QAT
+(compression/).
+
+Layout: tensors are quantized per GROUP (a row of `x.reshape(groups, -1)`),
+matching the reference's group-wise API.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _grouped(x: jnp.ndarray, groups: int) -> jnp.ndarray:
+    n = x.size
+    if n % groups != 0:
+        raise ValueError(f"size {n} not divisible by groups {groups}")
+    return x.reshape(groups, n // groups)
+
+
+def quantize_symmetric(x: jnp.ndarray, bits: int = 8, groups: int = 1,
+                       stochastic: bool = False,
+                       rng: Optional[jax.Array] = None
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x -> (q int8, scale f32[groups]); q in [-qmax, qmax], x ~= q * scale."""
+    shape = x.shape
+    g = _grouped(x.astype(jnp.float32), groups)
+    qmax = float(2 ** (bits - 1) - 1)
+    absmax = jnp.max(jnp.abs(g), axis=1, keepdims=True)
+    scale = jnp.where(absmax == 0, 1.0, absmax / qmax)
+    y = g / scale
+    if stochastic and rng is not None:
+        noise = jax.random.uniform(rng, y.shape) - 0.5
+        q = jnp.round(y + noise)
+    else:
+        q = jnp.round(y)
+    q = jnp.clip(q, -qmax, qmax).astype(jnp.int8)
+    return q.reshape(shape), scale[:, 0]
+
+
+def dequantize_symmetric(q: jnp.ndarray, scale: jnp.ndarray,
+                         groups: int = 1) -> jnp.ndarray:
+    shape = q.shape
+    g = _grouped(q.astype(jnp.float32), groups)
+    return (g * scale[:, None]).reshape(shape)
+
+
+def quantize_asymmetric(x: jnp.ndarray, bits: int = 8, groups: int = 1
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """x -> (q uint-range stored int32, scale, zero_point) per group."""
+    shape = x.shape
+    g = _grouped(x.astype(jnp.float32), groups)
+    qmax = float(2 ** bits - 1)
+    lo = jnp.min(g, axis=1, keepdims=True)
+    hi = jnp.max(g, axis=1, keepdims=True)
+    scale = jnp.where(hi == lo, 1.0, (hi - lo) / qmax)
+    zp = lo
+    q = jnp.clip(jnp.round((g - zp) / scale), 0, qmax).astype(jnp.int32)
+    return q.reshape(shape), scale[:, 0], zp[:, 0]
+
+
+def dequantize_asymmetric(q: jnp.ndarray, scale: jnp.ndarray,
+                          zero_point: jnp.ndarray, groups: int = 1
+                          ) -> jnp.ndarray:
+    shape = q.shape
+    g = _grouped(q.astype(jnp.float32), groups)
+    return (g * scale[:, None] + zero_point[:, None]).reshape(shape)
+
+
+def fake_quantize(x: jnp.ndarray, bits: int = 8, groups: int = 1,
+                  symmetric: bool = True) -> jnp.ndarray:
+    """Quant-dequant round trip (QAT forward; straight-through gradient)."""
+
+    @jax.custom_vjp
+    def _fq(x):
+        if symmetric:
+            q, s = quantize_symmetric(x, bits, groups)
+            return dequantize_symmetric(q, s, groups).astype(x.dtype)
+        q, s, zp = quantize_asymmetric(x, bits, groups)
+        return dequantize_asymmetric(q, s, zp, groups).astype(x.dtype)
+
+    _fq.defvjp(lambda x: (_fq(x), None), lambda _, g: (g,))
+    return _fq(x)
+
+
+def onebit_compress(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """1-bit sign compression: x -> (signs int8 {-1,+1}, scale = mean|x|).
+    (reference: compressed_allreduce sign+scale packing, runtime/comm/nccl.py:52)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.mean(jnp.abs(xf))
+    signs = jnp.where(xf >= 0, 1, -1).astype(jnp.int8)
+    return signs, scale
+
+
+def onebit_decompress(signs: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    return signs.astype(jnp.float32) * scale

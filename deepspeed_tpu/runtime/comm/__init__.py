@@ -1,0 +1,5 @@
+"""Specialized runtime communication: compressed (1-bit/int8) collectives."""
+
+from .compressed import compressed_allreduce, quantized_allreduce
+
+__all__ = ["compressed_allreduce", "quantized_allreduce"]
