@@ -5,6 +5,11 @@ Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 Baseline: the reference's headline sustained training throughput of
 50 TFLOPS/GPU (ZeRO-3 Offload on V100, docs/_posts/2021-03-08-zero3-offload.md:65;
 see BASELINE.md). vs_baseline = our model TFLOPs/chip / 50.
+
+Tuned config (measured on v5e, round 2): micro-batch 16 x gas 4 in one compiled
+step, selective "dots" remat (save matmul outputs, recompute elementwise),
+fused chunked CE loss (no [B,S,V] fp32 logits materialization), Pallas flash
+attention with 256-block forward / 512-block backward.
 """
 
 import json
@@ -18,45 +23,54 @@ BASELINE_TFLOPS_PER_CHIP = 50.0
 def main():
     import jax
     import deepspeed_tpu as ds
-    from deepspeed_tpu.models import build_model, causal_lm_loss
+    from deepspeed_tpu.models import build_model, fused_loss_passthrough
 
     on_tpu = jax.default_backend() == "tpu"
     n_chips = len(jax.devices())
 
     if on_tpu:
-        preset, batch_size, seq, steps = "gpt2-350m", 8, 1024, 10
+        preset, micro, gas, seq, steps = "gpt2-350m", 16, 8, 1024, 5
     else:  # smoke path for CPU-only environments
-        preset, batch_size, seq, steps = "gpt2-tiny", 8, 128, 3
+        preset, micro, gas, seq, steps = "gpt2-tiny", 8, 1, 128, 3
 
-    model, cfg = build_model(preset, max_seq_len=seq, remat=on_tpu)
+    model, cfg = build_model(preset, max_seq_len=seq, remat=on_tpu,
+                             remat_policy="dots", fused_loss=True)
+    batch_size = micro * gas * max(n_chips, 1)
     config = {
-        "train_batch_size": batch_size * max(n_chips, 1),
-        "train_micro_batch_size_per_gpu": batch_size,
+        "train_batch_size": batch_size,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 1},
+        "steps_per_print": 10,
     }
     rng = np.random.default_rng(0)
 
     def make_batch():
         return {"input_ids": rng.integers(
-            0, cfg.vocab_size, size=(batch_size * max(n_chips, 1), seq))}
+            0, cfg.vocab_size, size=(batch_size, seq))}
 
     engine, *_ = ds.initialize(model=model, config=config,
-                               loss_fn=causal_lm_loss,
+                               loss_fn=fused_loss_passthrough,
                                example_batch=make_batch())
-    engine.train_batch(make_batch())  # compile + warmup
-    jax.block_until_ready(engine.state.params)
+    # two warmup steps (compile + steady state); float() forces real completion
+    # (block_until_ready alone does not synchronize through remote relays)
+    float(engine.train_batch(make_batch())["loss"])
+    float(engine.train_batch(make_batch())["loss"])
 
     t0 = time.perf_counter()
     for _ in range(steps):
         m = engine.train_batch(make_batch())
-    jax.block_until_ready(engine.state.params)
+    loss = float(m["loss"])
+    # the loss only depends on params through step N-1; read back a param
+    # element so the final optimizer update is included in the timed region
+    float(jax.tree.leaves(engine.state.params)[0].ravel()[0])
     dt = (time.perf_counter() - t0) / steps
 
     # 6 * N * T model flops per token-step (fwd 2NT + bwd 4NT)
     n_params = cfg.num_params()
-    tokens = batch_size * max(n_chips, 1) * seq
+    tokens = batch_size * seq
     flops = 6.0 * n_params * tokens
     tflops_per_chip = flops / dt / max(n_chips, 1) / 1e12
 
@@ -65,9 +79,10 @@ def main():
         "value": round(tflops_per_chip, 3),
         "unit": "TFLOPs/chip",
         "vs_baseline": round(tflops_per_chip / BASELINE_TFLOPS_PER_CHIP, 4),
-        "detail": {"preset": preset, "batch": batch_size, "seq": seq,
+        "detail": {"preset": preset, "micro": micro, "gas": gas,
+                   "batch": batch_size, "seq": seq,
                    "chips": n_chips, "step_time_s": round(dt, 4),
-                   "loss": round(float(m["loss"]), 4), "backend": jax.default_backend()},
+                   "loss": round(loss, 4), "backend": jax.default_backend()},
     }))
 
 

@@ -10,9 +10,11 @@ from .transformer import (
     masked_lm_loss,
     make_moe_loss,
     cross_entropy,
+    fused_loss_passthrough,
 )
 
 __all__ = [
     "Transformer", "TransformerConfig", "Block", "build_model", "get_config",
     "causal_lm_loss", "masked_lm_loss", "make_moe_loss", "cross_entropy",
+    "fused_loss_passthrough",
 ]

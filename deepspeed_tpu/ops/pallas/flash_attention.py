@@ -260,13 +260,16 @@ def _bwd(q3, k3, v3, o3, do3, lse, causal, sm_scale, block_q, block_k,
 # public entry with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, block_q_bwd,
+           block_k_bwd, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                        block_q_bwd, block_k_bwd, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, block_q_bwd,
+               block_k_bwd, interpret):
     B, H, S, D = q.shape
     Sk = k.shape[2]
     q3 = q.reshape(B * H, S, D)
@@ -276,11 +279,12 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     return o3.reshape(B, H, S, D), (q3, k3, v3, o3, lse, (B, H, S, D))
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, sm_scale, block_q, block_k, block_q_bwd, block_k_bwd,
+               interpret, res, g):
     q3, k3, v3, o3, lse, (B, H, S, D) = res
     do3 = g.reshape(B * H, S, D)
     dq, dk, dv = _bwd(q3, k3, v3, o3, do3, lse, causal, sm_scale,
-                      block_q, block_k, interpret)
+                      block_q_bwd, block_k_bwd, interpret)
     Sk = k3.shape[1]
     return (dq.reshape(B, H, S, D), dk.reshape(B, H, Sk, D),
             dv.reshape(B, H, Sk, D))
@@ -295,10 +299,16 @@ def flash_attention(q: jnp.ndarray,
                     *,
                     causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 128,
-                    block_k: int = 128,
+                    block_q: int = 256,
+                    block_k: int = 256,
+                    block_q_bwd: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None,
                     interpret: bool = False) -> jnp.ndarray:
     """Flash attention. q,k,v: [batch, heads, seq, head_dim] -> same shape.
+
+    Forward and backward take independent block sizes: measured on v5e the
+    online-softmax forward peaks at 256x256 while the recompute-heavy backward
+    kernels want 512x512 (fewer grid steps, better MXU occupancy per step).
 
     Falls back to the jnp reference when shapes don't tile (short sequences):
     kernels want seq % block == 0 and head_dim lane-friendly.
@@ -309,11 +319,22 @@ def flash_attention(q: jnp.ndarray,
         sm_scale = 1.0 / float(np.sqrt(D))
     block_q = min(block_q, S)
     block_k = min(block_k, Sk)
+    # bwd defaults to 512 blocks but must not push a sequence that tiles at
+    # the fwd sizes onto the dense fallback — snap down to the fwd block
+    block_q_bwd = min(block_q_bwd or max(block_q, 512), S)
+    block_k_bwd = min(block_k_bwd or max(block_k, 512), Sk)
+    if S % block_q_bwd != 0:
+        block_q_bwd = block_q
+    if Sk % block_k_bwd != 0:
+        block_k_bwd = block_k
     # fall back unless blocks tile the sequences AND are TPU-tile aligned
     # (sublane multiple of 16 covers bf16; lane dim D padded by Mosaic)
-    aligned = (S % block_q == 0 and Sk % block_k == 0 and
-               block_q % 16 == 0 and block_k % 16 == 0 and D % 8 == 0)
+    aligned = all(s % b == 0 and b % 16 == 0
+                  for s, b in [(S, block_q), (Sk, block_k),
+                               (S, block_q_bwd), (Sk, block_k_bwd)]) \
+        and D % 8 == 0
     if not aligned:
         from ..attention import mha_reference
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
-    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k,
+                  block_q_bwd, block_k_bwd, interpret)

@@ -102,3 +102,58 @@ def test_tp_sharded_engine_matches_unsharded():
     m2 = eng_tp.train_batch(batch)
     np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_fused_loss_matches_unfused():
+    """fused_loss=True returns the same scalar + grads as logits->causal_lm_loss,
+    including ignore_index=-100 masking, at a chunk size that forces padding."""
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, size=(2, 64))
+    kw = dict(vocab_size=256, max_seq_len=64, dtype=jnp.float32,
+              attention_impl="reference")
+    m1, _ = build_model("gpt2-tiny", **kw)
+    m2, _ = build_model("gpt2-tiny", fused_loss=True, loss_chunk=24, **kw)
+    batch = {"input_ids": jnp.asarray(ids)}
+    params = m1.init(jax.random.PRNGKey(0), batch)["params"]
+
+    l1 = causal_lm_loss(m1.apply({"params": params}, batch), batch)
+    l2 = m2.apply({"params": params}, batch)
+    assert abs(float(l1 - l2)) < 1e-5
+
+    g1 = jax.grad(lambda p: causal_lm_loss(m1.apply({"params": p}, batch),
+                                           batch))(params)
+    g2 = jax.grad(lambda p: m2.apply({"params": p}, batch))(params)
+    errs = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), g1, g2)
+    assert max(jax.tree.leaves(errs)) < 1e-4
+
+    labels = ids.copy()
+    labels[:, 10:20] = -100
+    b2 = {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(labels)}
+    l1m = causal_lm_loss(m1.apply({"params": params}, b2), b2)
+    l2m = m2.apply({"params": params}, b2)
+    assert abs(float(l1m - l2m)) < 1e-5
+
+
+def test_remat_policies_agree():
+    """dots/full remat and no remat give identical losses AND gradients
+    (remat only changes what is saved for backward, so grads are where a
+    broken checkpoint policy would show up)."""
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 256, size=(2, 32))
+    batch = {"input_ids": jnp.asarray(ids)}
+    results = []
+    for remat, policy in [(False, "dots"), (True, "dots"), (True, "full")]:
+        m, _ = build_model("gpt2-tiny", vocab_size=256, max_seq_len=32,
+                           dtype=jnp.float32, attention_impl="reference",
+                           remat=remat, remat_policy=policy)
+        params = m.init(jax.random.PRNGKey(0), batch)["params"]
+        loss, grads = jax.value_and_grad(
+            lambda p: causal_lm_loss(m.apply({"params": p}, batch), batch)
+        )(params)
+        results.append((float(loss), grads))
+    base_loss, base_grads = results[0]
+    for loss, grads in results[1:]:
+        assert loss == pytest.approx(base_loss, abs=1e-6)
+        errs = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                            base_grads, grads)
+        assert max(jax.tree.leaves(errs)) < 1e-5
