@@ -84,10 +84,14 @@ def run_op_sweep(op: str, sizes_mb: List[float], dtype=jnp.bfloat16,
     fn = _collective_fn(op, mesh)
     itemsize = jnp.dtype(dtype).itemsize
     rows = []
+    # reduce_scatter consumes a per-rank FULL buffer (in_specs=P()), so place
+    # the input replicated; sharding it P('all') would fold an implicit
+    # all-gather into the timed region and corrupt the measurement
+    in_spec = P() if op == "reduce_scatter" else P("all")
     for mb in sizes_mb:
         numel = max(int(mb * 2 ** 20 / itemsize) // n * n, n)
         x = jax.device_put(jnp.ones((numel,), dtype),
-                           NamedSharding(mesh, P("all")))
+                           NamedSharding(mesh, in_spec))
         dt = _timed(fn, x, iters)
         size_bytes = numel * itemsize
         algbw = size_bytes / dt / 1e9

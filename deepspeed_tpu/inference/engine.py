@@ -86,7 +86,22 @@ class InferenceEngine:
                         jnp.asarray(input_ids), max_new_tokens,
                         temperature, rng, top_k)
         if hasattr(self.module, "generate"):
-            return self.module.generate(self.params, input_ids, **kwargs)
+            # forward the engine-level settings, but only those the module's
+            # own generate signature accepts (or **kwargs swallows)
+            import inspect
+            named = {"max_new_tokens": max_new_tokens, "temperature": temperature,
+                     "top_k": top_k, "rng": rng}
+            try:
+                sig = inspect.signature(self.module.generate)
+                has_var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                                 for p in sig.parameters.values())
+                if not has_var_kw:
+                    named = {k: v for k, v in named.items()
+                             if k in sig.parameters}
+            except (TypeError, ValueError):
+                pass
+            return self.module.generate(self.params, input_ids,
+                                        **named, **kwargs)
         raise NotImplementedError(
             "generate() requires a deepspeed_tpu.models.Transformer or a "
             "model exposing its own generate method")

@@ -51,14 +51,38 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
             "pos": jnp.zeros((), jnp.int32)}
 
 
+def ensure_scan_layout(params: PyTree, num_layers: int) -> PyTree:
+    """Restack a scan_layers=False param tree (blocks_0..blocks_{L-1}) into the
+    scanned layout (blocks leaves [L, ...]) that the decode path consumes."""
+    if "blocks" in params:
+        return params
+    names = [f"blocks_{i}" for i in range(num_layers)]
+    missing = [n for n in names if n not in params]
+    if missing:
+        raise ValueError(
+            f"params have neither 'blocks' (scan layout) nor all of "
+            f"blocks_0..blocks_{num_layers - 1} (missing {missing[:3]}...); "
+            "cannot build the KV-cache decode layout")
+    stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves),
+                           *[params[n] for n in names])
+    rest = {k: v for k, v in params.items() if k not in names}
+    return {**rest, "blocks": stacked}
+
+
 def forward_with_cache(cfg: TransformerConfig, params: PyTree,
                        input_ids: jnp.ndarray, cache: Dict
                        ) -> Tuple[jnp.ndarray, Dict]:
     """Run T_new tokens at positions [cache.pos, cache.pos+T_new) against the
     cache. Returns (logits [B, T_new, V], updated cache). Params must be the
-    scan-layers layout (blocks leaves [L, ...])."""
+    scan-layers layout (blocks leaves [L, ...]) — use ensure_scan_layout to
+    restack a per-layer tree."""
     if cfg.moe_experts > 0:
         raise NotImplementedError("KV-cache decode for MoE models lands later")
+    if "blocks" not in params:
+        raise ValueError(
+            "forward_with_cache needs scan-layers params (a 'blocks' subtree "
+            "stacked [L, ...]); this model was built with scan_layers=False — "
+            "restack with models.generation.ensure_scan_layout(params, L)")
     B, T_new = input_ids.shape
     pos = cache["pos"]
     max_len = cache["k"].shape[3]
@@ -136,6 +160,7 @@ def generate(cfg: TransformerConfig,
         raise ValueError(f"generation length {max_len} exceeds max_seq_len "
                          f"{cfg.max_seq_len}")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    params = ensure_scan_layout(params, cfg.num_layers)
     cache = init_cache(cfg, B, max_len)
     logits, cache = forward_with_cache(cfg, params, input_ids, cache)
     rng, r0 = jax.random.split(rng)

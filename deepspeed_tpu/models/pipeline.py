@@ -71,6 +71,11 @@ class PipelinedTransformer:
         if mesh is None:
             from ..parallel.mesh import get_global_mesh
             mesh = get_global_mesh().mesh
+        if isinstance(batch, dict) and batch.get("attention_mask") is not None:
+            raise NotImplementedError(
+                "PipelinedTransformer does not thread attention_mask through "
+                "the pipe loop yet; pad-free batches only (use pp=1 for "
+                "masked batches)")
         input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
         B, S = input_ids.shape
         if B % self.n_micro != 0:

@@ -85,17 +85,20 @@ class MoE(nn.Module):
         gate_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
                                param_dtype=jnp.float32, name="gate")(
                                    tokens.astype(jnp.float32))
+        # top-2 always wants an rng for the Gumbel-max second pick (reference
+        # top2gating adds gumbel noise unconditionally in training); fall back
+        # to noise-free gating when the caller supplied no "gating" rng stream
         rng = (self.make_rng("gating")
-               if train and (self.noisy_gate_policy == "RSample") else None)
+               if train and (self.noisy_gate_policy == "RSample" or self.k == 2)
+               and self.has_rng("gating")
+               else None)
         cf = self.capacity_factor if train else self.eval_capacity_factor
         C = compute_capacity(T, E, cf, self.k, self.min_capacity)
         gating = top1_gating if self.k == 1 else top2_gating
         if self.k not in (1, 2):
             raise ValueError(f"k must be 1 or 2, got {self.k}")
-        kwargs = ({"noisy_gate_policy": self.noisy_gate_policy}
-                  if self.k == 2 else {})
         aux, combine, dispatch, _ = gating(gate_logits, cf, self.min_capacity,
-                                           rng=rng, capacity=C, **kwargs)
+                                           rng=rng, capacity=C)
 
         # dispatch: [T,E,C] x [T,H] -> [E,C,H], then pin the queue to the
         # expert axis so XLA exchanges tokens instead of replicating experts

@@ -79,26 +79,28 @@ def top1_gating(logits: jnp.ndarray,
 def top2_gating(logits: jnp.ndarray,
                 capacity_factor: float = 1.0,
                 min_capacity: int = 4,
-                noisy_gate_policy: Optional[str] = None,
                 rng: Optional[jax.Array] = None,
                 capacity: Optional[int] = None
                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """GShard top-2: second expert chosen from the top-1-masked logits; both
+    """GShard top-2: first expert from clean gates, second via Gumbel-max over
+    the top-1-masked noisy logits (pass rng=None for noise-free eval); both
     gate values renormalized. (reference: sharded_moe.py:278 top2gating.)"""
     T, E = logits.shape
     logits = logits.astype(jnp.float32)
-    if noisy_gate_policy == "RSample" and rng is not None:
-        noise = jax.random.normal(rng, logits.shape) / E
-        logits_for_pick = logits + noise
-    else:
-        logits_for_pick = logits
     gates = jax.nn.softmax(logits, axis=-1)
     C = capacity if capacity is not None else compute_capacity(
         T, E, capacity_factor, 2, min_capacity)
 
-    idx1 = jnp.argmax(logits_for_pick, axis=-1)
+    # first expert from clean gates; second via Gumbel-max over the masked
+    # logits (reference top2gating adds gumbel_rsample noise only for the
+    # second pick — sharded_moe.py:278)
+    idx1 = jnp.argmax(gates, axis=-1)
     mask1 = _one_hot(idx1, E)
-    masked = jnp.where(mask1 > 0, -jnp.inf, logits_for_pick)
+    if rng is not None:
+        logits_w_noise = logits + jax.random.gumbel(rng, logits.shape)
+    else:
+        logits_w_noise = logits
+    masked = jnp.where(mask1 > 0, -jnp.inf, logits_w_noise)
     idx2 = jnp.argmax(masked, axis=-1)
     mask2 = _one_hot(idx2, E)
 

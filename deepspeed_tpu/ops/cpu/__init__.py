@@ -1,0 +1,12 @@
+"""Host-side (CPU) native ops — the offload tier's compute kernels.
+
+reference: csrc/adam/cpu_adam.cpp + csrc/adagrad/cpu_adagrad.cpp (SIMD host
+optimizers) and csrc/aio/ (async NVMe IO), built lazily like op_builder/.
+"""
+
+from .adam import DeepSpeedCPUAdam, DeepSpeedCPUAdagrad
+from .aio import AsyncIOHandle
+from .build import load_aio, load_cpu_kernels
+
+__all__ = ["DeepSpeedCPUAdam", "DeepSpeedCPUAdagrad", "AsyncIOHandle",
+           "load_cpu_kernels", "load_aio"]

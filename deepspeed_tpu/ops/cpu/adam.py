@@ -1,0 +1,142 @@
+"""DeepSpeedCPUAdam — host-memory Adam/AdamW over flat numpy partitions.
+
+Role of the reference's ``deepspeed/ops/adam/cpu_adam.py`` (DeepSpeedCPUAdam:
+torch optimizer driving csrc/adam/cpu_adam.cpp Step_AVX, with an optional
+fp16 device-param write-out). Here the state is plain numpy (the offloaded
+fp32 master partition lives in host RAM), the step calls the C kernel in
+ops/csrc/cpu_adam.cpp through ctypes, and the optional ``bf16_out`` buffer
+receives the updated params as bfloat16 for the H2D copy — fused into the
+same SIMD pass exactly like the reference's dev_param path.
+
+A pure-numpy fallback keeps the API alive when no C++ toolchain exists.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .build import load_cpu_kernels
+
+
+def _as_flat_f32(a: np.ndarray) -> np.ndarray:
+    assert a.dtype == np.float32 and a.flags.c_contiguous
+    return a.reshape(-1)
+
+
+class DeepSpeedCPUAdam:
+    """Adam/AdamW stepping host-resident fp32 buffers in place."""
+
+    def __init__(self, lr: float = 1e-3, betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 bias_correction: bool = True, adamw_mode: bool = True):
+        self.lr = float(lr)
+        self.betas = (float(betas[0]), float(betas[1]))
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+        self.bias_correction = bool(bias_correction)
+        self.adamw_mode = bool(adamw_mode)
+        self._lib = load_cpu_kernels()
+
+    @property
+    def has_native(self) -> bool:
+        return self._lib is not None
+
+    def init_state(self, param: np.ndarray) -> Dict[str, np.ndarray]:
+        return {"exp_avg": np.zeros_like(param, dtype=np.float32),
+                "exp_avg_sq": np.zeros_like(param, dtype=np.float32)}
+
+    def step(self, step: int, param: np.ndarray, grad: np.ndarray,
+             state: Dict[str, np.ndarray], lr: Optional[float] = None,
+             grad_scale: float = 1.0,
+             bf16_out: Optional[np.ndarray] = None) -> None:
+        """One Adam step, in place. ``step`` is 1-based. ``grad_scale`` divides
+        the grads (loss-scale unscaling fused into the kernel pass)."""
+        lr = self.lr if lr is None else float(lr)
+        p = _as_flat_f32(param)
+        g = np.ascontiguousarray(grad, dtype=np.float32).reshape(-1)
+        m = _as_flat_f32(state["exp_avg"])
+        v = _as_flat_f32(state["exp_avg_sq"])
+        n = p.size
+        out = None
+        if bf16_out is not None:
+            out = bf16_out.view(np.uint16).reshape(-1)
+            assert out.size == n
+        if self._lib is not None:
+            import ctypes
+            self._lib.ds_cpu_adam_step(
+                step, lr, self.betas[0], self.betas[1], self.eps,
+                self.weight_decay, int(self.adamw_mode),
+                int(self.bias_correction), float(grad_scale),
+                p.ctypes.data_as(ctypes.c_void_p),
+                g.ctypes.data_as(ctypes.c_void_p),
+                m.ctypes.data_as(ctypes.c_void_p),
+                v.ctypes.data_as(ctypes.c_void_p),
+                n,
+                out.ctypes.data_as(ctypes.c_void_p) if out is not None else None)
+            return
+        # numpy fallback — same numerics, no SIMD control
+        b1, b2 = self.betas
+        if grad_scale != 1.0 and grad_scale != 0.0:
+            g = g / grad_scale
+        if self.weight_decay and not self.adamw_mode:
+            g = g + self.weight_decay * p
+        np.multiply(m, b1, out=m)
+        m += (1.0 - b1) * g
+        np.multiply(v, b2, out=v)
+        v += (1.0 - b2) * g * g
+        bc1 = 1.0 - b1 ** step if self.bias_correction else 1.0
+        bc2 = 1.0 - b2 ** step if self.bias_correction else 1.0
+        upd = (m / bc1) / (np.sqrt(v) / np.sqrt(bc2) + self.eps)
+        if self.weight_decay and self.adamw_mode:
+            upd += self.weight_decay * p
+        p -= lr * upd
+        if out is not None:
+            _f32_to_bf16_np(p, out)
+
+
+class DeepSpeedCPUAdagrad:
+    """reference: deepspeed/ops/adagrad/cpu_adagrad.py over csrc/adagrad."""
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0):
+        self.lr, self.eps, self.weight_decay = float(lr), float(eps), float(weight_decay)
+        self._lib = load_cpu_kernels()
+
+    def init_state(self, param: np.ndarray) -> Dict[str, np.ndarray]:
+        return {"sum": np.zeros_like(param, dtype=np.float32)}
+
+    def step(self, step: int, param: np.ndarray, grad: np.ndarray,
+             state: Dict[str, np.ndarray], lr: Optional[float] = None,
+             grad_scale: float = 1.0,
+             bf16_out: Optional[np.ndarray] = None) -> None:
+        lr = self.lr if lr is None else float(lr)
+        p = _as_flat_f32(param)
+        g = np.ascontiguousarray(grad, dtype=np.float32).reshape(-1)
+        s = _as_flat_f32(state["sum"])
+        out = bf16_out.view(np.uint16).reshape(-1) if bf16_out is not None else None
+        if self._lib is not None:
+            import ctypes
+            self._lib.ds_cpu_adagrad_step(
+                lr, self.eps, self.weight_decay, float(grad_scale),
+                p.ctypes.data_as(ctypes.c_void_p),
+                g.ctypes.data_as(ctypes.c_void_p),
+                s.ctypes.data_as(ctypes.c_void_p), p.size,
+                out.ctypes.data_as(ctypes.c_void_p) if out is not None else None)
+            return
+        if grad_scale != 1.0 and grad_scale != 0.0:
+            g = g / grad_scale
+        if self.weight_decay:
+            g = g + self.weight_decay * p
+        s += g * g
+        p -= lr * g / (np.sqrt(s) + self.eps)
+        if out is not None:
+            _f32_to_bf16_np(p, out)
+
+
+def _f32_to_bf16_np(src_f32: np.ndarray, dst_u16: np.ndarray) -> None:
+    """round-to-nearest-even fp32 -> bf16 bit pattern (numpy fallback)."""
+    bits = src_f32.view(np.uint32)
+    rounding = np.uint32(0x7FFF) + ((bits >> np.uint32(16)) & np.uint32(1))
+    np.copyto(dst_u16, ((bits + rounding) >> np.uint32(16)).astype(np.uint16))

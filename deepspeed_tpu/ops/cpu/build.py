@@ -1,0 +1,107 @@
+"""Native-kernel build + ctypes load for the host-side (offload) ops.
+
+Role of the reference's op_builder/ (CPUAdamBuilder, AsyncIOBuilder: JIT
+compile on first use, cached .so). Differences: the toolchain is plain g++
+invoked directly (no torch cpp_extension), bindings are ctypes over a C ABI
+(no pybind11 in this image), and -march=native lets the compiler emit the
+AVX2/AVX512 the reference hand-writes in csrc/includes/simd.h.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional
+
+from ...utils.logging import logger
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "csrc")
+_BUILD_DIR = os.environ.get(
+    "DSTPU_BUILD_DIR",
+    os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu", "build"))
+
+_lock = threading.Lock()
+_libs = {}
+
+
+def _compile(name: str, sources, extra_flags=()) -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    so_path = os.path.join(_BUILD_DIR, f"{name}.so")
+    srcs = [os.path.join(_CSRC, s) for s in sources]
+    if os.path.exists(so_path) and all(
+            os.path.getmtime(so_path) >= os.path.getmtime(s) for s in srcs):
+        return so_path
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+           "-std=c++17", *extra_flags, *srcs, "-o", so_path]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("native build of %s failed to launch (%s); using the "
+                       "numpy fallback path", name, e)
+        return None
+    if proc.returncode != 0:
+        # -march=native can be unsupported in emulated environments
+        cmd_portable = [c for c in cmd if c != "-march=native"]
+        proc = subprocess.run(cmd_portable, capture_output=True, text=True,
+                              timeout=300)
+        if proc.returncode != 0:
+            logger.warning("native build of %s failed:\n%s\nusing the numpy "
+                           "fallback path", name, proc.stderr[-2000:])
+            return None
+    return so_path
+
+
+def _load(name: str, sources) -> Optional[ctypes.CDLL]:
+    with _lock:
+        if name in _libs:
+            return _libs[name]
+        so = _compile(name, sources)
+        lib = ctypes.CDLL(so) if so else None
+        _libs[name] = lib
+        return lib
+
+
+def load_cpu_kernels() -> Optional[ctypes.CDLL]:
+    """cpu_adam/adagrad/sgd + bf16 convert (csrc/cpu_adam.cpp)."""
+    lib = _load("ds_cpu_kernels", ["cpu_adam.cpp"])
+    if lib is not None and not getattr(lib, "_ds_typed", False):
+        c = ctypes
+        lib.ds_cpu_adam_step.argtypes = [
+            c.c_int64, c.c_float, c.c_float, c.c_float, c.c_float, c.c_float,
+            c.c_int, c.c_int, c.c_float,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64, c.c_void_p]
+        lib.ds_cpu_adagrad_step.argtypes = [
+            c.c_float, c.c_float, c.c_float, c.c_float,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64, c.c_void_p]
+        lib.ds_cpu_sgd_step.argtypes = [
+            c.c_float, c.c_float, c.c_float, c.c_int, c.c_float,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64, c.c_void_p]
+        lib.ds_f32_to_bf16.argtypes = [c.c_void_p, c.c_void_p, c.c_int64]
+        lib.ds_cpu_kernels_num_threads.restype = c.c_int
+        lib._ds_typed = True
+    return lib
+
+
+def load_aio() -> Optional[ctypes.CDLL]:
+    """thread-pool positional IO (csrc/aio.cpp)."""
+    lib = _load("ds_aio", ["aio.cpp"])
+    if lib is not None and not getattr(lib, "_ds_typed", False):
+        c = ctypes
+        lib.ds_aio_handle_new.restype = c.c_void_p
+        lib.ds_aio_handle_new.argtypes = [c.c_int64, c.c_int, c.c_int]
+        lib.ds_aio_handle_free.argtypes = [c.c_void_p]
+        for f in (lib.ds_aio_submit_read, lib.ds_aio_submit_write):
+            f.restype = c.c_int64
+            f.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_char_p, c.c_int64]
+        lib.ds_aio_wait.restype = c.c_int64
+        lib.ds_aio_wait.argtypes = [c.c_void_p]
+        for f in (lib.ds_aio_pread, lib.ds_aio_pwrite):
+            f.restype = c.c_int
+            f.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_char_p, c.c_int64]
+        lib._ds_typed = True
+    return lib

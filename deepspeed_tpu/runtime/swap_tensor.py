@@ -1,0 +1,149 @@
+"""Tensor swapping between host RAM and NVMe — ZeRO-Infinity's storage tier.
+
+Role of the reference's ``deepspeed/runtime/swap_tensor/`` package
+(partitioned_optimizer_swapper.py:35 OptimizerSwapper,
+partitioned_param_swapper.py:35 AsyncPartitionedParameterSwapper,
+async_swapper.py AsyncTensorSwapper): optimizer state / parameter partitions
+live in files under the nvme_path and stream through reusable host buffers
+with async reads ahead of compute and async write-back behind it.
+
+TPU-native simplifications: partitions are numpy leaves of a pytree (not
+flat torch buffers), and the double-buffered pipeline below is the whole
+scheduling story — no swap-out-and-release hooks, because jax params are
+immutable and the engine swaps only between steps.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.cpu.aio import AsyncIOHandle
+
+
+class SwappedTensorPool:
+    """A set of same-dtype tensors persisted one-file-per-tensor under a
+    directory, accessed through a ring of reusable pinned-size buffers."""
+
+    def __init__(self, root: str, names: Sequence[str],
+                 shapes: Sequence[Tuple[int, ...]], dtype=np.float32,
+                 aio: Optional[AsyncIOHandle] = None, buffer_count: int = 4,
+                 initialize_zero: bool = True):
+        self.root = root
+        self.names = list(names)
+        self.shapes = [tuple(s) for s in shapes]
+        self.dtype = np.dtype(dtype)
+        self.aio = aio or AsyncIOHandle()
+        os.makedirs(root, exist_ok=True)
+        self._paths = [os.path.join(root, f"{n}.swp") for n in self.names]
+        max_elems = max((int(np.prod(s)) for s in self.shapes), default=1)
+        self._buffers = [np.zeros(max_elems, self.dtype)
+                         for _ in range(max(buffer_count, 2))]
+        self._buf_i = 0
+        if initialize_zero:
+            zero = np.zeros(max_elems, self.dtype)
+            for p, s in zip(self._paths, self.shapes):
+                n = int(np.prod(s))
+                self.aio.async_pwrite(zero[:n], p)
+            self.aio.wait()
+
+    def _next_buffer(self, nelems: int) -> np.ndarray:
+        buf = self._buffers[self._buf_i % len(self._buffers)]
+        self._buf_i += 1
+        return buf[:nelems]
+
+    def read_async(self, i: int) -> np.ndarray:
+        """Submit an async read of tensor i; the view is valid after wait()."""
+        n = int(np.prod(self.shapes[i]))
+        view = self._next_buffer(n)
+        self.aio.async_pread(view, self._paths[i])
+        return view
+
+    def write_async(self, i: int, data: np.ndarray) -> None:
+        self.aio.async_pwrite(np.ascontiguousarray(data.reshape(-1)),
+                              self._paths[i])
+
+    def wait(self) -> None:
+        self.aio.wait()
+
+    def read_sync(self, i: int) -> np.ndarray:
+        view = self.read_async(i)
+        self.wait()
+        return view.reshape(self.shapes[i]).copy()
+
+
+class OptimizerStateSwapper:
+    """NVMe-resident optimizer state, streamed leaf-by-leaf through a
+    double-buffered read -> compute -> write-back pipeline.
+
+    reference: partitioned_optimizer_swapper.py (swap_in_optimizer_state /
+    swap_out_optimizer_state around the partition's Adam step) +
+    pipelined_optimizer_swapper.py (overlap of reads/writes with compute).
+    """
+
+    def __init__(self, nvme_path: str, slot_names: Sequence[str],
+                 leaf_shapes: Sequence[Tuple[int, ...]],
+                 aio: Optional[AsyncIOHandle] = None, buffer_count: int = 4):
+        self.slot_names = list(slot_names)
+        self.n_leaves = len(leaf_shapes)
+        self.pools = {
+            slot: SwappedTensorPool(
+                os.path.join(nvme_path, slot),
+                [f"leaf{j}" for j in range(self.n_leaves)],
+                leaf_shapes, np.float32, aio=aio, buffer_count=buffer_count)
+            for slot in self.slot_names}
+
+    def pipeline(self, compute_fn) -> None:
+        """For each leaf j: state = read(j); compute_fn(j, state) mutates the
+        buffers in place; write-back(j). Reads of leaf j+1 and write-backs of
+        leaf j overlap compute of leaf j via the shared aio thread pool."""
+        if self.n_leaves == 0:
+            return
+        views = {s: self.pools[s].read_async(0) for s in self.slot_names}
+        for j in range(self.n_leaves):
+            for s in self.slot_names:
+                self.pools[s].wait()   # reads for j (and writes for j-1) done
+            cur = views
+            if j + 1 < self.n_leaves:
+                views = {s: self.pools[s].read_async(j + 1)
+                         for s in self.slot_names}
+            compute_fn(j, cur)
+            for s in self.slot_names:
+                self.pools[s].write_async(j, cur[s])
+        for s in self.slot_names:
+            self.pools[s].wait()
+
+    def read_leaf(self, j: int) -> Dict[str, np.ndarray]:
+        return {s: self.pools[s].read_sync(j) for s in self.slot_names}
+
+
+class PartitionedParamSwapper:
+    """fp32 parameter partitions on NVMe (offload_param device=nvme).
+
+    reference: partitioned_param_swapper.py:35 AsyncPartitionedParameterSwapper
+    — here a thin facade over SwappedTensorPool keyed by leaf index, consumed
+    by the engine's transient-param mode (params are materialized on device
+    only for the duration of a step).
+    """
+
+    def __init__(self, nvme_path: str, leaf_shapes: Sequence[Tuple[int, ...]],
+                 aio: Optional[AsyncIOHandle] = None, buffer_count: int = 5):
+        self.pool = SwappedTensorPool(
+            os.path.join(nvme_path, "params"),
+            [f"leaf{j}" for j in range(len(leaf_shapes))],
+            leaf_shapes, np.float32, aio=aio, buffer_count=buffer_count,
+            initialize_zero=False)
+        self.shapes = [tuple(s) for s in leaf_shapes]
+
+    def swap_out(self, leaves: Sequence[np.ndarray]) -> None:
+        for j, leaf in enumerate(leaves):
+            self.pool.write_async(j, np.asarray(leaf, np.float32))
+        self.pool.wait()
+
+    def swap_in(self) -> List[np.ndarray]:
+        out = []
+        for j in range(len(self.shapes)):
+            out.append(self.pool.read_sync(j).reshape(self.shapes[j]))
+        return out

@@ -69,19 +69,58 @@ def get_active_init() -> Optional[Init]:
     return _ACTIVE_INIT
 
 
-@contextlib.contextmanager
-def GatheredParameters(params, modifier_rank: Optional[int] = None, fwd_module=None,
-                       enabled: bool = True):
-    """Yield fully-gathered (replicated) copies of ``params``.
+class GatheredParameters(contextlib.AbstractContextManager):
+    """Temporary full (host) access to *selected* params, with write-back.
 
-    reference: partition_parameters.py:1519. Mutation-write-back is not needed
-    in the functional model — callers rebuild state from the yielded values.
+    reference: partition_parameters.py:1519 — gathers only the params you pass
+    (pass a subtree, not the whole model), and when ``modifier_rank`` is set,
+    mutations made inside the block are re-partitioned on exit.
+
+    jax arrays are immutable, so the contract is: the context yields mutable
+    host numpy copies (gathered leaf-by-leaf — peak host memory is one leaf
+    above the subtree size, never the whole model unless you pass it); mutate
+    them in place, and after exit read ``.updated`` for device arrays restored
+    to each leaf's ORIGINAL sharding::
+
+        g = GatheredParameters(params["wte"], modifier_rank=0)
+        with g as host:
+            host["embedding"][0] = 0.0
+        params = {**params, "wte": g.updated}
     """
-    if not enabled:
-        yield params
-        return
-    gathered = jax.tree.map(lambda p: jax.device_get(p), params)
-    yield gathered
+
+    def __init__(self, params, modifier_rank: Optional[int] = None,
+                 fwd_module=None, enabled: bool = True):
+        import numpy as np
+        self._np = np
+        self.params = params
+        self.modifier_rank = modifier_rank
+        self.enabled = enabled
+        self.updated = None
+
+    def __enter__(self):
+        if not self.enabled:
+            self._host = self.params
+            return self._host
+        self._shardings = jax.tree.map(
+            lambda p: getattr(p, "sharding", None), self.params)
+        # leaf-by-leaf gather: device buffers for a leaf are freed before the
+        # next leaf is pulled, so host peak ~= subtree size + one leaf
+        self._host = jax.tree.map(lambda p: self._np.array(p), self.params)
+        return self._host
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None or self.modifier_rank is None:
+            return False
+        if not self.enabled:
+            # disabled is a no-op: write-back target is the original tree, so
+            # the documented `params = {**params, k: g.updated}` pattern holds
+            self.updated = self.params
+            return False
+        self.updated = jax.tree.map(
+            lambda h, s: (jax.device_put(h, s) if s is not None
+                          else jax.numpy.asarray(h)),
+            self._host, self._shardings)
+        return False
 
 
 __all__ = ["Init", "GatheredParameters", "ZeroShardingPolicy", "get_active_init"]

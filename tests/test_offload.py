@@ -1,0 +1,228 @@
+"""ZeRO-Offload tests: C++ CPU Adam numerics, AIO round-trips, NVMe swapping,
+and end-to-end engine training with offload_optimizer cpu/nvme.
+
+Mirrors the reference's tests/unit/test_zero.py cpu_offload parametrizations +
+csrc/aio/py_test round-trip checks.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.ops.cpu import AsyncIOHandle, DeepSpeedCPUAdam
+from deepspeed_tpu.ops.optimizers import adamw as jax_adamw
+from deepspeed_tpu.runtime.swap_tensor import (OptimizerStateSwapper,
+                                               PartitionedParamSwapper)
+
+from util import SimpleModel, random_batch
+
+
+def test_cpu_adam_matches_jax_adamw():
+    """The offloaded C++ kernel must be step-for-step identical (to fp32
+    roundoff) with the in-jit AdamW the non-offload engine uses."""
+    rng = np.random.RandomState(0)
+    n = 4097  # odd size: exercises SIMD tail handling
+    p_cpu = rng.randn(n).astype(np.float32)
+    # explicit copy: on the CPU backend jnp.asarray can alias the numpy
+    # buffer, which the C++ kernel then mutates in place
+    p_jax = jnp.array(p_cpu, copy=True)
+    cpu_opt = DeepSpeedCPUAdam(lr=1e-2, betas=(0.9, 0.95), eps=1e-8,
+                               weight_decay=0.1, adamw_mode=True)
+    st_cpu = cpu_opt.init_state(p_cpu)
+    jx = jax_adamw(lr=1e-2, betas=(0.9, 0.95), eps=1e-8, weight_decay=0.1)
+    st_jax = jx.init({"w": p_jax})
+    params = {"w": p_jax}
+    for step in range(5):
+        g = rng.randn(n).astype(np.float32)
+        cpu_opt.step(step + 1, p_cpu, g, st_cpu)
+        params, st_jax = jx.update({"w": jnp.asarray(g)}, st_jax, params,
+                                   jnp.asarray(step, jnp.int32))
+    np.testing.assert_allclose(p_cpu, np.asarray(params["w"]), rtol=2e-5,
+                               atol=2e-6)
+
+
+def test_cpu_adam_grad_scale_fused():
+    """grad_scale divides grads inside the kernel (loss-scale unscaling)."""
+    rng = np.random.RandomState(1)
+    p1 = rng.randn(512).astype(np.float32)
+    p2 = p1.copy()
+    g = rng.randn(512).astype(np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-3)
+    s1, s2 = opt.init_state(p1), opt.init_state(p2)
+    opt.step(1, p1, g * 8.0, s1, grad_scale=8.0)
+    opt.step(1, p2, g, s2, grad_scale=1.0)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-7)
+
+
+def test_cpu_adam_bf16_out_matches_xla_cast():
+    rng = np.random.RandomState(2)
+    p = rng.randn(300).astype(np.float32)
+    g = rng.randn(300).astype(np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-2)
+    st = opt.init_state(p)
+    import ml_dtypes
+    bf = np.zeros(300, ml_dtypes.bfloat16)
+    opt.step(1, p, g, st, bf16_out=bf)
+    expected = jnp.asarray(p).astype(jnp.bfloat16)
+    assert bf.view(np.uint16).tolist() == \
+        np.asarray(expected).view(np.uint16).tolist()
+
+
+def test_aio_sync_roundtrip(tmp_path):
+    h = AsyncIOHandle(block_size=4096, thread_count=2)
+    data = np.arange(10000, dtype=np.float32)
+    path = str(tmp_path / "buf.swp")
+    h.sync_pwrite(data, path)
+    out = np.zeros_like(data)
+    h.sync_pread(out, path)
+    np.testing.assert_array_equal(data, out)
+
+
+def test_aio_async_roundtrip(tmp_path):
+    h = AsyncIOHandle(block_size=1024, thread_count=4)
+    bufs = [np.random.RandomState(i).randn(3000).astype(np.float32)
+            for i in range(6)]
+    paths = [str(tmp_path / f"t{i}.swp") for i in range(6)]
+    for b, p in zip(bufs, paths):
+        h.async_pwrite(b, p)
+    h.wait()
+    outs = [np.zeros(3000, np.float32) for _ in range(6)]
+    for o, p in zip(outs, paths):
+        h.async_pread(o, p)
+    h.wait()
+    for b, o in zip(bufs, outs):
+        np.testing.assert_array_equal(b, o)
+
+
+def test_optimizer_state_swapper_pipeline(tmp_path):
+    """The read->compute->write pipeline must deliver each leaf's own state
+    and persist mutations (reference: partitioned_optimizer_swapper)."""
+    shapes = [(64,), (32, 8), (100,)]
+    sw = OptimizerStateSwapper(str(tmp_path), ["m", "v"], shapes,
+                               buffer_count=4)
+    # round 1: write leaf index j+1 into every element of slot m of leaf j
+    def fill(j, views):
+        views["m"][:] = j + 1.0
+        views["v"][:] = (j + 1.0) * 10.0
+    sw.pipeline(fill)
+    # round 2: verify persisted values arrive back
+    seen = {}
+    def check(j, views):
+        seen[j] = (views["m"].copy(), views["v"].copy())
+    sw.pipeline(check)
+    for j, shape in enumerate(shapes):
+        n = int(np.prod(shape))
+        np.testing.assert_array_equal(seen[j][0], np.full(n, j + 1.0, np.float32))
+        np.testing.assert_array_equal(seen[j][1], np.full(n, (j + 1.0) * 10.0,
+                                                          np.float32))
+
+
+def test_partitioned_param_swapper_roundtrip(tmp_path):
+    shapes = [(16, 16), (50,)]
+    sw = PartitionedParamSwapper(str(tmp_path), shapes)
+    leaves = [np.random.RandomState(i).randn(*s).astype(np.float32)
+              for i, s in enumerate(shapes)]
+    sw.swap_out(leaves)
+    back = sw.swap_in()
+    for a, b in zip(leaves, back):
+        np.testing.assert_array_equal(a, b)
+
+
+def _make_engine(offload_device=None, tmp_path=None, dtype_section=None,
+                 seed=42):
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 1e-2, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "seed": seed,
+    }
+    if dtype_section:
+        config[dtype_section] = {"enabled": True}
+    if offload_device:
+        zo = {"stage": 1 if offload_device else 0,
+              "offload_optimizer": {"device": offload_device}}
+        if offload_device == "nvme":
+            zo["offload_optimizer"]["nvme_path"] = str(tmp_path / "nvme")
+        config["zero_optimization"] = zo
+    model = SimpleModel()
+    engine, *_ = ds.initialize(model=model, config=config,
+                               example_batch=random_batch(8))
+    return engine
+
+
+def test_engine_offload_cpu_trains():
+    engine = _make_engine("cpu")
+    assert engine.offload is not None
+    assert engine.state.opt_state == {}          # nothing on device
+    assert engine.state.master == ()
+    losses = [float(engine.train_batch(random_batch(8, seed=i))["loss"])
+              for i in range(12)]
+    assert losses[-1] < losses[0]
+
+
+def test_engine_offload_matches_device_path():
+    """Offloaded AdamW must track the on-device jitted AdamW step-for-step."""
+    e_dev = _make_engine(None)
+    e_off = _make_engine("cpu")
+    for i in range(5):
+        b = random_batch(8, seed=i)
+        l_dev = float(e_dev.train_batch(b)["loss"])
+        l_off = float(e_off.train_batch(b)["loss"])
+        assert abs(l_dev - l_off) < 2e-4, (i, l_dev, l_off)
+    p_dev = jax.tree.leaves(e_dev.state.params)
+    p_off = jax.tree.leaves(e_off.state.params)
+    for a, b in zip(p_dev, p_off):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-5)
+
+
+def test_engine_offload_bf16_trains():
+    engine = _make_engine("cpu", dtype_section="bf16")
+    losses = [float(engine.train_batch(random_batch(8, seed=i))["loss"])
+              for i in range(12)]
+    assert losses[-1] < losses[0]
+    for leaf in jax.tree.leaves(engine.state.params):
+        assert leaf.dtype == jnp.bfloat16
+
+
+def test_engine_offload_nvme_trains(tmp_path):
+    engine = _make_engine("nvme", tmp_path=tmp_path)
+    losses = [float(engine.train_batch(random_batch(8, seed=i))["loss"])
+              for i in range(8)]
+    assert losses[-1] < losses[0]
+    swap_root = tmp_path / "nvme" / "zero_offload_opt"
+    assert (swap_root / "exp_avg").is_dir()
+    assert any(f.suffix == ".swp" for f in (swap_root / "exp_avg").iterdir())
+
+
+def test_engine_offload_checkpoint_roundtrip(tmp_path):
+    engine = _make_engine("cpu")
+    for i in range(3):
+        engine.train_batch(random_batch(8, seed=i))
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    ref_losses = [float(engine.train_batch(random_batch(8, seed=10 + i))["loss"])
+                  for i in range(3)]
+    engine2 = _make_engine("cpu")
+    engine2.load_checkpoint(str(tmp_path / "ckpt"))
+    got_losses = [float(engine2.train_batch(random_batch(8, seed=10 + i))["loss"])
+                  for i in range(3)]
+    np.testing.assert_allclose(ref_losses, got_losses, rtol=1e-5)
+
+
+def test_gathered_parameters_writeback():
+    """Task: zero.GatheredParameters write-back (round-1 Weak #8)."""
+    from deepspeed_tpu.zero import GatheredParameters
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    g = GatheredParameters(params, modifier_rank=0)
+    with g as host:
+        host["w"][0, :] = 7.0
+    assert g.updated is not None
+    assert np.asarray(g.updated["w"])[0, 0] == 7.0
+    assert np.asarray(g.updated["w"])[1, 0] == 1.0
+    # original untouched (functional semantics)
+    assert float(params["w"][0, 0]) == 1.0
