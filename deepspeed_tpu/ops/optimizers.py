@@ -249,6 +249,125 @@ def onebit_adam(lr: float = 1e-3,
     return Optimizer(init, update, "onebitadam")
 
 
+def lamb_warm_leaf(p32, m_new, v_new, cf, *, eps, weight_decay, min_coeff,
+                   max_coeff, coeff_beta):
+    """Per-leaf LAMB warmup step math, shared by the dp=1 functional
+    onebit_lamb and the explicit-collective OneBitRunner (runtime/onebit.py).
+    Returns (update, trust coeff, new coeff_freeze EMA)."""
+    upd = m_new / (jnp.sqrt(v_new) + eps) + weight_decay * p32
+    w_norm = jnp.linalg.norm(p32)
+    u_norm = jnp.linalg.norm(upd)
+    coeff = jnp.where((w_norm > 0) & (u_norm > 0),
+                      jnp.clip(w_norm / u_norm, min_coeff, max_coeff), 1.0)
+    cf_new = jnp.where(coeff != 1.0,
+                       coeff_beta * cf + (1 - coeff_beta) * coeff, cf)
+    return upd, coeff, cf_new
+
+
+def lamb_frozen_leaf(p32, m_old, m_comp, v, vf, lf, *, b1, b2, eps,
+                     weight_decay, factor_min, factor_max, factor_threshold):
+    """Per-leaf 1-bit LAMB compression-stage math (reference:
+    onebit/lamb.py:337-386): frozen-variance update scaled by the
+    clipped/rate-limited denominator factor. Returns (update, factor,
+    new v_fresh); the param step is p - lr * coeff_freeze * factor * update."""
+    denom = jnp.sqrt(v) + eps
+    upd_prelim = m_comp / denom
+    upd = upd_prelim + weight_decay * p32
+    g_recon = (m_comp - b1 * m_old) / (1.0 - b1)
+    vf_new = b2 * vf + (1.0 - b2) * g_recon * g_recon
+    denom_real = jnp.sqrt(vf_new) + eps
+    factor = jnp.max(denom / denom_real)
+    if weight_decay > 0.0:
+        ratio = jnp.minimum(1.0, jnp.linalg.norm(upd_prelim) /
+                            (jnp.linalg.norm(upd) + 1e-30))
+        factor = factor * ratio + (1.0 - ratio)
+    factor = jnp.clip(factor, factor_min, factor_max)
+    factor = jnp.clip(factor, lf * (1.0 - factor_threshold),
+                      lf * (1.0 + factor_threshold))
+    return upd, factor, vf_new
+
+
+def onebit_lamb(lr: float = 1e-3,
+                betas: Tuple[float, float] = (0.9, 0.999),
+                eps: float = 1e-8,
+                weight_decay: float = 0.0,
+                freeze_step: int = 100,
+                max_coeff: float = 10.0,
+                min_coeff: float = 0.01,
+                coeff_beta: float = 0.9,
+                factor_max: float = 4.0,
+                factor_min: float = 0.5,
+                factor_threshold: float = 0.1) -> Optimizer:
+    """1-bit LAMB (reference: runtime/fp16/onebit/lamb.py).
+
+    Warmup: exact LAMB, tracking an EMA of each leaf's trust ratio
+    (lamb_coeff_freeze). Compression: momentum is sign-compressed with error
+    feedback, v freezes, and the trust ratio becomes coeff_freeze * factor
+    where factor = max(frozen_denom / fresh_denom) estimated from the
+    reconstructed grads, clipped and rate-limited (lamb.py:337-386). The
+    cross-rank 1-bit exchange itself lives in runtime/onebit.OneBitRunner;
+    this functional form reproduces the numerics for dp=1 / tests.
+    """
+    b1, b2 = betas
+    from .quantizer import onebit_compress, onebit_decompress
+
+    def init(params):
+        return {"m": _tree_zeros_like(params),
+                "v": _tree_zeros_like(params),
+                "v_fresh": _tree_zeros_like(params),
+                "comp_err": _tree_zeros_like(params),
+                "coeff_freeze": jax.tree.map(
+                    lambda p: jnp.zeros((), jnp.float32), params),
+                "last_factor": jax.tree.map(
+                    lambda p: jnp.ones((), jnp.float32), params)}
+
+    def update(grads, state, params, step, lr_t=None):
+        lr_eff = lr if lr_t is None else lr_t
+        t = step.astype(jnp.float32) + 1.0
+        warm = t <= float(freeze_step)
+
+        def leaf(g, m, v, vf, err, cf, lf, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m_new = b1 * m + (1.0 - b1) * g
+            # -- warmup branch values
+            v_w = b2 * v + (1.0 - b2) * g * g
+            upd_w, coeff_w, cf_w = lamb_warm_leaf(
+                p32, m_new, v_w, cf, eps=eps, weight_decay=weight_decay,
+                min_coeff=min_coeff, max_coeff=max_coeff,
+                coeff_beta=coeff_beta)
+            p_w = p32 - lr_eff * coeff_w * upd_w
+            # -- compression branch values
+            signs, scale = onebit_compress(m_new + err)
+            m_comp = onebit_decompress(signs, scale)
+            err_f = (m_new + err) - m_comp
+            upd_f, factor, vf_f = lamb_frozen_leaf(
+                p32, m, m_comp, v, vf, lf, b1=b1, b2=b2, eps=eps,
+                weight_decay=weight_decay, factor_min=factor_min,
+                factor_max=factor_max, factor_threshold=factor_threshold)
+            p_f = p32 - lr_eff * (cf * factor) * upd_f
+            # -- select
+            sel = lambda a, b: jnp.where(warm, a, b)
+            return (sel(p_w, p_f), sel(m_new, m_comp), sel(v_w, v),
+                    sel(v_w, vf_f), sel(err, err_f), sel(cf_w, cf),
+                    sel(lf, factor))
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat = [leaf(*args) for args in zip(
+            treedef.flatten_up_to(grads), treedef.flatten_up_to(state["m"]),
+            treedef.flatten_up_to(state["v"]),
+            treedef.flatten_up_to(state["v_fresh"]),
+            treedef.flatten_up_to(state["comp_err"]),
+            treedef.flatten_up_to(state["coeff_freeze"]),
+            treedef.flatten_up_to(state["last_factor"]), flat_p)]
+        unf = lambda i: treedef.unflatten([o[i] for o in flat])
+        return unf(0), {"m": unf(1), "v": unf(2), "v_fresh": unf(3),
+                        "comp_err": unf(4), "coeff_freeze": unf(5),
+                        "last_factor": unf(6)}
+
+    return Optimizer(init, update, "onebitlamb")
+
+
 # Registry keyed by the optimizer `type` names the reference engine accepts
 # (engine.py:1042-1054 / _configure_basic_optimizer engine.py:1315).
 _REGISTRY: Dict[str, Callable[..., Optimizer]] = {
@@ -261,7 +380,7 @@ _REGISTRY: Dict[str, Callable[..., Optimizer]] = {
     "adagrad": adagrad,
     "onebitadam": onebit_adam,
     "zerooneadam": onebit_adam,
-    "onebitlamb": lamb,
+    "onebitlamb": onebit_lamb,
 }
 
 
@@ -278,6 +397,4 @@ def build_optimizer(opt_type: str, params: Optional[dict] = None) -> Optimizer:
     if key in ("onebitadam", "zerooneadam", "onebitlamb"):
         kwargs.pop("cuda_aware", None)
         kwargs.pop("comm_backend_name", None)
-        if key == "onebitlamb":
-            kwargs.pop("freeze_step", None)
     return _REGISTRY[key](**kwargs)

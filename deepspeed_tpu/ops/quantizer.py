@@ -102,3 +102,21 @@ def onebit_compress(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 def onebit_decompress(signs: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
     return signs.astype(jnp.float32) * scale
+
+
+_BIT_WEIGHTS = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
+
+
+def pack_signs(x: jnp.ndarray) -> jnp.ndarray:
+    """[-1,+1] (or real-valued; sign taken) f32 [m] -> uint8 bitmap [m/8].
+    m must be a multiple of 8. This is what makes 1-bit collectives carry
+    1 bit/element on the wire (the reference packs via cupy packbits,
+    runtime/comm/nccl.py my_igather of sign bits)."""
+    bits = (x >= 0).reshape(-1, 8).astype(jnp.uint8)
+    return jnp.sum(bits * _BIT_WEIGHTS[None, :], axis=1, dtype=jnp.uint8)
+
+
+def unpack_signs(packed: jnp.ndarray) -> jnp.ndarray:
+    """uint8 bitmap [m/8] -> f32 signs {-1,+1} [m]."""
+    bits = (packed[:, None] & _BIT_WEIGHTS[None, :]) > 0
+    return jnp.where(bits, 1.0, -1.0).reshape(-1).astype(jnp.float32)

@@ -207,15 +207,34 @@ def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      config: SparsityConfig,
                      *,
                      causal: bool = False,
-                     sm_scale: Optional[float] = None) -> jnp.ndarray:
-    """Block-sparse attention via layout mask. q,k,v: [B, H, S, D].
+                     sm_scale: Optional[float] = None,
+                     use_kernel: Optional[bool] = None,
+                     interpret: bool = False) -> jnp.ndarray:
+    """Block-sparse attention. q,k,v: [B, H, S, D].
 
-    Density d of the layout cuts attention FLOPs/memory to d (the Pallas
-    block-skip path realizes the FLOP saving on TPU; this entry is the
-    layout-correct oracle and CPU path).
+    Execution: the Pallas layout-skip kernel
+    (ops/pallas/block_sparse_attention.py) when on TPU and shapes tile —
+    attention FLOPs scale with layout density, like the reference's Triton
+    sdd/dsd path — otherwise the dense-mask oracle (XLA fuses mask+softmax;
+    correct everywhere, no compute saving).
     """
     S = q.shape[-2]
     layout = config.make_layout(S)
+    auto = use_kernel is None
+    if auto:
+        use_kernel = jax.default_backend() == "tpu"
+    if use_kernel:
+        from .pallas.block_sparse_attention import block_sparse_flash_attention
+        try:
+            return block_sparse_flash_attention(
+                q, k, v, layout, config.block, causal=causal,
+                sm_scale=sm_scale, interpret=interpret)
+        except ValueError:
+            # only the AUTO path may quietly fall back to the dense-mask
+            # oracle; an explicit use_kernel=True means the caller wants the
+            # FLOP-scaling contract and must hear that it can't be met
+            if not auto:
+                raise
     mask = layout_to_dense_mask(layout, config.block)[None]   # [1, H, S, S]
     from .attention import mha_reference
     return mha_reference(q, k, v, causal=causal, mask=mask, sm_scale=sm_scale)

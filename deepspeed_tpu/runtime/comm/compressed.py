@@ -24,10 +24,19 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def _chunk(x: jnp.ndarray, n: int) -> jnp.ndarray:
-    pad = (-x.size) % n
+def _chunk(x: jnp.ndarray, n: int, multiple: int = 1) -> jnp.ndarray:
+    """Pad + reshape flat x to [n, c] with c a multiple of ``multiple``."""
+    c = -(-x.size // n)
+    c = -(-c // multiple) * multiple
+    pad = n * c - x.size
     xp = jnp.pad(x.reshape(-1), (0, pad))
     return xp.reshape(n, -1), pad
+
+
+def chunk_elems(numel: int, n: int, multiple: int = 8) -> int:
+    """Per-rank chunk length the 1-bit path uses for ``numel`` elements."""
+    c = -(-numel // n)
+    return -(-c // multiple) * multiple
 
 
 def compressed_allreduce(x: jnp.ndarray,
@@ -46,25 +55,30 @@ def compressed_allreduce(x: jnp.ndarray,
     """
     n = mesh.shape[axis]
 
+    from ...ops.quantizer import pack_signs, unpack_signs
+
     def inner(x, w_err, s_err):
         x, w_err, s_err = x[0], w_err[0], s_err[0]
         flat = x.reshape(-1).astype(jnp.float32)
         corrected = flat + w_err
-        chunks, pad = _chunk(corrected, n)                    # [n, c]
+        chunks, pad = _chunk(corrected, n, multiple=8)        # [n, c], c%8==0
         scale = jnp.mean(jnp.abs(chunks), axis=1, keepdims=True)  # [n, 1]
         signs = jnp.where(chunks >= 0, 1.0, -1.0)
         new_w_err = corrected - (signs * scale).reshape(-1)[:corrected.size]
 
-        # exchange: rank r serves chunk r — a2a signs (int8 on the wire),
-        # allgather the tiny scales
-        signs_recv = jax.lax.all_to_all(signs.astype(jnp.int8), axis,
-                                        split_axis=0, concat_axis=0,
-                                        tiled=True)            # [n, c]
+        # exchange: rank r serves chunk r — a2a the PACKED sign bits (1 bit per
+        # element on the wire; reference packs via cupy packbits), allgather
+        # the tiny per-chunk scales
+        c = chunks.shape[1]
+        packed = jax.vmap(pack_signs)(signs)                   # [n, c/8] u8
+        packed_recv = jax.lax.all_to_all(packed, axis,
+                                         split_axis=0, concat_axis=0,
+                                         tiled=True)           # [n, c/8]
+        signs_recv = jax.vmap(unpack_signs)(packed_recv)       # [n, c]
         scales_all = jax.lax.all_gather(scale[:, 0], axis)     # [n, n]
         my = jax.lax.axis_index(axis)
         my_scales = scales_all[:, my]                          # senders' scales
-        served = jnp.mean(signs_recv.astype(jnp.float32) *
-                          my_scales[:, None], axis=0)          # [c]
+        served = jnp.mean(signs_recv * my_scales[:, None], axis=0)  # [c]
 
         # server-side re-compress with server error feedback
         served_c = served + s_err
@@ -72,11 +86,10 @@ def compressed_allreduce(x: jnp.ndarray,
         s_signs = jnp.where(served_c >= 0, 1.0, -1.0)
         new_s_err = served_c - s_signs * s_scale
 
-        out_signs = jax.lax.all_gather(s_signs.astype(jnp.int8), axis,
-                                       tiled=True)             # [n*c]
+        out_packed = jax.lax.all_gather(pack_signs(s_signs), axis,
+                                        tiled=True)            # [n*c/8]
         out_scales = jax.lax.all_gather(s_scale, axis)         # [n]
-        c = served.shape[0]
-        out = (out_signs.astype(jnp.float32).reshape(n, c) *
+        out = (unpack_signs(out_packed).reshape(n, c) *
                out_scales[:, None]).reshape(-1)
         out = out[:flat.size].reshape(x.shape).astype(x.dtype)
         return out, new_w_err[None], new_s_err[None]

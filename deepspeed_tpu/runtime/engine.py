@@ -179,10 +179,47 @@ class DeepSpeedEngine:
                 buffer_count=off.buffer_count,
                 aio_config=self.config.aio.model_dump())
 
+        # 1-bit explicit-collective mode --------------------------------------
+        # onebit optimizers only save wire bytes if the grad sync is explicit:
+        # the OneBitRunner owns the whole train step (per-rank grads out of
+        # shard_map, compressed momentum exchange after freeze_step).
+        self.onebit = None
+        opt_key = (opt_cfg.type.lower().replace("_", "")
+                   if opt_cfg is not None else "")
+        if (self.offload is None and optimizer is None
+                and self.optimizer is not None
+                and opt_key in ("onebitadam", "zerooneadam", "onebitlamb")
+                and self.mesh_mgr.shape["data"] > 1):
+            for ax in ("model", "seq", "pipe", "expert"):
+                if self.mesh_mgr.shape[ax] != 1:
+                    raise ValueError(
+                        f"1-bit optimizers support pure data parallelism; "
+                        f"mesh axis '{ax}' has size {self.mesh_mgr.shape[ax]}")
+            if stage != 0:
+                raise ValueError("1-bit optimizers are incompatible with "
+                                 "ZeRO (reference: onebit docs); set stage 0")
+            if self.loss_scaler.enabled and self.loss_scaler.dynamic:
+                raise ValueError("1-bit optimizers need a static or disabled "
+                                 "loss scale (no overflow-skip in the "
+                                 "compressed exchange)")
+            from .onebit import OneBitRunner
+            self.onebit = OneBitRunner(
+                "lamb" if "lamb" in opt_key else "adam",
+                opt_cfg.params, self.mesh, "data", params_f32,
+                self.apply_fn, self.loss_fn,
+                self.config.gradient_accumulation_steps,
+                compute_dtype=self.compute_dtype,
+                grad_clip=self.config.gradient_clipping)
+
         # device placement of state -----------------------------------------
         # fp32 training: params ARE the master copy — TrainState.master is kept
         # empty so the same buffers aren't donated twice through the pytree.
-        if self.offload is not None:
+        if self.onebit is not None:
+            # fp32 params, replicated (pure DP); runner casts for compute
+            params = jax.device_put(params_f32,
+                                    NamedSharding(self.mesh, P()))
+            master = ()
+        elif self.offload is not None:
             params = self.offload.current_params_device()
             master = ()
         elif self.keep_master:
@@ -194,12 +231,17 @@ class DeepSpeedEngine:
             params = jax.device_put(params_f32, self.param_shardings)
             master = ()
         opt_state = {}
-        self.opt_shardings = {} if self.offload is not None else \
-            self._opt_state_shardings(params_f32)
-        if self.optimizer is not None and self.offload is None:
-            opt_state = jax.jit(self.optimizer.init,
-                                out_shardings=self.opt_shardings)(
-                                    master if self.keep_master else params)
+        if self.onebit is not None:
+            opt_state = {"onebit": self.onebit.init_state(params_f32)}
+            self.opt_shardings = jax.tree.map(lambda x: x.sharding, opt_state)
+        elif self.offload is not None:
+            self.opt_shardings = {}
+        else:
+            self.opt_shardings = self._opt_state_shardings(params_f32)
+            if self.optimizer is not None:
+                opt_state = jax.jit(self.optimizer.init,
+                                    out_shardings=self.opt_shardings)(
+                                        master if self.keep_master else params)
         self.state = TrainState(
             step=jnp.asarray(0, jnp.int32),
             params=params,
@@ -212,6 +254,9 @@ class DeepSpeedEngine:
         if self.offload is not None:
             self._grads_step = self._make_grads_step()
             self._train_step = None
+        elif self.onebit is not None:
+            self._grads_step = None
+            self._train_step = None           # the runner owns the step
         else:
             self._grads_step = None
             self._train_step = self._make_train_step()
@@ -541,7 +586,21 @@ class DeepSpeedEngine:
                 micro_sharding),
             batch)
         self.tput_timer.start()
-        if self.offload is not None:
+        if self.onebit is not None:
+            if self.lr_fn is not None:
+                lr = float(jax.device_get(self.lr_fn(self.state.step)))
+            else:
+                lr = float(jax.device_get(self._current_lr()))
+            new_p, new_s, loss, norm = self.onebit.step(
+                self.state.params, self.state.opt_state["onebit"], micros,
+                self.next_rng(), lr, self.global_steps)
+            self.state = self.state.replace(
+                step=self.state.step + 1, params=new_p,
+                opt_state={"onebit": new_s})
+            metrics = {"loss": loss, "lr": lr, "grad_norm": norm,
+                       "overflow": False,
+                       "loss_scale": float(self.loss_scaler.initial_scale)}
+        elif self.offload is not None:
             grads_sum, loss, raw_norm, overflow = self._grads_step(
                 self.state.params, self.state.scale, micros, self.next_rng())
             metrics = self._apply_offload_update(grads_sum, float(gas), loss,
@@ -561,6 +620,19 @@ class DeepSpeedEngine:
 
     def forward(self, batch):
         """Compute loss for one microbatch — forward only, no gradients.
+
+        Not available in 1-bit explicit-collective mode: the compressed
+        momentum exchange needs per-rank grads, which only the fused
+        train_batch step produces."""
+        if self.onebit is not None:
+            raise NotImplementedError(
+                "the forward/backward/step micro API is not supported with "
+                "1-bit optimizers on a multi-rank mesh — use train_batch() "
+                "(the compressed exchange needs per-rank gradients)")
+        return self._forward_impl(batch)
+
+    def _forward_impl(self, batch):
+        """Forward-only loss for one microbatch.
 
         The batch + rng are cached so backward() can differentiate the same
         computation (same dropout rng → identical numerics). Inference-style

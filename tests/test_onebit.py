@@ -1,0 +1,140 @@
+"""1-bit optimizer tests: explicit-collective mode wire-byte accounting,
+warmup parity with exact Adam, convergence through the freeze transition,
+and the real OneBitLamb (vs the round-1 silent lamb alias).
+
+Mirrors the reference's tests/unit/test_onebit.py (TestOneBitAdamBasic /
+TestOneBitLambBasic) plus a wire-byte audit the reference can't do (we parse
+the compiled HLO's collective ops).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.runtime.onebit import hlo_collective_bytes
+
+from util import SimpleModel, random_batch
+
+
+def _onebit_config(opt_type="OneBitAdam", freeze_step=4, lr=1e-2):
+    return {
+        "train_batch_size": 16,
+        "optimizer": {"type": opt_type,
+                      "params": {"lr": lr, "freeze_step": freeze_step,
+                                 "weight_decay": 0.01}},
+        "seed": 7,
+    }
+
+
+def _make(opt_type="OneBitAdam", freeze_step=4, **kw):
+    engine, *_ = ds.initialize(model=SimpleModel(), example_batch=random_batch(16),
+                               config=_onebit_config(opt_type, freeze_step, **kw))
+    return engine
+
+
+def test_onebit_engine_explicit_mode_active():
+    engine = _make()
+    assert engine.onebit is not None
+    assert engine.onebit.n == 8
+
+
+def test_onebit_adam_warmup_matches_exact_adam():
+    """During warmup the explicit-collective path is exact (uncompressed)
+    Adam without bias correction — losses must track a same-hyper reference
+    run step for step."""
+    e1 = _make("OneBitAdam", freeze_step=1000)
+    cfg = _onebit_config("Adam")
+    cfg["optimizer"]["params"].pop("freeze_step")
+    cfg["optimizer"]["params"]["bias_correction"] = False
+    # 1-bit Adam's weight decay is decoupled (reference onebit/adam.py adds
+    # wd*p to the update); match it
+    cfg["optimizer"]["params"]["adamw_mode"] = True
+    e2, *_ = ds.initialize(model=SimpleModel(), example_batch=random_batch(16),
+                           config=cfg)
+    for i in range(4):
+        b = random_batch(16, seed=i)
+        l1 = float(e1.train_batch(b)["loss"])
+        l2 = float(e2.train_batch(b)["loss"])
+        assert abs(l1 - l2) < 3e-3, (i, l1, l2)
+
+
+def test_onebit_adam_trains_through_freeze():
+    """Warmup long enough for v to stabilize (the algorithm's intended regime
+    — reference docs put freeze at 15-25% of total steps), then the
+    compressed stage must keep training without blowup."""
+    engine = _make("OneBitAdam", freeze_step=12, lr=2e-3)
+    losses = [float(engine.train_batch(random_batch(16, seed=i))["loss"])
+              for i in range(36)]
+    assert np.mean(losses[-5:]) < losses[0]
+    assert all(np.isfinite(losses)), losses
+    # the compressed stage must actually run
+    assert engine.onebit._step_frozen is not None
+    assert engine.onebit._step_warm is not None
+
+
+def test_onebit_lamb_trains_through_freeze():
+    engine = _make("OneBitLamb", freeze_step=12, lr=1e-2)
+    losses = [float(engine.train_batch(random_batch(16, seed=i))["loss"])
+              for i in range(36)]
+    assert np.mean(losses[-5:]) < losses[0]
+    assert all(np.isfinite(losses)), losses
+    assert engine.onebit._step_frozen is not None
+
+
+def test_onebit_wire_bytes_compressed():
+    """The compression-stage step must move far fewer collective bytes than
+    the warmup step (which allreduces f32 grads): the 1-bit exchange carries
+    packed sign bits + scales. Audited from the optimized HLO."""
+    engine = _make("OneBitAdam", freeze_step=5)
+    micros = jax.tree.map(
+        lambda x: jnp.asarray(x)[None], random_batch(16))
+    rng = jax.random.PRNGKey(0)
+    params = engine.state.params
+    state = engine.state.opt_state["onebit"]
+    runner = engine.onebit
+
+    def bytes_for(frozen):
+        fn = runner._build(frozen)
+        lowered = fn.lower(params, state, micros, rng,
+                           jnp.asarray(1e-2, jnp.float32))
+        return hlo_collective_bytes(lowered.compile().as_text())
+
+    warm = bytes_for(False)
+    frozen = bytes_for(True)
+    assert warm > 0 and frozen > 0
+    # sign-bit traffic alone is 1/32 of f32; scales/loss/norm overhead means
+    # the end-to-end step must still be >=6x cheaper on the wire
+    assert frozen * 6 <= warm, (warm, frozen)
+
+
+def test_onebit_rejects_zero_stage():
+    cfg = _onebit_config()
+    cfg["zero_optimization"] = {"stage": 2}
+    with pytest.raises(ValueError, match="ZeRO"):
+        ds.initialize(model=SimpleModel(), example_batch=random_batch(16),
+                      config=cfg)
+
+
+def test_onebit_lamb_numeric_dp1():
+    """The functional onebit_lamb (dp=1 numeric form) must run both stages
+    and differ from plain lamb after freeze (the round-1 alias bug)."""
+    from deepspeed_tpu.ops.optimizers import build_optimizer, lamb
+    ob = build_optimizer("OneBitLamb", {"lr": 1e-2, "freeze_step": 3})
+    pl = lamb(lr=1e-2)
+    assert ob.name == "onebitlamb"
+    params = {"w": jnp.asarray(np.random.RandomState(0).randn(64), jnp.float32)}
+    s_ob, s_pl = ob.init(params), pl.init(params)
+    p_ob = p_pl = params
+    diverged = False
+    for step in range(8):
+        g = {"w": jnp.asarray(np.random.RandomState(step + 1).randn(64),
+                              jnp.float32)}
+        p_ob, s_ob = ob.update(g, s_ob, p_ob, jnp.asarray(step, jnp.int32))
+        p_pl, s_pl = pl.update(g, s_pl, p_pl, jnp.asarray(step, jnp.int32))
+        if step >= 3 and not np.allclose(np.asarray(p_ob["w"]),
+                                         np.asarray(p_pl["w"]), atol=1e-6):
+            diverged = True
+    assert diverged, "onebit_lamb behaved identically to plain lamb"
+    assert np.all(np.isfinite(np.asarray(p_ob["w"])))
