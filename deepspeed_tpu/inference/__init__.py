@@ -1,0 +1,11 @@
+"""deepspeed_tpu.inference — config-driven inference engine.
+
+reference: deepspeed/inference/ (InferenceEngine + config), entered through
+deepspeed.init_inference (deepspeed_tpu.init_inference here).
+"""
+
+from .config import DeepSpeedInferenceConfig, load_inference_config
+from .engine import InferenceEngine
+
+__all__ = ["InferenceEngine", "DeepSpeedInferenceConfig",
+           "load_inference_config"]

@@ -54,13 +54,13 @@ class InferenceEngine:
         # dtype conversion + TP sharding of weights (reference: engine.py:450 dtype
         # convert + module_inject TP slicing — here one device_put with specs)
         tp_specs = build_tp_specs(model_parameters, sharding_rules)
-        shardings = jax.tree.map(
+        self._shardings = jax.tree.map(
             lambda spec: jax.sharding.NamedSharding(self.mesh, spec if spec is not None
                                                     else P()),
             tp_specs, is_leaf=lambda x: isinstance(x, P) or x is None)
         self.params = jax.tree.map(
             lambda p, s: jax.device_put(jnp.asarray(p, self.dtype), s),
-            model_parameters, shardings)
+            model_parameters, self._shardings)
 
         if apply_fn is not None:
             self._apply = apply_fn
@@ -68,6 +68,19 @@ class InferenceEngine:
             self._apply = lambda params, batch: model.apply({"params": params}, batch)
         self._fwd = jax.jit(self._apply)
         log_dist(f"InferenceEngine: dtype={self.config.dtype} tp={tp}", ranks=[0])
+
+    def load_checkpoint(self, path: str):
+        """Load a name-keyed npz (save_16bit_model / model_states.npz output)
+        and reshard every tensor onto THIS engine's TP mesh — the role of the
+        reference's TP-degree-resharding checkpoint loader
+        (runtime/state_dict_factory.py:20,214 merge/split of mp_rank shards).
+        Checkpoints are whole-tensor name-keyed, so any source topology loads
+        onto any tp_size; the device_put splits along the rule-declared axes.
+        """
+        from ..runtime import checkpointing as ckpt_lib
+        self.params = ckpt_lib.load_tree(path, self.params, self._shardings)
+        log_dist(f"InferenceEngine: loaded + TP-resharded {path}", ranks=[0])
+        return self
 
     def forward(self, batch):
         return self._fwd(self.params, batch)

@@ -69,15 +69,45 @@ def ensure_scan_layout(params: PyTree, num_layers: int) -> PyTree:
     return {**rest, "blocks": stacked}
 
 
+def _moe_mlp(cfg: TransformerConfig, p_moe, h):
+    """Decode-path MoE MLP: same gating math as moe/layer.MoE with a no-drop
+    capacity — incremental decode can't see the other timesteps a capacity
+    limit would make it compete with (run eval with a capacity_factor that
+    avoids drops for exact decode/full-forward parity)."""
+    from ..moe.sharded_moe import top1_gating, top2_gating
+    B, T, H = h.shape
+    tokens = h.reshape(B * T, H)
+    gate_logits = tokens.astype(jnp.float32) @ p_moe["gate"]["kernel"]
+    gating = top1_gating if cfg.moe_k == 1 else top2_gating
+    _aux, combine, dispatch, _ = gating(gate_logits, capacity=B * T)
+    disp = jnp.einsum("tec,th->ech", dispatch.astype(h.dtype), tokens)
+    fc = p_moe["experts"]["fc"]
+    hh = jnp.einsum("ech,ehm->ecm", disp, fc["kernel"].astype(h.dtype))
+    if "bias" in fc:
+        hh = hh + fc["bias"][:, None].astype(h.dtype)
+    hh = jax.nn.gelu(hh)
+    proj = p_moe["experts"]["proj"]
+    out = jnp.einsum("ecm,emh->ech", hh, proj["kernel"].astype(h.dtype))
+    if "bias" in proj:
+        out = out + proj["bias"][:, None].astype(h.dtype)
+    y = jnp.einsum("tec,ech->th", combine.astype(h.dtype), out)
+    return y.reshape(B, T, H)
+
+
 def forward_with_cache(cfg: TransformerConfig, params: PyTree,
                        input_ids: jnp.ndarray, cache: Dict
                        ) -> Tuple[jnp.ndarray, Dict]:
     """Run T_new tokens at positions [cache.pos, cache.pos+T_new) against the
     cache. Returns (logits [B, T_new, V], updated cache). Params must be the
     scan-layers layout (blocks leaves [L, ...]) — use ensure_scan_layout to
-    restack a per-layer tree."""
-    if cfg.moe_experts > 0:
-        raise NotImplementedError("KV-cache decode for MoE models lands later")
+    restack a per-layer tree.
+
+    Covers the policy architectures: rotary/alibi positions, parallel
+    residual (GPT-J), per-layer local windows (GPT-Neo), relu/gelu
+    activations, unscaled attention, MoE MLPs. post_ln (BERT) has no decode
+    path — encoders don't generate."""
+    if cfg.post_ln:
+        raise NotImplementedError("post-LN encoders (BERT) do not decode")
     if "blocks" not in params:
         raise ValueError(
             "forward_with_cache needs scan-layers params (a 'blocks' subtree "
@@ -87,41 +117,72 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
     pos = cache["pos"]
     max_len = cache["k"].shape[3]
     nh, hd = cfg.num_heads, cfg.head_dim
+    from .transformer import _ACTIVATIONS, alibi_slopes, apply_rotary
+    act = _ACTIVATIONS[cfg.activation]
+    sm_scale = (cfg.attn_scale if cfg.attn_scale is not None
+                else 1.0 / np.sqrt(hd))
 
     wte = params["wte"]["embedding"]
-    wpe = params["wpe"]["embedding"]
-    x = (wte.astype(cfg.dtype)[input_ids] +
-         wpe.astype(cfg.dtype)[pos + jnp.arange(T_new)][None])
-
+    x = wte.astype(cfg.dtype)[input_ids]
     q_abs = pos + jnp.arange(T_new)                 # [T_new]
+    if cfg.pos_embed == "learned":
+        x = x + params["wpe"]["embedding"].astype(cfg.dtype)[q_abs][None]
+    if cfg.embed_ln:
+        x = _layer_norm(x, params["ln_emb"], cfg.layer_norm_eps)
+
     k_pos = jnp.arange(max_len)                     # [max_len]
     # causal-with-cache mask [T_new, max_len]
     mask = k_pos[None, :] <= q_abs[:, None]
+    ali = None
+    if cfg.pos_embed == "alibi":
+        slopes = jnp.asarray(alibi_slopes(nh), jnp.float32)
+        dist = (k_pos[None, :] - q_abs[:, None]).astype(jnp.float32)
+        ali = slopes[:, None, None] * dist[None]    # [nh, T_new, max_len]
+
+    windows = (jnp.asarray(cfg.layer_windows, jnp.int32)
+               if cfg.layer_windows is not None
+               else jnp.zeros((cfg.num_layers,), jnp.int32))
 
     def layer(x, xs):
-        p, k_cache, v_cache = xs                    # k/v: [B, nh, max_len, hd]
+        p, k_cache, v_cache, window = xs            # k/v: [B, nh, max_len, hd]
         h = _layer_norm(x, p["ln1"], cfg.layer_norm_eps)
         qkv = _dense(h, p["attn_qkv"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
         to_heads = lambda t: t.reshape(B, T_new, nh, hd).transpose(0, 2, 1, 3)
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        if cfg.pos_embed == "rotary":
+            q = apply_rotary(q, q_abs, cfg.rotary_dim)
+            k = apply_rotary(k, q_abs, cfg.rotary_dim)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache).astype(jnp.float32)
-        s = s / np.sqrt(hd)
-        s = jnp.where(mask[None, None], s, -1e30)
+        s = s * sm_scale
+        if ali is not None:
+            s = s + ali[None]
+        m = mask
+        # local sliding window (0 = global)
+        m = m & ((q_abs[:, None] - k_pos[None, :] < window) | (window <= 0))
+        s = jnp.where(m[None, None], s, -1e30)
         prob = jax.nn.softmax(s, axis=-1).astype(x.dtype)
         o = jnp.einsum("bhqk,bhkd->bhqd", prob, v_cache)
         o = o.transpose(0, 2, 1, 3).reshape(B, T_new, nh * hd)
-        x = x + _dense(o, p["attn_proj"])
-        h = _layer_norm(x, p["ln2"], cfg.layer_norm_eps)
-        h = _dense(h, p["mlp_fc"])
-        h = jax.nn.gelu(h)
-        x = x + _dense(h, p["mlp_proj"])
-        return x, (k_cache, v_cache)
+        attn_out = _dense(o, p["attn_proj"])
+
+        def mlp(hin):
+            if cfg.moe_experts > 0:
+                return _moe_mlp(cfg, p["moe"], hin)
+            return _dense(act(_dense(hin, p["mlp_fc"])), p["mlp_proj"])
+
+        if cfg.parallel_residual:
+            x_out = x + attn_out + mlp(h)
+        else:
+            x_mid = x + attn_out
+            h2 = _layer_norm(x_mid, p["ln2"], cfg.layer_norm_eps)
+            x_out = x_mid + mlp(h2)
+        return x_out, (k_cache, v_cache)
 
     x, (k_new, v_new) = jax.lax.scan(
-        layer, x, (params["blocks"], cache["k"], cache["v"]))
+        layer, x, (params["blocks"], cache["k"], cache["v"], windows))
     x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bth,vh->btv", x, wte.astype(x.dtype))

@@ -8,8 +8,10 @@ fused CUDA modules; here the model IS the TPU-native Transformer, so a
 pytree. TP slicing happens downstream via sharding rules (the reference
 slices 1/tp_size by hand, containers/base.py:243).
 
-Policies implemented: GPT-2 (HFGPT2Policy). The reference ships ~10
-(replace_policy.py:18-32); further arches land as mappings here.
+Policies implemented: GPT-2, GPT-Neo, GPT-J, OPT, BLOOM, BERT — the training
+/inference arches the reference's replace_policy.py:18-32 list headlines.
+torch Linear weights are [out, in] and transpose into flax kernels; GPT-2's
+Conv1D is already [in, out].
 """
 
 from __future__ import annotations
@@ -87,10 +89,356 @@ def load_hf_gpt2(model_or_state_dict,
     return params, cfg
 
 
+def _sd_and_config(model_or_state_dict, config):
+    if hasattr(model_or_state_dict, "state_dict"):
+        return (dict(model_or_state_dict.state_dict()),
+                config or model_or_state_dict.config)
+    if config is None:
+        raise ValueError("pass the HF config when giving a raw state_dict")
+    return dict(model_or_state_dict), config
+
+
+def load_hf_gpt_neo(model_or_state_dict, config=None):
+    """GPT-Neo (HF GPTNeoForCausalLM): separate unbiased q/k/v torch Linears
+    concat into our qkv kernel; unscaled attention (attn_scale=1.0);
+    alternating global/local attention layers become layer_windows."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    g = lambda n: _np(sd[prefix + n])
+    L = config.num_layers
+    # config.attention_layers: ["global", "local", ...] per layer
+    windows = tuple(config.window_size if a == "local" else 0
+                    for a in config.attention_layers)
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_position_embeddings,
+        hidden_size=config.hidden_size,
+        num_layers=L,
+        num_heads=config.num_heads,
+        mlp_ratio=(config.intermediate_size or 4 * config.hidden_size)
+        // config.hidden_size,
+        tie_embeddings=True,
+        scan_layers=True,
+        layer_norm_eps=float(config.layer_norm_epsilon),
+        attn_scale=1.0,
+        qkv_bias=False,
+        layer_windows=windows if any(windows) else None,
+    )
+
+    def qkv(i):
+        ws = [g(f"h.{i}.attn.attention.{p}_proj.weight").T
+              for p in ("q", "k", "v")]
+        return np.concatenate(ws, axis=1)                    # [H, 3H]
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    blocks = {
+        "ln1": {"scale": stack(lambda i: g(f"h.{i}.ln_1.weight")),
+                "bias": stack(lambda i: g(f"h.{i}.ln_1.bias"))},
+        "attn_qkv": {"kernel": stack(qkv)},
+        "attn_proj": {"kernel": stack(
+            lambda i: g(f"h.{i}.attn.attention.out_proj.weight").T),
+            "bias": stack(lambda i: g(f"h.{i}.attn.attention.out_proj.bias"))},
+        "ln2": {"scale": stack(lambda i: g(f"h.{i}.ln_2.weight")),
+                "bias": stack(lambda i: g(f"h.{i}.ln_2.bias"))},
+        "mlp_fc": {"kernel": stack(lambda i: g(f"h.{i}.mlp.c_fc.weight").T),
+                   "bias": stack(lambda i: g(f"h.{i}.mlp.c_fc.bias"))},
+        "mlp_proj": {"kernel": stack(lambda i: g(f"h.{i}.mlp.c_proj.weight").T),
+                     "bias": stack(lambda i: g(f"h.{i}.mlp.c_proj.bias"))},
+    }
+    params = {
+        "wte": {"embedding": g("wte.weight")},
+        "wpe": {"embedding": g("wpe.weight")},
+        "blocks": blocks,
+        "ln_f": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+    }
+    return _to_f32(params), cfg
+
+
+def load_hf_gptj(model_or_state_dict, config=None):
+    """GPT-J (HF GPTJForCausalLM): rotary positions, parallel attention+MLP
+    residual off one shared LayerNorm, untied biased lm_head."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    g = lambda n: _np(sd[prefix + n])
+    L = config.n_layer
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.n_positions,
+        hidden_size=config.n_embd,
+        num_layers=L,
+        num_heads=config.n_head,
+        mlp_ratio=(getattr(config, "n_inner", None) or 4 * config.n_embd)
+        // config.n_embd,
+        tie_embeddings=False,
+        lm_head_bias=True,
+        scan_layers=True,
+        layer_norm_eps=float(config.layer_norm_epsilon),
+        pos_embed="rotary",
+        rotary_dim=config.rotary_dim or 0,
+        parallel_residual=True,
+        qkv_bias=False,
+        attn_out_bias=False,
+    )
+
+    def qkv(i):
+        ws = [g(f"h.{i}.attn.{p}_proj.weight").T for p in ("q", "k", "v")]
+        return np.concatenate(ws, axis=1)
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    blocks = {
+        "ln1": {"scale": stack(lambda i: g(f"h.{i}.ln_1.weight")),
+                "bias": stack(lambda i: g(f"h.{i}.ln_1.bias"))},
+        "attn_qkv": {"kernel": stack(qkv)},
+        "attn_proj": {"kernel": stack(
+            lambda i: g(f"h.{i}.attn.out_proj.weight").T)},
+        "mlp_fc": {"kernel": stack(lambda i: g(f"h.{i}.mlp.fc_in.weight").T),
+                   "bias": stack(lambda i: g(f"h.{i}.mlp.fc_in.bias"))},
+        "mlp_proj": {"kernel": stack(lambda i: g(f"h.{i}.mlp.fc_out.weight").T),
+                     "bias": stack(lambda i: g(f"h.{i}.mlp.fc_out.bias"))},
+    }
+    params = {
+        "wte": {"embedding": g("wte.weight")},
+        "blocks": blocks,
+        "ln_f": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+        "lm_head": {"kernel": _np(sd["lm_head.weight"]).T,
+                    "bias": _np(sd["lm_head.bias"])},
+    }
+    return _to_f32(params), cfg
+
+
+def load_hf_opt(model_or_state_dict, config=None):
+    """OPT (HF OPTForCausalLM): pre-LN decoder with ReLU and learned
+    positions at a +2 offset — the offset is baked by dropping the embedding
+    table's first two rows."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    prefix = ("model.decoder." if any(k.startswith("model.decoder.")
+                                      for k in sd) else "decoder.")
+    g = lambda n: _np(sd[prefix + n])
+    if not getattr(config, "do_layer_norm_before", True):
+        raise NotImplementedError("OPT with do_layer_norm_before=False "
+                                  "(350m variant) is post-LN; not mapped")
+    if config.word_embed_proj_dim != config.hidden_size:
+        raise NotImplementedError("OPT word_embed_proj_dim != hidden_size "
+                                  "needs the projection layers")
+    L = config.num_hidden_layers
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_position_embeddings,
+        hidden_size=config.hidden_size,
+        num_layers=L,
+        num_heads=config.num_attention_heads,
+        mlp_ratio=config.ffn_dim // config.hidden_size,
+        tie_embeddings=True,
+        scan_layers=True,
+        layer_norm_eps=1e-5,
+        activation="relu",
+    )
+
+    def qkv_w(i):
+        ws = [g(f"layers.{i}.self_attn.{p}_proj.weight").T
+              for p in ("q", "k", "v")]
+        return np.concatenate(ws, axis=1)
+
+    def qkv_b(i):
+        bs = [g(f"layers.{i}.self_attn.{p}_proj.bias") for p in ("q", "k", "v")]
+        return np.concatenate(bs)
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    blocks = {
+        "ln1": {"scale": stack(
+            lambda i: g(f"layers.{i}.self_attn_layer_norm.weight")),
+            "bias": stack(lambda i: g(f"layers.{i}.self_attn_layer_norm.bias"))},
+        "attn_qkv": {"kernel": stack(qkv_w), "bias": stack(qkv_b)},
+        "attn_proj": {"kernel": stack(
+            lambda i: g(f"layers.{i}.self_attn.out_proj.weight").T),
+            "bias": stack(lambda i: g(f"layers.{i}.self_attn.out_proj.bias"))},
+        "ln2": {"scale": stack(lambda i: g(f"layers.{i}.final_layer_norm.weight")),
+                "bias": stack(lambda i: g(f"layers.{i}.final_layer_norm.bias"))},
+        "mlp_fc": {"kernel": stack(lambda i: g(f"layers.{i}.fc1.weight").T),
+                   "bias": stack(lambda i: g(f"layers.{i}.fc1.bias"))},
+        "mlp_proj": {"kernel": stack(lambda i: g(f"layers.{i}.fc2.weight").T),
+                     "bias": stack(lambda i: g(f"layers.{i}.fc2.bias"))},
+    }
+    params = {
+        "wte": {"embedding": g("embed_tokens.weight")},
+        # OPTLearnedPositionalEmbedding adds +2 to every position index
+        "wpe": {"embedding": g("embed_positions.weight")[2:]},
+        "blocks": blocks,
+        "ln_f": {"scale": g("final_layer_norm.weight"),
+                 "bias": g("final_layer_norm.bias")},
+    }
+    return _to_f32(params), cfg
+
+
+def load_hf_bloom(model_or_state_dict, config=None, max_seq_len=None):
+    """BLOOM (HF BloomForCausalLM): ALiBi attention, LayerNorm on the word
+    embeddings, fused qkv stored head-major ([nh, 3, hd] on the out dim) —
+    permuted here into our contiguous q|k|v layout.
+
+    ALiBi has no positional table, so max_seq_len is only a KV-cache sizing
+    bound: defaults to the config's training length (seq_length, 2048 for
+    released BLOOMs); pass max_seq_len to extrapolate longer."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    g = lambda n: _np(sd[prefix + n])
+    L = config.n_layer
+    H = config.hidden_size
+    nh = config.n_head
+    hd = H // nh
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=max_seq_len or getattr(config, "seq_length", 2048),
+        hidden_size=H,
+        num_layers=L,
+        num_heads=nh,
+        mlp_ratio=4,
+        tie_embeddings=True,
+        scan_layers=True,
+        layer_norm_eps=float(config.layer_norm_epsilon),
+        pos_embed="alibi",
+        embed_ln=True,
+    )
+
+    def qkv_w(i):
+        w = g(f"h.{i}.self_attention.query_key_value.weight")  # [3H, H]
+        w = w.reshape(nh, 3, hd, H).transpose(1, 0, 2, 3).reshape(3 * H, H)
+        return w.T                                             # [H, 3H]
+
+    def qkv_b(i):
+        b = g(f"h.{i}.self_attention.query_key_value.bias")
+        return b.reshape(nh, 3, hd).transpose(1, 0, 2).reshape(3 * H)
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    blocks = {
+        "ln1": {"scale": stack(lambda i: g(f"h.{i}.input_layernorm.weight")),
+                "bias": stack(lambda i: g(f"h.{i}.input_layernorm.bias"))},
+        "attn_qkv": {"kernel": stack(qkv_w), "bias": stack(qkv_b)},
+        "attn_proj": {"kernel": stack(
+            lambda i: g(f"h.{i}.self_attention.dense.weight").T),
+            "bias": stack(lambda i: g(f"h.{i}.self_attention.dense.bias"))},
+        "ln2": {"scale": stack(
+            lambda i: g(f"h.{i}.post_attention_layernorm.weight")),
+            "bias": stack(lambda i: g(f"h.{i}.post_attention_layernorm.bias"))},
+        "mlp_fc": {"kernel": stack(
+            lambda i: g(f"h.{i}.mlp.dense_h_to_4h.weight").T),
+            "bias": stack(lambda i: g(f"h.{i}.mlp.dense_h_to_4h.bias"))},
+        "mlp_proj": {"kernel": stack(
+            lambda i: g(f"h.{i}.mlp.dense_4h_to_h.weight").T),
+            "bias": stack(lambda i: g(f"h.{i}.mlp.dense_4h_to_h.bias"))},
+    }
+    params = {
+        "wte": {"embedding": g("word_embeddings.weight")},
+        "ln_emb": {"scale": g("word_embeddings_layernorm.weight"),
+                   "bias": g("word_embeddings_layernorm.bias")},
+        "blocks": blocks,
+        "ln_f": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+    }
+    return _to_f32(params), cfg
+
+
+def load_hf_bert(model_or_state_dict, config=None):
+    """BERT (HF BertForMaskedLM): post-LN encoder with token-type embeddings
+    and the MLM prediction head (transform + tied decoder + bias)."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    prefix = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    g = lambda n: _np(sd[prefix + n])
+    L = config.num_hidden_layers
+    act = {"gelu": "gelu_exact", "gelu_new": "gelu", "relu": "relu"}[
+        config.hidden_act]
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_position_embeddings,
+        hidden_size=config.hidden_size,
+        num_layers=L,
+        num_heads=config.num_attention_heads,
+        mlp_ratio=config.intermediate_size // config.hidden_size,
+        causal=False,
+        tie_embeddings=True,
+        scan_layers=True,
+        layer_norm_eps=float(config.layer_norm_eps),
+        activation=act,
+        post_ln=True,
+        embed_ln=True,
+        token_type_vocab=config.type_vocab_size,
+        mlm_head=True,
+    )
+    enc = "encoder.layer."
+
+    def qkv_w(i):
+        ws = [g(f"{enc}{i}.attention.self.{p}.weight").T
+              for p in ("query", "key", "value")]
+        return np.concatenate(ws, axis=1)
+
+    def qkv_b(i):
+        return np.concatenate(
+            [g(f"{enc}{i}.attention.self.{p}.bias")
+             for p in ("query", "key", "value")])
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    blocks = {
+        "attn_qkv": {"kernel": stack(qkv_w), "bias": stack(qkv_b)},
+        "attn_proj": {"kernel": stack(
+            lambda i: g(f"{enc}{i}.attention.output.dense.weight").T),
+            "bias": stack(lambda i: g(f"{enc}{i}.attention.output.dense.bias"))},
+        "ln1": {"scale": stack(
+            lambda i: g(f"{enc}{i}.attention.output.LayerNorm.weight")),
+            "bias": stack(
+                lambda i: g(f"{enc}{i}.attention.output.LayerNorm.bias"))},
+        "mlp_fc": {"kernel": stack(
+            lambda i: g(f"{enc}{i}.intermediate.dense.weight").T),
+            "bias": stack(lambda i: g(f"{enc}{i}.intermediate.dense.bias"))},
+        "mlp_proj": {"kernel": stack(
+            lambda i: g(f"{enc}{i}.output.dense.weight").T),
+            "bias": stack(lambda i: g(f"{enc}{i}.output.dense.bias"))},
+        "ln2": {"scale": stack(lambda i: g(f"{enc}{i}.output.LayerNorm.weight")),
+                "bias": stack(lambda i: g(f"{enc}{i}.output.LayerNorm.bias"))},
+    }
+    params = {
+        "wte": {"embedding": g("embeddings.word_embeddings.weight")},
+        "wpe": {"embedding": g("embeddings.position_embeddings.weight")},
+        "tte": {"embedding": g("embeddings.token_type_embeddings.weight")},
+        "ln_emb": {"scale": g("embeddings.LayerNorm.weight"),
+                   "bias": g("embeddings.LayerNorm.bias")},
+        "blocks": blocks,
+        "mlm_transform": {
+            "kernel": _np(sd["cls.predictions.transform.dense.weight"]).T,
+            "bias": _np(sd["cls.predictions.transform.dense.bias"])},
+        "mlm_ln": {"scale": _np(sd["cls.predictions.transform.LayerNorm.weight"]),
+                   "bias": _np(sd["cls.predictions.transform.LayerNorm.bias"])},
+        "mlm_bias": _np(sd["cls.predictions.bias"]),
+    }
+    return _to_f32(params), cfg
+
+
+def _to_f32(params):
+    import jax
+    return jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
+
+
 # policy registry (reference: replace_policy.py replace_policies list)
 HF_POLICIES = {
+    "gptneo": load_hf_gpt_neo,
+    "GPTNeoForCausalLM": load_hf_gpt_neo,
+    "gptj": load_hf_gptj,
+    "GPTJForCausalLM": load_hf_gptj,
     "gpt2": load_hf_gpt2,
     "GPT2LMHeadModel": load_hf_gpt2,
+    "opt": load_hf_opt,
+    "OPTForCausalLM": load_hf_opt,
+    "bloom": load_hf_bloom,
+    "BloomForCausalLM": load_hf_bloom,
+    "bert": load_hf_bert,
+    "BertForMaskedLM": load_hf_bert,
 }
 
 

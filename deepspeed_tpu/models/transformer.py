@@ -66,6 +66,22 @@ class TransformerConfig:
     loss_chunk: int = 128
     attention_impl: str = "auto"   # "auto" | "flash" | "reference"
     layer_norm_eps: float = 1e-5
+    # -- architecture knobs covering the HF import policies (models/hf.py;
+    #    reference: module_inject/replace_policy.py's per-arch policies) -----
+    activation: str = "gelu"       # gelu (tanh) | gelu_exact | relu
+    attn_scale: Optional[float] = None   # None = 1/sqrt(head_dim); GPT-Neo: 1.0
+    pos_embed: str = "learned"     # learned | rotary (GPT-J) | alibi (BLOOM) | none
+    rotary_dim: int = 0            # 0 = whole head_dim
+    parallel_residual: bool = False  # GPT-J: x + attn(ln(x)) + mlp(ln(x))
+    post_ln: bool = False          # BERT: LayerNorm AFTER each residual add
+    embed_ln: bool = False         # BLOOM/BERT: LayerNorm on the embeddings
+    token_type_vocab: int = 0      # BERT segment embeddings
+    mlm_head: bool = False         # BERT: transform (dense+act+LN) + decoder bias
+    lm_head_bias: bool = False     # GPT-J: untied lm_head carries a bias
+    qkv_bias: Optional[bool] = None       # None = use_bias (GPT-Neo/J: False)
+    attn_out_bias: Optional[bool] = None  # None = use_bias (GPT-J: False)
+    # per-layer local attention window, 0 = global (GPT-Neo alternates 0/256)
+    layer_windows: Optional[Tuple[int, ...]] = None
     # MoE (reference: deepspeed/moe/*): >0 replaces every block's MLP with a
     # mixture of moe_experts experts; aux loss returned next to the logits
     moe_experts: int = 0
@@ -147,6 +163,61 @@ def get_config(name: str, **overrides) -> TransformerConfig:
     return TransformerConfig(**kw)
 
 
+_ACTIVATIONS = {
+    "gelu": nn.gelu,                                    # tanh approximation
+    "gelu_exact": lambda x: nn.gelu(x, approximate=False),
+    "relu": nn.relu,
+}
+
+
+def apply_rotary(x: jnp.ndarray, positions: jnp.ndarray,
+                 rotary_dim: int = 0) -> jnp.ndarray:
+    """GPT-J-style rotary embedding (rotate_every_two / interleaved pairs).
+
+    x: [B, nh, S, hd]; positions: [B, S] or [S]. Only the first rotary_dim
+    channels rotate (GPT-J: 64 of 256); the rest pass through.
+    reference arch source: HF GPTJAttention._apply_rotary_pos_emb.
+    """
+    B, nh, S, hd = x.shape
+    rd = rotary_dim or hd
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    inv_freq = 1.0 / (10000.0 ** (jnp.arange(0, rd, 2) / rd))
+    ang = positions[:, :, None].astype(jnp.float32) * inv_freq[None, None, :]
+    sin = jnp.sin(ang)[:, None, :, :]                   # [B, 1, S, rd/2]
+    cos = jnp.cos(ang)[:, None, :, :]
+    xr = x[..., :rd].astype(jnp.float32)
+    x1 = xr[..., 0::2]
+    x2 = xr[..., 1::2]
+    rot1 = x1 * cos - x2 * sin
+    rot2 = x2 * cos + x1 * sin
+    rot = jnp.stack([rot1, rot2], axis=-1).reshape(B, nh, S, rd)
+    return jnp.concatenate([rot.astype(x.dtype), x[..., rd:]], axis=-1)
+
+
+def alibi_slopes(num_heads: int) -> np.ndarray:
+    """ALiBi per-head slopes (BLOOM; HF build_alibi_tensor formula)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return start * (start ** np.arange(n))
+    if np.log2(num_heads).is_integer():
+        return pow2_slopes(num_heads)
+    base = 2 ** int(np.floor(np.log2(num_heads)))
+    extra = pow2_slopes(2 * base)[0::2][:num_heads - base]
+    return np.concatenate([pow2_slopes(base), extra])
+
+
+def alibi_bias(num_heads: int, q_pos: jnp.ndarray, k_pos: jnp.ndarray
+               ) -> jnp.ndarray:
+    """Additive bias -slope * (q - k): [B, H, Sq, Sk] for [B, S] positions
+    (packed/per-sample position ids), [1, H, Sq, Sk] for shared [S]."""
+    slopes = jnp.asarray(alibi_slopes(num_heads), jnp.float32)
+    if q_pos.ndim == 1:
+        q_pos, k_pos = q_pos[None], k_pos[None]
+    dist = (k_pos[:, None, :] - q_pos[:, :, None]).astype(jnp.float32)
+    return slopes[None, :, None, None] * dist[:, None]
+
+
 def _batch_constraint(x):
     """Constrain activations [B, S, H] to the mesh's batch/seq layout."""
     try:
@@ -156,28 +227,57 @@ def _batch_constraint(x):
 
 
 class Block(nn.Module):
-    """One pre-LN transformer block (attention + MLP)."""
+    """One transformer block (attention + MLP).
+
+    Default is the pre-LN GPT shape; cfg knobs reconfigure it into the other
+    policy architectures: post_ln (BERT), parallel_residual (GPT-J), rotary /
+    alibi positions, per-layer local windows (GPT-Neo), activations.
+    """
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, attn_mask=None, train: bool = False):
+    def __call__(self, x, attn_mask=None, train: bool = False, window=None,
+                 positions=None):
         cfg = self.cfg
         B, S, H = x.shape
         nh, hd = cfg.num_heads, cfg.head_dim
-        dense = lambda feats, name: nn.Dense(
-            feats, use_bias=cfg.use_bias, dtype=cfg.dtype,
-            param_dtype=jnp.float32, name=name)
+        act = _ACTIVATIONS[cfg.activation]
+        dense = lambda feats, name, bias=None: nn.Dense(
+            feats, use_bias=cfg.use_bias if bias is None else bias,
+            dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                       dtype=cfg.dtype,
+                                       param_dtype=jnp.float32, name=name)
 
         # attention ----------------------------------------------------------
-        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
-                         param_dtype=jnp.float32, name="ln1")(x)
-        qkv = dense(3 * H, "attn_qkv")(h)
+        h = x if cfg.post_ln else ln("ln1")(x)
+        qkv = dense(3 * H, "attn_qkv", bias=cfg.qkv_bias)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         to_heads = lambda t: t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        if cfg.pos_embed == "rotary":
+            pos = positions if positions is not None else jnp.arange(S)
+            q = apply_rotary(q, pos, cfg.rotary_dim)
+            k = apply_rotary(k, pos, cfg.rotary_dim)
+        bias = None
+        if cfg.pos_embed == "alibi":
+            pos = positions if positions is not None else jnp.arange(S)
+            bias = alibi_bias(nh, pos, pos)
+        mask = attn_mask
+        if window is not None:
+            # local sliding window (GPT-Neo): q attends k in (q-window, q].
+            # NOTE: mask/bias currently route attention() to the dense
+            # reference path (quadratic); long-seq window/alibi layers should
+            # move onto ops/pallas/block_sparse_attention (the sliding-window
+            # layout) — tracked as a perf follow-up, numerics are exact here.
+            q_pos = jnp.arange(S)[:, None]
+            k_pos = jnp.arange(S)[None, :]
+            wmask = (q_pos - k_pos < window) | (window <= 0)
+            mask = wmask[None, None] if mask is None else mask & wmask[None, None]
         drop_rng = (self.make_rng("dropout")
                     if train and cfg.dropout > 0.0 else None)
-        out = attention(q, k, v, causal=cfg.causal, mask=attn_mask,
+        out = attention(q, k, v, causal=cfg.causal, mask=mask, bias=bias,
+                        sm_scale=cfg.attn_scale,
                         dropout_rate=cfg.dropout if train else 0.0,
                         dropout_rng=drop_rng, impl=cfg.attention_impl)
         # tag so the "dots" remat policy keeps it: the Pallas kernel output is
@@ -185,35 +285,51 @@ class Block(nn.Module):
         from jax.ad_checkpoint import checkpoint_name
         out = checkpoint_name(out, "attn_out")
         out = out.transpose(0, 2, 1, 3).reshape(B, S, H)
-        out = dense(H, "attn_proj")(out)
+        out = dense(H, "attn_proj", bias=cfg.attn_out_bias)(out)
         if cfg.dropout > 0.0 and train:
             out = nn.Dropout(cfg.dropout)(out, deterministic=False)
-        x = _batch_constraint(x + out)
 
-        # mlp / moe ----------------------------------------------------------
-        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
-                         param_dtype=jnp.float32, name="ln2")(x)
         aux = jnp.zeros((), jnp.float32)
-        if cfg.moe_experts > 0:
-            from ..moe.layer import ExpertMLP, MoE
-            h, aux = MoE(
-                hidden_size=H,
-                num_experts=cfg.moe_experts,
-                expert=lambda: ExpertMLP(H, cfg.mlp_dim, dtype=cfg.dtype,
-                                         use_bias=cfg.use_bias,
-                                         name="experts"),
-                k=cfg.moe_k,
-                capacity_factor=cfg.moe_capacity_factor,
-                eval_capacity_factor=cfg.moe_capacity_factor,
-                dtype=cfg.dtype,
-                name="moe")(h, train=train)
-        else:
+
+        def mlp(h):
+            if cfg.moe_experts > 0:
+                from ..moe.layer import ExpertMLP, MoE
+                return MoE(
+                    hidden_size=H,
+                    num_experts=cfg.moe_experts,
+                    expert=lambda: ExpertMLP(H, cfg.mlp_dim, dtype=cfg.dtype,
+                                             use_bias=cfg.use_bias,
+                                             name="experts"),
+                    k=cfg.moe_k,
+                    capacity_factor=cfg.moe_capacity_factor,
+                    eval_capacity_factor=cfg.moe_capacity_factor,
+                    dtype=cfg.dtype,
+                    name="moe")(h, train=train)
             h = dense(cfg.mlp_dim, "mlp_fc")(h)
-            h = nn.gelu(h)
+            h = act(h)
             h = dense(H, "mlp_proj")(h)
+            return h, aux
+
+        if cfg.parallel_residual:
+            # GPT-J: one shared LN feeds both branches; single residual add
+            m, aux = mlp(h)
+            if cfg.dropout > 0.0 and train:
+                m = nn.Dropout(cfg.dropout)(m, deterministic=False)
+            return _batch_constraint(x + out + m), aux
+
+        if cfg.post_ln:
+            # BERT: LN after each residual add
+            x = ln("ln1")(x + out)
+            m, aux = mlp(x)
+            if cfg.dropout > 0.0 and train:
+                m = nn.Dropout(cfg.dropout)(m, deterministic=False)
+            return _batch_constraint(ln("ln2")(x + m)), aux
+
+        x = _batch_constraint(x + out)
+        m, aux = mlp(ln("ln2")(x))
         if cfg.dropout > 0.0 and train:
-            h = nn.Dropout(cfg.dropout)(h, deterministic=False)
-        return _batch_constraint(x + h), aux
+            m = nn.Dropout(cfg.dropout)(m, deterministic=False)
+        return _batch_constraint(x + m), aux
 
 
 class Transformer(nn.Module):
@@ -233,11 +349,25 @@ class Transformer(nn.Module):
 
         wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                        param_dtype=jnp.float32, name="wte")
-        wpe = nn.Embed(cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
-                       param_dtype=jnp.float32, name="wpe")
         if position_ids is None:
             position_ids = jnp.arange(S)[None, :]
-        x = wte(input_ids) + wpe(position_ids)
+        x = wte(input_ids)
+        if cfg.pos_embed == "learned":
+            wpe = nn.Embed(cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
+                           param_dtype=jnp.float32, name="wpe")
+            x = x + wpe(position_ids)
+        if cfg.token_type_vocab > 0:
+            tte = nn.Embed(cfg.token_type_vocab, cfg.hidden_size,
+                           dtype=cfg.dtype, param_dtype=jnp.float32,
+                           name="tte")
+            token_type_ids = (batch.get("token_type_ids")
+                              if isinstance(batch, dict) else None)
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + tte(token_type_ids)
+        if cfg.embed_ln:
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             param_dtype=jnp.float32, name="ln_emb")(x)
         if cfg.dropout > 0.0 and train:
             x = nn.Dropout(cfg.dropout)(x, deterministic=False)
         x = _batch_constraint(x)
@@ -259,23 +389,42 @@ class Transformer(nn.Module):
                                  f"have {sorted(policies)}")
             block = nn.remat(Block, static_argnums=(3,),
                              policy=policies[cfg.remat_policy])
+        windows = (jnp.asarray(cfg.layer_windows, jnp.int32)
+                   if cfg.layer_windows is not None else None)
         if cfg.scan_layers:
             x, auxes = nn.scan(
-                lambda mdl, carry, _: mdl(carry, attn_mask, train),
+                lambda mdl, carry, w: mdl(carry, attn_mask, train, w,
+                                          position_ids),
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True, "gating": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(block(cfg, name="blocks"), x, None)
+            )(block(cfg, name="blocks"), x, windows)
             aux_total = jnp.sum(auxes)
         else:
             aux_total = jnp.zeros((), jnp.float32)
             for i in range(cfg.num_layers):
-                x, aux = block(cfg, name=f"blocks_{i}")(x, attn_mask, train)
+                w = windows[i] if windows is not None else None
+                x, aux = block(cfg, name=f"blocks_{i}")(x, attn_mask, train,
+                                                        w, position_ids)
                 aux_total = aux_total + aux
 
-        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
-                         param_dtype=jnp.float32, name="ln_f")(x)
+        if not cfg.post_ln:
+            # post-LN stacks (BERT) end already normalized by each block's ln2
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             param_dtype=jnp.float32, name="ln_f")(x)
+        if cfg.mlm_head:
+            # BERT cls.predictions: transform (dense+act+LN) then decoder
+            # (tied embedding + output bias)
+            h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="mlm_transform")(x)
+            h = _ACTIVATIONS[cfg.activation](h)
+            h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             param_dtype=jnp.float32, name="mlm_ln")(h)
+            logits = wte.attend(h)
+            bias = self.param("mlm_bias", nn.initializers.zeros,
+                              (cfg.vocab_size,), jnp.float32)
+            return (logits + bias).astype(jnp.float32)
         if cfg.fused_loss:
             if not cfg.tie_embeddings:
                 raise ValueError("fused_loss requires tie_embeddings")
@@ -289,7 +438,8 @@ class Transformer(nn.Module):
         if cfg.tie_embeddings:
             logits = wte.attend(x)
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+            logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
+                              dtype=cfg.dtype,
                               param_dtype=jnp.float32, name="lm_head")(x)
         logits = logits.astype(jnp.float32)
         if cfg.moe_experts > 0:

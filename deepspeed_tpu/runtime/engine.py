@@ -198,14 +198,15 @@ class DeepSpeedEngine:
             if stage != 0:
                 raise ValueError("1-bit optimizers are incompatible with "
                                  "ZeRO (reference: onebit docs); set stage 0")
-            if self.loss_scaler.enabled and self.loss_scaler.dynamic:
-                raise ValueError("1-bit optimizers need a static or disabled "
-                                 "loss scale (no overflow-skip in the "
-                                 "compressed exchange)")
+            if self.loss_scaler.enabled:
+                raise ValueError(
+                    "1-bit optimizers run without fp16 loss scaling (the "
+                    "compressed exchange has no overflow-skip and the runner "
+                    "computes unscaled grads) — use bf16 or fp32")
             from .onebit import OneBitRunner
             self.onebit = OneBitRunner(
                 "lamb" if "lamb" in opt_key else "adam",
-                opt_cfg.params, self.mesh, "data", params_f32,
+                opt_cfg.params, self.mesh, "data",
                 self.apply_fn, self.loss_fn,
                 self.config.gradient_accumulation_steps,
                 compute_dtype=self.compute_dtype,
@@ -619,19 +620,6 @@ class DeepSpeedEngine:
     # --- micro-batch API (reference forward/backward/step contract) ----------
 
     def forward(self, batch):
-        """Compute loss for one microbatch — forward only, no gradients.
-
-        Not available in 1-bit explicit-collective mode: the compressed
-        momentum exchange needs per-rank grads, which only the fused
-        train_batch step produces."""
-        if self.onebit is not None:
-            raise NotImplementedError(
-                "the forward/backward/step micro API is not supported with "
-                "1-bit optimizers on a multi-rank mesh — use train_batch() "
-                "(the compressed exchange needs per-rank gradients)")
-        return self._forward_impl(batch)
-
-    def _forward_impl(self, batch):
         """Forward-only loss for one microbatch.
 
         The batch + rng are cached so backward() can differentiate the same
@@ -650,6 +638,13 @@ class DeepSpeedEngine:
         """Compute + accumulate grads for the last forward's microbatch
         (reference: engine.backward scales by 1/gas and fires reduction hooks;
         here the grad computation itself is deferred to this call)."""
+        if self.onebit is not None:
+            # inference-style forward() is fine in 1-bit mode; the TRAINING
+            # micro API is not — the compressed momentum exchange needs
+            # per-rank grads, which only the fused train_batch step produces
+            raise NotImplementedError(
+                "backward()/step() are not supported with 1-bit optimizers "
+                "on a multi-rank mesh — use train_batch()")
         if not hasattr(self, "_pending") or self._pending is None:
             raise RuntimeError("backward() called before forward()")
         batch, rng, loss_val = self._pending

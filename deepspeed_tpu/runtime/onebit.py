@@ -45,7 +45,6 @@ class OneBitRunner:
                  hyper: Dict,
                  mesh,
                  axis: str,
-                 params_f32: PyTree,
                  apply_fn: Callable,
                  loss_fn: Callable,
                  gas: int,
@@ -101,16 +100,6 @@ class OneBitRunner:
             state["coeff_freeze"] = jax.device_put(scalar(0.0), rep)
             state["last_factor"] = jax.device_put(scalar(1.0), rep)
         return state
-
-    def state_shardings(self) -> Dict[str, PyTree]:
-        rep = NamedSharding(self.mesh, P())
-        sh = NamedSharding(self.mesh, P(self.axis))
-        like = {"m": rep, "v": rep, "w_err": sh, "s_err": sh}
-        if self.kind == "lamb":
-            like.update({"v_fresh": rep, "coeff_freeze": rep,
-                         "last_factor": rep})
-        # broadcast one sharding per leaf lazily at use sites
-        return like
 
     # -- the per-rank grad stage ---------------------------------------------
 
@@ -317,9 +306,13 @@ def hlo_collective_bytes(hlo_text: str) -> int:
             continue
         if mt.group(1) is not None:      # tuple result
             shapes = shape_pat.findall(mt.group(1))
+            if suffix == "-start" and len(shapes) > 1:
+                # async-start tuples are (operand, result[, ...]); the wire
+                # payload is the result — counting the operand too would
+                # double all-reduce and halve-undercount all-gather
+                shapes = shapes[-1:]
         else:
             shapes = [(mt.group(2), mt.group(3))]
-        sub = 0
         for dt, dims in shapes:
             if dt not in _DTYPE_BYTES:
                 continue
@@ -327,8 +320,5 @@ def hlo_collective_bytes(hlo_text: str) -> int:
             for d in dims.split(","):
                 if d.strip():
                     numel *= int(d)
-            sub += numel * _DTYPE_BYTES[dt]
-        if suffix == "-start" and mt.group(1) is not None:
-            sub //= 2                    # tuple holds operand + result copies
-        total += sub
+            total += numel * _DTYPE_BYTES[dt]
     return total
