@@ -1,0 +1,11 @@
+"""deepspeed_tpu.autotuning — automatic ds_config search.
+
+reference: deepspeed/autotuning/ (Autotuner + tuner/ search strategies +
+scheduler.py experiment runner).
+"""
+
+from .autotuner import (Autotuner, Experiment, GridSearchTuner, RandomTuner,
+                        engine_runner, subprocess_runner)
+
+__all__ = ["Autotuner", "Experiment", "GridSearchTuner", "RandomTuner",
+           "engine_runner", "subprocess_runner"]

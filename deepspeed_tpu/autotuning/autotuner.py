@@ -1,0 +1,254 @@
+"""Autotuner — searches ZeRO stage / micro-batch / remat configs for the
+fastest training setup.
+
+Capability parity with the reference's ``deepspeed/autotuning/autotuner.py``
+(Autotuner.tune:421 — tuning spaces per ZeRO stage, micro-batch sweeps,
+experiment scheduling, ranked results) + ``tuner/`` (grid / random /
+model-based search). TPU reshape: an *experiment* is just a ds_config dict;
+a *runner* executes it and returns metrics — in-process for tests and
+notebook use (engine_runner), or a subprocess launching the user's training
+script exactly like the reference's scheduler.py run_job (subprocess_runner;
+the engine exits after ``end_profile_step`` writing its metric file when
+DS_AUTOTUNING_METRIC_FILE is set).
+
+Failed experiments (OOM, bad composition) score -inf and are kept in the
+record with their error, matching the reference's error-result handling.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import json
+import os
+import random
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+
+METRIC_FILE_ENV = "DS_AUTOTUNING_METRIC_FILE"
+
+
+@dataclass
+class Experiment:
+    name: str
+    config: Dict[str, Any]
+    metrics: Optional[Dict[str, float]] = None
+    error: Optional[str] = None
+
+    @property
+    def score(self) -> float:
+        if self.metrics is None:
+            return float("-inf")
+        return self.metrics.get("throughput", float("-inf"))
+
+
+def default_tuning_space(base_config: Dict[str, Any],
+                         micro_batch_sizes: Optional[List[int]] = None,
+                         zero_stages: Optional[List[int]] = None,
+                         remat: Optional[List[bool]] = None) -> Dict[str, List]:
+    """The reference's DEFAULT_TUNING_SPACE equivalent: per-ZeRO-stage spaces
+    x micro-batch ladder x activation checkpointing."""
+    mbs = micro_batch_sizes or [1, 2, 4, 8, 16]
+    stages = zero_stages if zero_stages is not None else [0, 1, 2, 3]
+    return {
+        "train_micro_batch_size_per_gpu": mbs,
+        "zero_optimization.stage": stages,
+        "activation_checkpointing": remat if remat is not None else [False],
+    }
+
+
+def _set_path(cfg: Dict, dotted: str, value):
+    parts = dotted.split(".")
+    node = cfg
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+class GridSearchTuner:
+    """reference: tuner/index_based_tuner.py GridSearchTuner."""
+
+    def __init__(self, space: Dict[str, List]):
+        keys = list(space)
+        self._combos = [dict(zip(keys, vals))
+                        for vals in itertools.product(*(space[k] for k in keys))]
+
+    def __iter__(self):
+        return iter(self._combos)
+
+
+class RandomTuner:
+    """reference: tuner/index_based_tuner.py RandomTuner."""
+
+    def __init__(self, space: Dict[str, List], num_trials: int = 50,
+                 seed: int = 0):
+        combos = list(GridSearchTuner(space))
+        rng = random.Random(seed)
+        rng.shuffle(combos)
+        self._combos = combos[:num_trials]
+
+    def __iter__(self):
+        return iter(self._combos)
+
+
+class Autotuner:
+    """Experiment loop: generate -> run -> rank (reference autotuner.py:421).
+
+    runner(config_dict) -> metrics dict with at least {"throughput"} (samples
+    per second); raise or return None for a failed experiment.
+    """
+
+    def __init__(self,
+                 base_config: Dict[str, Any],
+                 runner: Callable[[Dict], Optional[Dict[str, float]]],
+                 tuning_space: Optional[Dict[str, List]] = None,
+                 tuner_type: str = "gridsearch",
+                 num_trials: int = 50,
+                 early_stopping: int = 0,
+                 results_dir: Optional[str] = None):
+        self.base_config = base_config
+        self.runner = runner
+        self.space = tuning_space or default_tuning_space(base_config)
+        if tuner_type in ("gridsearch", "grid"):
+            self.tuner = GridSearchTuner(self.space)
+        elif tuner_type == "random":
+            self.tuner = RandomTuner(self.space, num_trials)
+        else:
+            raise ValueError(f"unknown tuner_type '{tuner_type}' "
+                             "(gridsearch | random)")
+        self.early_stopping = early_stopping
+        self.results_dir = results_dir
+        self.experiments: List[Experiment] = []
+
+    def _materialize(self, overrides: Dict[str, Any]) -> Dict[str, Any]:
+        cfg = copy.deepcopy(self.base_config)
+        for dotted, val in overrides.items():
+            if dotted == "activation_checkpointing":
+                _set_path(cfg, "activation_checkpointing.partition_activations",
+                          bool(val))
+            else:
+                _set_path(cfg, dotted, val)
+        # micro batch sweeps re-derive gas from the fixed global batch
+        if "train_micro_batch_size_per_gpu" in overrides and \
+                "train_batch_size" in cfg:
+            cfg.pop("gradient_accumulation_steps", None)
+        return cfg
+
+    def tune(self) -> List[Experiment]:
+        best = float("-inf")
+        since_best = 0
+        for i, overrides in enumerate(self.tuner):
+            name = "exp_" + "_".join(
+                f"{k.split('.')[-1]}{v}" for k, v in overrides.items())
+            cfg = self._materialize(overrides)
+            exp = Experiment(name=name, config=cfg)
+            try:
+                exp.metrics = self.runner(cfg)
+            except Exception as e:  # OOM / invalid composition: record + go on
+                exp.error = f"{type(e).__name__}: {e}"
+                logger.warning("autotuning experiment %s failed: %s", name,
+                               exp.error[:200])
+            self.experiments.append(exp)
+            if exp.score > best:
+                best = exp.score
+                since_best = 0
+            else:
+                since_best += 1
+            logger.info("autotuning %s -> %s", name,
+                        exp.metrics or exp.error)
+            if self.early_stopping and since_best >= self.early_stopping:
+                logger.info("autotuning early stop after %d stale trials",
+                            since_best)
+                break
+        self.experiments.sort(key=lambda e: e.score, reverse=True)
+        if self.results_dir:
+            self.write_results(self.results_dir)
+        return self.experiments
+
+    def best(self) -> Optional[Experiment]:
+        return self.experiments[0] if self.experiments else None
+
+    def write_results(self, results_dir: str) -> str:
+        os.makedirs(results_dir, exist_ok=True)
+        path = os.path.join(results_dir, "autotuning_results.json")
+        with open(path, "w") as f:
+            json.dump([{"name": e.name, "metrics": e.metrics,
+                        "error": e.error, "config": e.config}
+                       for e in self.experiments], f, indent=2)
+        best = self.best()
+        if best and best.metrics is not None:
+            with open(os.path.join(results_dir, "best_config.json"), "w") as f:
+                json.dump(best.config, f, indent=2)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# runners
+# ---------------------------------------------------------------------------
+
+def engine_runner(model_factory: Callable[[], Any],
+                  batch_factory: Callable[[int], Any],
+                  steps: int = 5,
+                  warmup: int = 2) -> Callable[[Dict], Dict[str, float]]:
+    """In-process experiment runner: builds a fresh engine per config, times
+    `steps` train_batches. batch_factory(step) -> global batch."""
+    import time
+
+    import jax
+
+    def run(config: Dict) -> Dict[str, float]:
+        import deepspeed_tpu as ds
+        cfg = copy.deepcopy(config)
+        act = cfg.get("activation_checkpointing", {})
+        model = model_factory()
+        if act.get("partition_activations") and hasattr(model, "cfg"):
+            import dataclasses
+            model = type(model)(dataclasses.replace(model.cfg, remat=True))
+        engine, *_ = ds.initialize(model=model, config=cfg,
+                                   example_batch=batch_factory(0))
+        for i in range(warmup):
+            engine.train_batch(batch_factory(i))
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(steps):
+            loss = engine.train_batch(batch_factory(warmup + i))["loss"]
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / steps
+        bs = engine.train_batch_size
+        return {"throughput": bs / dt, "step_time": dt,
+                "train_batch_size": bs}
+
+    return run
+
+
+def subprocess_runner(cmd: List[str], exps_dir: str,
+                      timeout: int = 1800) -> Callable[[Dict], Dict[str, float]]:
+    """Script-mode runner (reference: scheduler.py run_job): writes the exp
+    ds_config, launches `cmd + ['--deepspeed_config', path]`, and reads the
+    metric file the engine writes at end_profile_step."""
+
+    def run(config: Dict) -> Dict[str, float]:
+        os.makedirs(exps_dir, exist_ok=True)
+        n = len(os.listdir(exps_dir))
+        cfg_path = os.path.join(exps_dir, f"exp_{n}_config.json")
+        metric_path = os.path.join(exps_dir, f"exp_{n}_metrics.json")
+        cfg = copy.deepcopy(config)
+        cfg.setdefault("autotuning", {})["enabled"] = True
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        env = dict(os.environ, **{METRIC_FILE_ENV: metric_path})
+        proc = subprocess.run(cmd + ["--deepspeed_config", cfg_path],
+                              env=env, capture_output=True, text=True,
+                              timeout=timeout)
+        if not os.path.exists(metric_path):
+            raise RuntimeError(
+                f"experiment produced no metric file (rc={proc.returncode}): "
+                f"{proc.stderr[-1000:]}")
+        with open(metric_path) as f:
+            return json.load(f)
+
+    return run

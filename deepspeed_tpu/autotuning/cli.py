@@ -1,0 +1,76 @@
+"""ds_autotune CLI — script-mode autotuning entry point.
+
+reference: `deepspeed --autotuning run user_script.py ...` (autotuning/README
+flow). Usage:
+
+    ds_autotune --config base_ds_config.json [--tuner gridsearch]
+        [--mbs 1,2,4,8] [--stages 0,1,2,3] [--remat] [--trials 50]
+        [--early-stopping 5] [--results-dir autotuning_results]
+        -- python train.py --my-args ...
+
+The command after ``--`` is launched once per experiment with
+``--deepspeed_config <exp.json>`` appended; the engine writes its measured
+throughput to $DS_AUTOTUNING_METRIC_FILE after autotuning.end_profile_step
+and exits (runtime/engine.py _autotuning_hook).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .autotuner import Autotuner, default_tuning_space, subprocess_runner
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" in argv:
+        split = argv.index("--")
+        argv, cmd = argv[:split], argv[split + 1:]
+    else:
+        cmd = []
+    p = argparse.ArgumentParser(prog="ds_autotune")
+    p.add_argument("--config", required=True, help="base ds_config json")
+    p.add_argument("--tuner", default="gridsearch",
+                   choices=["gridsearch", "random"])
+    p.add_argument("--mbs", default="", help="micro batch sizes, comma-sep")
+    p.add_argument("--stages", default="", help="zero stages, comma-sep")
+    p.add_argument("--remat", action="store_true",
+                   help="also try activation checkpointing on")
+    p.add_argument("--trials", type=int, default=50)
+    p.add_argument("--early-stopping", type=int, default=0)
+    p.add_argument("--exps-dir", default="autotuning_exps")
+    p.add_argument("--results-dir", default="autotuning_results")
+    p.add_argument("--timeout", type=int, default=1800)
+    args = p.parse_args(argv)
+    if not cmd:
+        p.error("pass the training command after '--'")
+
+    with open(args.config) as f:
+        base = json.load(f)
+    space = default_tuning_space(
+        base,
+        micro_batch_sizes=([int(x) for x in args.mbs.split(",")]
+                           if args.mbs else None),
+        zero_stages=([int(x) for x in args.stages.split(",")]
+                     if args.stages else None),
+        remat=[False, True] if args.remat else [False])
+    tuner = Autotuner(base, subprocess_runner(cmd, args.exps_dir,
+                                              args.timeout),
+                      tuning_space=space, tuner_type=args.tuner,
+                      num_trials=args.trials,
+                      early_stopping=args.early_stopping,
+                      results_dir=args.results_dir)
+    exps = tuner.tune()
+    best = tuner.best()
+    print(f"ran {len(exps)} experiments; results in {args.results_dir}")
+    if best is not None and best.metrics is not None:
+        print(f"best: {best.name} -> {best.metrics}")
+    else:
+        print("no experiment succeeded")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

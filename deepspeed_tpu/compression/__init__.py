@@ -1,0 +1,12 @@
+"""deepspeed_tpu.compression — QAT, pruning, layer reduction.
+
+reference: deepspeed/compression/ (compress.py + basic_layer.py + config.py).
+"""
+
+from .compress import (CompressionGroup, CompressionSpec, apply_compression,
+                       apply_layer_reduction, export_int8, init_compression,
+                       parse_compression_config)
+
+__all__ = ["CompressionSpec", "CompressionGroup", "init_compression",
+           "parse_compression_config", "apply_compression",
+           "apply_layer_reduction", "export_int8"]

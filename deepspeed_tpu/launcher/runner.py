@@ -164,6 +164,11 @@ def parse_args(argv=None):
     p.add_argument("--master_port", type=int, default=29500)
     p.add_argument("--master_addr", default="")
     p.add_argument("--launcher", default="ssh", choices=["ssh", "local"])
+    p.add_argument("--autotuning", default="", choices=["", "run", "tune"],
+                   help="search ds_configs instead of launching directly "
+                        "(reference: deepspeed --autotuning)")
+    p.add_argument("--deepspeed_config", default="",
+                   help="base ds_config for --autotuning mode")
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -171,6 +176,22 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.autotuning:
+        if not args.deepspeed_config:
+            sys.exit("--autotuning requires --deepspeed_config")
+        from ..autotuning.cli import main as autotune_main
+        results_dir = "autotuning_results"
+        autotune_main(["--config", args.deepspeed_config,
+                       "--results-dir", results_dir, "--",
+                       sys.executable, args.user_script] + args.user_args)
+        if args.autotuning == "run":
+            # tune-then-train: relaunch the script with the winning config
+            # (reference: --autotuning run vs tune distinction)
+            best = os.path.join(results_dir, "best_config.json")
+            cmd = [sys.executable, args.user_script] + args.user_args + \
+                ["--deepspeed_config", best]
+            os.execvpe(cmd[0], cmd, os.environ.copy())
+        return
     pool = fetch_hostfile(args.hostfile)
     if not pool:
         # single node, all local chips

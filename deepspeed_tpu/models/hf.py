@@ -47,7 +47,7 @@ def load_hf_gpt2(model_or_state_dict,
     if config is None:
         raise ValueError("pass the HF config when giving a raw state_dict")
 
-    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    prefix = _prefix(sd, "transformer.")
     g = lambda name: _np(sd[prefix + name])
 
     L = config.n_layer
@@ -62,8 +62,10 @@ def load_hf_gpt2(model_or_state_dict,
         layer_norm_eps=float(config.layer_norm_epsilon),
     )
 
+    _stk = _stacker(g, L)
+
     def stack(name):
-        return np.stack([g(f"h.{i}.{name}") for i in range(L)])
+        return _stk(lambda i: g(f"h.{i}.{name}"))
 
     blocks = {
         "ln1": {"scale": stack("ln_1.weight"), "bias": stack("ln_1.bias")},
@@ -89,6 +91,30 @@ def load_hf_gpt2(model_or_state_dict,
     return params, cfg
 
 
+
+def _prefix(sd, candidate: str) -> str:
+    """Detect whether keys carry the wrapper prefix (model vs bare decoder)."""
+    return candidate if any(k.startswith(candidate) for k in sd) else ""
+
+
+def _stacker(g, L: int):
+    """Per-layer getter -> stacked [L, ...] leaf."""
+    return lambda fn: np.stack([fn(i) for i in range(L)])
+
+
+def _concat_qkv_linear(g, fmt: str, names=("q", "k", "v")):
+    """Separate torch Linear projections -> one [H, 3H] flax qkv kernel."""
+    def kernel(i):
+        return np.concatenate([g(fmt.format(i=i, p=p)).T for p in names],
+                              axis=1)
+
+    def bias(i):
+        return np.concatenate([g(fmt.format(i=i, p=p).replace(
+            ".weight", ".bias")) for p in names])
+
+    return kernel, bias
+
+
 def _sd_and_config(model_or_state_dict, config):
     if hasattr(model_or_state_dict, "state_dict"):
         return (dict(model_or_state_dict.state_dict()),
@@ -103,7 +129,7 @@ def load_hf_gpt_neo(model_or_state_dict, config=None):
     concat into our qkv kernel; unscaled attention (attn_scale=1.0);
     alternating global/local attention layers become layer_windows."""
     sd, config = _sd_and_config(model_or_state_dict, config)
-    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    prefix = _prefix(sd, "transformer.")
     g = lambda n: _np(sd[prefix + n])
     L = config.num_layers
     # config.attention_layers: ["global", "local", ...] per layer
@@ -130,8 +156,7 @@ def load_hf_gpt_neo(model_or_state_dict, config=None):
               for p in ("q", "k", "v")]
         return np.concatenate(ws, axis=1)                    # [H, 3H]
 
-    def stack(fn):
-        return np.stack([fn(i) for i in range(L)])
+    stack = _stacker(g, L)
 
     blocks = {
         "ln1": {"scale": stack(lambda i: g(f"h.{i}.ln_1.weight")),
@@ -160,7 +185,7 @@ def load_hf_gptj(model_or_state_dict, config=None):
     """GPT-J (HF GPTJForCausalLM): rotary positions, parallel attention+MLP
     residual off one shared LayerNorm, untied biased lm_head."""
     sd, config = _sd_and_config(model_or_state_dict, config)
-    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    prefix = _prefix(sd, "transformer.")
     g = lambda n: _np(sd[prefix + n])
     L = config.n_layer
     cfg = TransformerConfig(
@@ -186,8 +211,7 @@ def load_hf_gptj(model_or_state_dict, config=None):
         ws = [g(f"h.{i}.attn.{p}_proj.weight").T for p in ("q", "k", "v")]
         return np.concatenate(ws, axis=1)
 
-    def stack(fn):
-        return np.stack([fn(i) for i in range(L)])
+    stack = _stacker(g, L)
 
     blocks = {
         "ln1": {"scale": stack(lambda i: g(f"h.{i}.ln_1.weight")),
@@ -215,8 +239,7 @@ def load_hf_opt(model_or_state_dict, config=None):
     positions at a +2 offset — the offset is baked by dropping the embedding
     table's first two rows."""
     sd, config = _sd_and_config(model_or_state_dict, config)
-    prefix = ("model.decoder." if any(k.startswith("model.decoder.")
-                                      for k in sd) else "decoder.")
+    prefix = _prefix(sd, "model.decoder.") or "decoder."
     g = lambda n: _np(sd[prefix + n])
     if not getattr(config, "do_layer_norm_before", True):
         raise NotImplementedError("OPT with do_layer_norm_before=False "
@@ -247,8 +270,7 @@ def load_hf_opt(model_or_state_dict, config=None):
         bs = [g(f"layers.{i}.self_attn.{p}_proj.bias") for p in ("q", "k", "v")]
         return np.concatenate(bs)
 
-    def stack(fn):
-        return np.stack([fn(i) for i in range(L)])
+    stack = _stacker(g, L)
 
     blocks = {
         "ln1": {"scale": stack(
@@ -285,7 +307,7 @@ def load_hf_bloom(model_or_state_dict, config=None, max_seq_len=None):
     bound: defaults to the config's training length (seq_length, 2048 for
     released BLOOMs); pass max_seq_len to extrapolate longer."""
     sd, config = _sd_and_config(model_or_state_dict, config)
-    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    prefix = _prefix(sd, "transformer.")
     g = lambda n: _np(sd[prefix + n])
     L = config.n_layer
     H = config.hidden_size
@@ -314,8 +336,7 @@ def load_hf_bloom(model_or_state_dict, config=None, max_seq_len=None):
         b = g(f"h.{i}.self_attention.query_key_value.bias")
         return b.reshape(nh, 3, hd).transpose(1, 0, 2).reshape(3 * H)
 
-    def stack(fn):
-        return np.stack([fn(i) for i in range(L)])
+    stack = _stacker(g, L)
 
     blocks = {
         "ln1": {"scale": stack(lambda i: g(f"h.{i}.input_layernorm.weight")),
@@ -348,7 +369,7 @@ def load_hf_bert(model_or_state_dict, config=None):
     """BERT (HF BertForMaskedLM): post-LN encoder with token-type embeddings
     and the MLM prediction head (transform + tied decoder + bias)."""
     sd, config = _sd_and_config(model_or_state_dict, config)
-    prefix = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    prefix = _prefix(sd, "bert.")
     g = lambda n: _np(sd[prefix + n])
     L = config.num_hidden_layers
     act = {"gelu": "gelu_exact", "gelu_new": "gelu", "relu": "relu"}[
@@ -382,8 +403,7 @@ def load_hf_bert(model_or_state_dict, config=None):
             [g(f"{enc}{i}.attention.self.{p}.bias")
              for p in ("query", "key", "value")])
 
-    def stack(fn):
-        return np.stack([fn(i) for i in range(L)])
+    stack = _stacker(g, L)
 
     blocks = {
         "attn_qkv": {"kernel": stack(qkv_w), "bias": stack(qkv_b)},
@@ -444,10 +464,12 @@ HF_POLICIES = {
 
 def load_hf(model, arch: str = None):
     """Dispatch on HF architecture name (reference: replace_module.py policy
-    matching by class)."""
+    matching by class). Exact matches only: substring matching misfires on
+    sibling arches (GPTNeoX contains 'gptneo', Roberta contains 'bert')."""
     arch = arch or type(model).__name__
-    for key, fn in HF_POLICIES.items():
-        if key.lower() in arch.lower():
-            return fn(model)
+    fn = HF_POLICIES.get(arch) or HF_POLICIES.get(arch.lower())
+    if fn is not None:
+        return fn(model)
     raise NotImplementedError(
-        f"no import policy for architecture '{arch}'; have {list(HF_POLICIES)}")
+        f"no import policy for architecture '{arch}'; have "
+        f"{sorted(k for k in HF_POLICIES if not k.islower())}")

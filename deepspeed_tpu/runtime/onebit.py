@@ -290,9 +290,10 @@ _DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
 def hlo_collective_bytes(hlo_text: str) -> int:
     """Sum output bytes of cross-replica collective ops in optimized HLO.
 
-    Async pairs are handled: '-start' ops carry an (operand, result) tuple —
-    counted at half — and '-done' ops (which alias the start's buffers) are
-    skipped, so bytes aren't double- or triple-counted on real TPU HLO."""
+    Async pairs are handled: '-start' op tuples are (operand, result, ...
+    context scalars) — only the result (element 1) is counted — and '-done'
+    ops (which alias the start's buffers) are skipped, so bytes aren't
+    double- or triple-counted on real TPU HLO."""
     import re
     total = 0
     pat = re.compile(
@@ -307,10 +308,10 @@ def hlo_collective_bytes(hlo_text: str) -> int:
         if mt.group(1) is not None:      # tuple result
             shapes = shape_pat.findall(mt.group(1))
             if suffix == "-start" and len(shapes) > 1:
-                # async-start tuples are (operand, result[, ...]); the wire
-                # payload is the result — counting the operand too would
-                # double all-reduce and halve-undercount all-gather
-                shapes = shapes[-1:]
+                # async-start tuples are (operand, result[, u32 context
+                # scalars]); the wire payload is the RESULT at index 1 —
+                # the last element can be a context scalar
+                shapes = shapes[1:2]
         else:
             shapes = [(mt.group(2), mt.group(3))]
         for dt, dims in shapes:

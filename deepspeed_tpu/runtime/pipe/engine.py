@@ -65,7 +65,8 @@ class PipelineEngine(DeepSpeedEngine):
                  if hasattr(data_iter_or_batch, "__next__")
                  else data_iter_or_batch)
         batch = self.shard_batch(batch)
-        return self._eval_step(self.state.params, batch, self.next_rng())
+        return self._eval_step(self.state.params, batch, self.next_rng(),
+                               self.state.step)
 
     # the reference redirects these for pipeline engines (engine.py:1246-1256)
     def forward(self, *a, **k):

@@ -1,0 +1,113 @@
+"""Autotuning tests: tuner enumeration, experiment ranking with failures,
+in-process engine runner on the CPU mesh, and the script-mode metric hook.
+
+Mirrors the reference's tests/unit/autotuning coverage of tuning-space
+generation + the scheduler's result handling.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import (Autotuner, GridSearchTuner, RandomTuner,
+                                      engine_runner)
+from deepspeed_tpu.autotuning.autotuner import default_tuning_space
+
+from util import SimpleModel, random_batch
+
+
+def test_grid_tuner_enumerates_product():
+    space = {"a": [1, 2], "b.c": [10, 20, 30]}
+    combos = list(GridSearchTuner(space))
+    assert len(combos) == 6
+    assert {"a": 1, "b.c": 30} in combos
+
+
+def test_random_tuner_caps_trials():
+    space = {"a": list(range(10)), "b": list(range(10))}
+    assert len(list(RandomTuner(space, num_trials=7))) == 7
+
+
+def test_autotuner_ranks_and_records_failures(tmp_path):
+    calls = []
+
+    def runner(cfg):
+        mb = cfg["train_micro_batch_size_per_gpu"]
+        calls.append(mb)
+        if mb == 4:
+            raise MemoryError("simulated OOM")
+        return {"throughput": float(mb * 100)}
+
+    base = {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 1}
+    tuner = Autotuner(base, runner,
+                      tuning_space={"train_micro_batch_size_per_gpu": [1, 2, 4]},
+                      results_dir=str(tmp_path))
+    exps = tuner.tune()
+    assert [e.name for e in exps][0].endswith("2")       # mb=2 wins
+    failed = [e for e in exps if e.error]
+    assert len(failed) == 1 and "OOM" in failed[0].error
+    results = json.load(open(tmp_path / "autotuning_results.json"))
+    assert len(results) == 3
+    best = json.load(open(tmp_path / "best_config.json"))
+    assert best["train_micro_batch_size_per_gpu"] == 2
+
+
+def test_engine_runner_on_cpu_mesh(tmp_path):
+    """End-to-end: grid over micro-batch x ZeRO stage with real engines;
+    every experiment must produce a throughput."""
+    base = {"train_batch_size": 16,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+    space = {"train_micro_batch_size_per_gpu": [1, 2],
+             "zero_optimization.stage": [0, 1]}
+    runner = engine_runner(lambda: SimpleModel(),
+                           lambda i: random_batch(16, seed=i), steps=3,
+                           warmup=1)
+    tuner = Autotuner(base, runner, tuning_space=space,
+                      results_dir=str(tmp_path))
+    exps = tuner.tune()
+    assert len(exps) == 4
+    assert all(e.metrics is not None for e in exps), \
+        [(e.name, e.error) for e in exps]
+    assert exps[0].score >= exps[-1].score
+
+
+def test_script_mode_metric_hook(tmp_path):
+    """The engine must write its metric file and exit at end_profile_step
+    when launched under the autotuner (reference: autotuning exit path)."""
+    script = tmp_path / "train.py"
+    script.write_text("""
+import json, os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tests!r})
+import deepspeed_tpu as ds
+from util import SimpleModel, random_batch
+cfg = json.load(open(sys.argv[sys.argv.index("--deepspeed_config") + 1]))
+engine, *_ = ds.initialize(model=SimpleModel(), config=cfg,
+                           example_batch=random_batch(8))
+for i in range(100):
+    engine.train_batch(random_batch(8, seed=i))
+raise SystemExit("engine did not exit at end_profile_step")
+""".format(repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+           tests=os.path.dirname(os.path.abspath(__file__))))
+    cfg_path = tmp_path / "base.json"
+    cfg_path.write_text(json.dumps({
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "autotuning": {"enabled": True, "end_profile_step": 4},
+    }))
+    metric_path = tmp_path / "metrics.json"
+    env = dict(os.environ, DS_AUTOTUNING_METRIC_FILE=str(metric_path))
+    proc = subprocess.run(
+        [sys.executable, str(script), "--deepspeed_config", str(cfg_path)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    metrics = json.load(open(metric_path))
+    assert metrics["throughput"] > 0
+    assert metrics["steps"] == 4

@@ -1,0 +1,179 @@
+"""Compression training tests: config parsing, QAT schedule gating, pruning
+masks, layer reduction, int8 export, and end-to-end engine QAT training.
+
+Mirrors the reference's tests/unit/test_compression.py coverage of
+init_compression + LinearLayer_Compress behaviors.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.compression import (apply_compression,
+                                       apply_layer_reduction, export_int8,
+                                       init_compression,
+                                       parse_compression_config)
+
+from util import SimpleModel, random_batch
+
+
+def _wq_config(bits=8, offset=0, modules=(".*kernel.*",), period=0,
+               start_bits=None):
+    return {"weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": offset,
+                              "quantization_type": "symmetric"},
+        "different_groups": {"wq1": {
+            "params": {"start_bits": start_bits or bits, "target_bits": bits,
+                       "quantization_period": period},
+            "modules": list(modules)}}}}
+
+
+def test_parse_config_groups():
+    spec = parse_compression_config({
+        **_wq_config(8),
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5},
+            "different_groups": {"sp1": {"params": {"dense_ratio": 0.5},
+                                         "modules": ["Dense_0"]}}},
+    })
+    assert spec.enabled
+    kinds = sorted(g.kind for g in spec.groups)
+    assert kinds == ["sparse_pruning", "weight_quantization"]
+    sp = [g for g in spec.groups if g.kind == "sparse_pruning"][0]
+    assert sp.dense_ratio == 0.5 and sp.schedule_offset == 5
+
+
+def test_schedule_offset_gates_quantization():
+    spec = init_compression({"compression_training": _wq_config(4, offset=10)})
+    w = {"layer": {"kernel": jnp.asarray(
+        np.random.RandomState(0).randn(16, 16), jnp.float32)}}
+    before = apply_compression(w, spec, jnp.asarray(5))
+    after = apply_compression(w, spec, jnp.asarray(10))
+    np.testing.assert_array_equal(np.asarray(before["layer"]["kernel"]),
+                                  np.asarray(w["layer"]["kernel"]))
+    assert not np.allclose(np.asarray(after["layer"]["kernel"]),
+                           np.asarray(w["layer"]["kernel"]))
+    # 4-bit: at most 15 distinct levels per group
+    assert len(np.unique(np.asarray(after["layer"]["kernel"]))) <= 15
+
+
+def test_bit_schedule_halves_to_target():
+    """start 16 -> target 4 halving every 10 steps (reference bit schedule)."""
+    spec = init_compression({"compression_training": _wq_config(
+        4, offset=0, period=10, start_bits=16)})
+    w = {"k": {"kernel": jnp.asarray(
+        np.random.RandomState(1).randn(64, 8), jnp.float32)}}
+
+    def levels(step):
+        out = apply_compression(w, spec, jnp.asarray(step, jnp.float32))
+        return len(np.unique(np.asarray(out["k"]["kernel"])))
+
+    assert levels(0) > levels(10) > levels(20)      # 16b -> 8b -> 4b
+    assert levels(20) <= 15 and levels(100) <= 15   # floor at 4 bits
+
+
+def test_ste_gradients_flow():
+    spec = init_compression({"compression_training": _wq_config(8)})
+    w = {"m": {"kernel": jnp.ones((8, 8))}}
+
+    def loss(params):
+        c = apply_compression(params, spec, jnp.asarray(1))
+        return jnp.sum(c["m"]["kernel"] ** 2)
+
+    g = jax.grad(loss)(w)
+    assert np.all(np.isfinite(np.asarray(g["m"]["kernel"])))
+    assert np.abs(np.asarray(g["m"]["kernel"])).sum() > 0
+
+
+def test_sparse_and_row_pruning_masks():
+    cfgd = {
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"sp": {"params": {"dense_ratio": 0.25},
+                                        "modules": ["sparse/kernel"]}}},
+        "row_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"rp": {"params": {"dense_ratio": 0.5},
+                                        "modules": ["rows/kernel"]}}},
+    }
+    spec = init_compression({"compression_training": cfgd})
+    rng = np.random.RandomState(2)
+    params = {"sparse": {"kernel": jnp.asarray(rng.randn(32, 32), jnp.float32)},
+              "rows": {"kernel": jnp.asarray(rng.randn(16, 8), jnp.float32)}}
+    out = apply_compression(params, spec, jnp.asarray(1))
+    sp = np.asarray(out["sparse"]["kernel"])
+    assert abs((sp == 0).mean() - 0.75) < 0.02
+    rp = np.asarray(out["rows"]["kernel"])
+    zero_rows = (np.abs(rp).sum(axis=1) == 0).sum()
+    assert zero_rows == 8
+
+
+def test_head_pruning_zeroes_head_blocks():
+    cfgd = {"head_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"hp": {
+            "params": {"dense_ratio": 0.5, "num_heads": 4},
+            "modules": ["attn_proj/kernel"]}}}}
+    spec = init_compression({"compression_training": cfgd})
+    w = {"attn_proj": {"kernel": jnp.asarray(
+        np.random.RandomState(3).randn(16, 8), jnp.float32)}}
+    out = np.asarray(apply_compression(w, spec, jnp.asarray(1))
+                     ["attn_proj"]["kernel"])
+    per = 4  # 16 rows / 4 heads
+    head_zero = [np.abs(out[h * per:(h + 1) * per]).sum() == 0
+                 for h in range(4)]
+    assert sum(head_zero) == 2
+
+
+def test_layer_reduction_student_init():
+    from deepspeed_tpu.models import build_model
+    model, cfg = build_model("gpt2-tiny", num_layers=4, dtype=jnp.float32,
+                             attention_impl="reference")
+    ids = np.random.default_rng(4).integers(0, cfg.vocab_size, (2, 8))
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": jnp.asarray(ids)})["params"]
+    student = apply_layer_reduction(params, [0, 3])
+    assert student["blocks"]["attn_qkv"]["kernel"].shape[0] == 2
+    s_model, _ = build_model("gpt2-tiny", num_layers=2, dtype=jnp.float32,
+                             attention_impl="reference")
+    logits = s_model.apply({"params": student},
+                           {"input_ids": jnp.asarray(ids)})
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_int8_export_roundtrip():
+    spec = init_compression({"compression_training": _wq_config(8)})
+    w = {"m": {"kernel": jnp.asarray(
+        np.random.RandomState(5).randn(32, 32), jnp.float32)}}
+    exported = export_int8(w, spec)
+    assert exported["m/kernel.int8"].dtype == np.int8
+    deq = exported["m/kernel.int8"].astype(np.float32) * \
+        exported["m/kernel.scale"]
+    err = np.abs(deq - np.asarray(w["m"]["kernel"])).max()
+    assert err < 0.05
+
+
+def test_engine_qat_training_tracks_fp():
+    """End to end: QAT through the engine config; loss decreases and stays
+    near the fp run (reference 'Done' criterion)."""
+    base = {"train_batch_size": 16,
+            "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+            "seed": 3}
+    qat = dict(base, compression_training=_wq_config(
+        8, offset=3, modules=["kernel"]))
+    e_fp, *_ = ds.initialize(model=SimpleModel(), config=base,
+                             example_batch=random_batch(16))
+    e_q, *_ = ds.initialize(model=SimpleModel(), config=qat,
+                            example_batch=random_batch(16))
+    assert e_q.compression_spec is not None
+    fp, q = [], []
+    for i in range(15):
+        b = random_batch(16, seed=i)
+        fp.append(float(e_fp.train_batch(b)["loss"]))
+        q.append(float(e_q.train_batch(b)["loss"]))
+    assert q[-1] < q[0]
+    assert abs(np.mean(q[-3:]) - np.mean(fp[-3:])) < 0.25, (fp[-3:], q[-3:])
