@@ -1,0 +1,131 @@
+"""Pluggable checkpoint engines — sync, async (thread-offloaded) writers.
+
+Capability parity with the reference's
+``deepspeed/runtime/checkpoint_engine/checkpoint_engine.py`` (CheckpointEngine
+ABC: create/save/load/commit) + the Nebula async engine (``nebula/``): the
+engine abstraction lets save_checkpoint hand tensors to a writer that
+persists them off the training thread; ``commit`` is the durability barrier.
+
+The async engine gathers device arrays to host SYNCHRONOUSLY (cheap D2H,
+and the training loop would otherwise race donated buffers) and performs
+file IO on a worker thread — the part worth hiding, exactly what the
+reference offloads to Nebula's service.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+
+
+class CheckpointEngine:
+    """reference: checkpoint_engine.py:19 — create/save/load/commit."""
+
+    # sync engines may receive lazy (thunk-valued) flat dicts and stream
+    # leaf-by-leaf; async engines need materialized arrays (the training
+    # thread would otherwise race donated device buffers)
+    wants_lazy = True
+
+    def create(self, tag: str) -> None:
+        """Start of a checkpoint under ``tag`` (logging/bookkeeping hook)."""
+
+    def run(self, fn: Callable[[], Any]) -> None:
+        """Execute ``fn`` with this engine's ordering guarantees (async:
+        after all previously submitted saves)."""
+        fn()
+
+    def save(self, state_dict: Dict[str, Any], path: str) -> None:
+        raise NotImplementedError
+
+    def load(self, path: str, map_location=None) -> Dict[str, Any]:
+        from ..runtime.checkpointing import read_flat_npz
+        return read_flat_npz(path)
+
+    def commit(self, tag: str) -> bool:
+        """Durability barrier: returns when everything under ``tag`` is on
+        disk (reference: engine.commit for Nebula's async persistence)."""
+        return True
+
+
+class NpzCheckpointEngine(CheckpointEngine):
+    """Synchronous writer (the reference's TorchCheckpointEngine role)."""
+
+    def save(self, state_dict: Dict[str, Any], path: str) -> None:
+        from ..runtime.checkpointing import write_flat_npz
+        write_flat_npz(state_dict, path)
+
+
+class AsyncCheckpointEngine(CheckpointEngine):
+    """File IO on a worker thread; commit() joins all pending writes.
+
+    reference: nebula/ async persistence + checkpoint/constants tagging.
+    """
+
+    wants_lazy = False
+
+    def __init__(self):
+        # one worker => FIFO: anything run() after save() lands after it —
+        # the `latest`-after-data guarantee depends on this, so the worker
+        # count is not configurable
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="ckpt-writer")
+        self._pending: List[Future] = []
+        self._lock = threading.Lock()
+        self._failed = False
+
+    def save(self, state_dict: Dict[str, Any], path: str) -> None:
+        from ..runtime.checkpointing import write_flat_npz
+
+        def job():
+            write_flat_npz(state_dict, path)
+            return path
+
+        self.run(job)
+
+    def run(self, fn: Callable[[], Any]) -> None:
+        # later jobs (e.g. the `latest` tag update) must not run after an
+        # earlier write failed — `latest` would point at a corrupt checkpoint
+        def guarded():
+            if self._failed:
+                raise RuntimeError(
+                    "skipped: an earlier checkpoint write failed")
+            try:
+                return fn()
+            except Exception:
+                self._failed = True
+                raise
+
+        with self._lock:
+            self._pending.append(self._pool.submit(guarded))
+
+    def commit(self, tag: str) -> bool:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        ok = True
+        for f in pending:
+            try:
+                f.result()
+            except Exception as e:
+                logger.error("async checkpoint write failed: %s", e)
+                ok = False
+        self._failed = False
+        return ok
+
+    def __del__(self):
+        try:
+            self._pool.shutdown(wait=False)
+        except Exception:
+            pass
+
+
+def build_checkpoint_engine(config) -> CheckpointEngine:
+    """Pick the writer from the ds_config (checkpoint.async_save, or the
+    nebula section as its alias)."""
+    async_save = bool(getattr(config.checkpoint, "async_save", False))
+    if getattr(config, "nebula", None) is not None and config.nebula.enabled:
+        async_save = True
+    return AsyncCheckpointEngine() if async_save else NpzCheckpointEngine()

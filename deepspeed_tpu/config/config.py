@@ -497,11 +497,19 @@ def unconsumed_sections(cfg: "DeepSpeedConfig") -> List[str]:
     if zo.offload_param is not None and zo.offload_param.device != "none":
         out.append("zero_optimization.offload_param")
     if cfg.compression_training.layer_reduction.get("enabled"):
-        out.append("compression_training.layer_reduction")
-    if cfg.data_efficiency.enabled:
-        out.append("data_efficiency")
-    if cfg.curriculum_learning.enabled:
-        out.append("curriculum_learning")
+        out.append("compression_training.layer_reduction (apply explicitly "
+                   "via compression.apply_layer_reduction)")
+    if (cfg.data_efficiency.data_routing or {}).get(
+            "random_ltd", {}).get("enabled"):
+        out.append("data_efficiency.data_routing.random_ltd (set the model's "
+                   "ltd_tokens/ltd_start/ltd_end config instead)")
+    for key, sub in (cfg.data_efficiency.data_sampling or {}).items():
+        # the engine consumes only the seqlen curriculum; any other enabled
+        # sampling feature must not no-op silently
+        if key != "curriculum_learning" and isinstance(sub, dict) \
+                and sub.get("enabled"):
+            out.append(f"data_efficiency.data_sampling.{key} "
+                       "(use runtime.data_pipeline.DeepSpeedDataSampler)")
     if cfg.eigenvalue.enabled:
         out.append("eigenvalue")
     if cfg.progressive_layer_drop.enabled:

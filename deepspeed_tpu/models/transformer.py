@@ -82,6 +82,13 @@ class TransformerConfig:
     attn_out_bias: Optional[bool] = None  # None = use_bias (GPT-J: False)
     # per-layer local attention window, 0 = global (GPT-Neo alternates 0/256)
     layer_windows: Optional[Tuple[int, ...]] = None
+    # random-LTD (reference: data_pipeline/data_routing + csrc/random_ltd):
+    # layers in [ltd_start, ltd_end) process only ltd_tokens randomly-sampled
+    # tokens per step; the rest pass through on the residual. Requires
+    # scan_layers=False (the token subset changes the layer's shapes).
+    ltd_tokens: int = 0
+    ltd_start: int = 0
+    ltd_end: int = 0
     # MoE (reference: deepspeed/moe/*): >0 replaces every block's MLP with a
     # mixture of moe_experts experts; aux loss returned next to the logits
     moe_experts: int = 0
@@ -347,6 +354,9 @@ class Transformer(nn.Module):
             input_ids, attention_mask, position_ids = batch, None, None
         B, S = input_ids.shape
 
+        if cfg.ltd_tokens > 0 and cfg.scan_layers:
+            raise ValueError("random-LTD needs scan_layers=False (the token "
+                             "subset changes layer shapes per depth)")
         wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                        param_dtype=jnp.float32, name="wte")
         if position_ids is None:
@@ -403,10 +413,33 @@ class Transformer(nn.Module):
             aux_total = jnp.sum(auxes)
         else:
             aux_total = jnp.zeros((), jnp.float32)
+            ltd_active = (train and cfg.ltd_tokens > 0
+                          and cfg.ltd_end > cfg.ltd_start)
+            if ltd_active and cfg.layer_windows is not None:
+                raise ValueError(
+                    "random-LTD + layer_windows is unsupported: the local "
+                    "window would apply to compacted subset indices, voiding "
+                    "the true token-distance constraint")
             for i in range(cfg.num_layers):
                 w = windows[i] if windows is not None else None
-                x, aux = block(cfg, name=f"blocks_{i}")(x, attn_mask, train,
-                                                        w, position_ids)
+                blk = block(cfg, name=f"blocks_{i}")
+                if ltd_active and cfg.ltd_start <= i < cfg.ltd_end \
+                        and cfg.ltd_tokens < S:
+                    # random-LTD: this layer sees only a sampled token subset
+                    # (sorted to keep causal order); dropped tokens ride the
+                    # residual stream unchanged (reference: random_ltd
+                    # gather/scatter kernels, csrc/random_ltd)
+                    r = self.make_rng("gating")
+                    idx = jnp.sort(jax.random.permutation(
+                        jax.random.fold_in(r, i), S)[:cfg.ltd_tokens])
+                    x_kept = jnp.take(x, idx, axis=1)
+                    mask_kept = (attn_mask[..., idx]
+                                 if attn_mask is not None else None)
+                    out, aux = blk(x_kept, mask_kept, train, w,
+                                   jnp.take(position_ids, idx, axis=1))
+                    x = x.at[:, idx].set(out)
+                else:
+                    x, aux = blk(x, attn_mask, train, w, position_ids)
                 aux_total = aux_total + aux
 
         if not cfg.post_ln:

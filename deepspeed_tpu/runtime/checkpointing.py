@@ -17,7 +17,8 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import zipfile
+from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import jax
 import numpy as np
@@ -26,6 +27,16 @@ from ..utils.logging import logger
 from ..utils.partitioning import path_str
 
 LATEST_FILE = "latest"
+_DTYPES_KEY = "__dtypes__"
+
+try:
+    import ml_dtypes
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    _BF16 = None
+
+_NATIVE_DTYPES = (np.float32, np.float64, np.float16, np.int32, np.int64,
+                  np.int8, np.uint8, np.uint16, np.bool_)
 
 
 def _gather_leaf(leaf) -> np.ndarray:
@@ -36,16 +47,54 @@ def _gather_leaf(leaf) -> np.ndarray:
     return np.asarray(jax.device_get(leaf))
 
 
-def _tree_to_flat_dict(tree) -> Dict[str, np.ndarray]:
+def _tree_to_flat_dict(tree, lazy: bool = False
+                       ) -> Dict[str, Union[np.ndarray, Callable]]:
+    """Name-keyed view of a pytree. ``lazy=True`` defers each leaf's gather
+    to a thunk so the streaming writer holds ONE leaf on host at a time —
+    round-1 Weak #6: the eager whole-model gather was ~80GB host RAM for the
+    6.7B ladder config."""
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        arr = _gather_leaf(leaf)
-        # npz has no bfloat16 (ml_dtypes) support — store as f32 (lossless up-cast);
-        # load_tree casts back to the model's dtype.
-        if arr.dtype not in (np.float32, np.float64, np.float16, np.int32, np.int64,
-                             np.int8, np.uint8, np.bool_):
-            arr = arr.astype(np.float32)
-        flat[path_str(path)] = arr
+        if lazy:
+            flat[path_str(path)] = (lambda l=leaf: _gather_leaf(l))
+        else:
+            flat[path_str(path)] = _gather_leaf(leaf)
+    return flat
+
+
+def write_flat_npz(flat: Dict[str, Union[np.ndarray, Callable]],
+                   path: str) -> None:
+    """Streaming npz writer: arrays (or thunks producing them) are written
+    into the zip one at a time and freed. bfloat16 is stored AS bf16 (uint16
+    bit pattern + a dtype manifest) — no 2x f32 upcast (round-1 Weak #6)."""
+    from numpy.lib import format as npfmt
+    dtypes: Dict[str, str] = {}
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED, allowZip64=True) as zf:
+        for key, val in flat.items():
+            arr = np.asarray(val() if callable(val) else val)
+            if _BF16 is not None and arr.dtype == _BF16:
+                dtypes[key] = "bfloat16"
+                arr = arr.view(np.uint16)
+            elif arr.dtype not in _NATIVE_DTYPES:
+                arr = arr.astype(np.float32)
+            with zf.open(key + ".npy", "w", force_zip64=True) as f:
+                npfmt.write_array(f, np.ascontiguousarray(arr),
+                                  allow_pickle=False)
+            del arr
+        meta = np.frombuffer(json.dumps(dtypes).encode(), dtype=np.uint8)
+        with zf.open(_DTYPES_KEY + ".npy", "w") as f:
+            npfmt.write_array(f, meta, allow_pickle=False)
+
+
+def read_flat_npz(path: str) -> Dict[str, np.ndarray]:
+    """Inverse of write_flat_npz (also reads plain np.savez archives)."""
+    with np.load(path) as data:
+        flat = {k: data[k] for k in data.files if k != _DTYPES_KEY}
+        if _DTYPES_KEY in data.files:
+            mapping = json.loads(bytes(data[_DTYPES_KEY]).decode())
+            for k, dt in mapping.items():
+                if dt == "bfloat16" and _BF16 is not None:
+                    flat[k] = flat[k].view(_BF16)
     return flat
 
 
@@ -65,13 +114,12 @@ def _flat_dict_to_tree(flat: Dict[str, np.ndarray], like):
 
 
 def save_tree(tree, path: str) -> None:
-    np.savez(path, **_tree_to_flat_dict(tree))
+    write_flat_npz(_tree_to_flat_dict(tree, lazy=True), path)
 
 
 def load_tree(path: str, like, shardings=None):
     import jax.numpy as jnp
-    with np.load(path) as data:
-        flat = {k: data[k] for k in data.files}
+    flat = read_flat_npz(path)
     tree = _flat_dict_to_tree(flat, like)
 
     def restore(arr, ref, sh=None):
@@ -89,33 +137,51 @@ def save_checkpoint(save_dir: str,
                     tag: str,
                     state,
                     client_state: Optional[Dict[str, Any]] = None,
-                    master_aliases_params: bool = False) -> str:
+                    master_aliases_params: bool = False,
+                    ckpt_engine=None) -> str:
     """Write {save_dir}/{tag}/ with model+optim npz and metadata; update `latest`.
 
     ``master_aliases_params``: fp32 training stores params once (the master copy
-    IS the param tree); the alias is re-established at load."""
+    IS the param tree); the alias is re-established at load.
+    ``ckpt_engine``: a checkpoint.engine.CheckpointEngine — async engines do
+    the file IO off-thread; `latest` lands only after the data is durable
+    (the async engine's single FIFO worker orders it behind the writes)."""
     ckpt_dir = os.path.join(save_dir, tag)
-    if jax.process_index() == 0:
-        os.makedirs(ckpt_dir, exist_ok=True)
-        save_tree(state.params, os.path.join(ckpt_dir, "model_states.npz"))
-        optim_group = {"opt_state": state.opt_state}
-        if not master_aliases_params:
-            optim_group["master"] = state.master
-        save_tree(optim_group, os.path.join(ckpt_dir, "optim_states.npz"))
-        meta = {
-            "master_aliases_params": master_aliases_params,
-            "step": int(jax.device_get(state.step)),
-            "skipped_steps": int(jax.device_get(state.skipped_steps)),
-            "loss_scale": float(jax.device_get(state.scale.scale)),
-            "scale_good_steps": int(jax.device_get(state.scale.good_steps)),
-            "scale_hysteresis": int(jax.device_get(state.scale.hysteresis)),
-            "client_state": client_state or {},
-        }
-        with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2)
+    if jax.process_index() != 0:
+        return ckpt_dir
+    if ckpt_engine is None:
+        from ..checkpoint.engine import NpzCheckpointEngine
+        ckpt_engine = NpzCheckpointEngine()
+    os.makedirs(ckpt_dir, exist_ok=True)
+    ckpt_engine.create(tag)
+    # async engines must not race donated device buffers: gather to host
+    # eagerly (leaf-wise), hand numpy to the writer thread
+    lazy = getattr(ckpt_engine, "wants_lazy", True)
+    ckpt_engine.save(_tree_to_flat_dict(state.params, lazy=lazy),
+                     os.path.join(ckpt_dir, "model_states.npz"))
+    optim_group = {"opt_state": state.opt_state}
+    if not master_aliases_params:
+        optim_group["master"] = state.master
+    ckpt_engine.save(_tree_to_flat_dict(optim_group, lazy=lazy),
+                     os.path.join(ckpt_dir, "optim_states.npz"))
+    meta = {
+        "master_aliases_params": master_aliases_params,
+        "step": int(jax.device_get(state.step)),
+        "skipped_steps": int(jax.device_get(state.skipped_steps)),
+        "loss_scale": float(jax.device_get(state.scale.scale)),
+        "scale_good_steps": int(jax.device_get(state.scale.good_steps)),
+        "scale_hysteresis": int(jax.device_get(state.scale.hysteresis)),
+        "client_state": client_state or {},
+    }
+    with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+    def _write_latest():
         with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
             f.write(tag)
         logger.info(f"saved checkpoint {ckpt_dir}")
+
+    ckpt_engine.run(_write_latest)   # async: FIFO-ordered behind the writes
     return ckpt_dir
 
 

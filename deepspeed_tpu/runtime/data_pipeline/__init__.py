@@ -1,0 +1,14 @@
+"""deepspeed_tpu.runtime.data_pipeline — data efficiency suite.
+
+reference: deepspeed/runtime/data_pipeline/ (curriculum scheduler, curriculum
+data sampler, mmap indexed dataset, random-LTD routing).
+"""
+
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import (CurriculumBatchTransform, DeepSpeedDataSampler,
+                           apply_seqlen_curriculum)
+from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder)
+
+__all__ = ["CurriculumScheduler", "CurriculumBatchTransform",
+           "DeepSpeedDataSampler", "apply_seqlen_curriculum",
+           "MMapIndexedDataset", "MMapIndexedDatasetBuilder"]

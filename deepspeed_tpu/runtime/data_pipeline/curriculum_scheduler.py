@@ -1,0 +1,82 @@
+"""Curriculum difficulty schedules.
+
+Capability parity with the reference's
+``runtime/data_pipeline/curriculum_scheduler.py`` (CurriculumScheduler:
+fixed_linear / fixed_root / fixed_discrete / custom difficulty as a function
+of global step, quantized to difficulty_step). Pure step->difficulty math —
+no torch state; get_state/set_state keep the reference's checkpoint surface.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict):
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.schedule_type = config.get("schedule_type", "fixed_linear")
+        sc = dict(config.get("schedule_config", {}))
+        if self.schedule_type in ("fixed_linear", "fixed_root"):
+            if "total_curriculum_step" not in sc:
+                raise ValueError(f"{self.schedule_type} needs "
+                                 "schedule_config.total_curriculum_step")
+            sc.setdefault("difficulty_step", 8)
+            if self.schedule_type == "fixed_root" and "root_degree" not in sc:
+                raise ValueError("fixed_root needs schedule_config.root_degree")
+        elif self.schedule_type == "fixed_discrete":
+            if "difficulty" not in sc or "max_step" not in sc:
+                raise ValueError("fixed_discrete needs schedule_config."
+                                 "difficulty + max_step lists")
+        elif self.schedule_type != "custom":
+            raise ValueError(f"unknown schedule_type '{self.schedule_type}'")
+        self.schedule_config = sc
+        self.current_difficulty = self.min_difficulty
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+
+    # -- schedules (reference curriculum_scheduler.py:136-175) ----------------
+
+    def _fixed_root(self, global_steps: int, root_degree: int) -> int:
+        sc = self.schedule_config
+        frac = (float(global_steps) / sc["total_curriculum_step"]) ** \
+            (1.0 / root_degree)
+        d = math.floor(frac * (self.max_difficulty - self.min_difficulty)
+                       + self.min_difficulty)
+        d -= d % sc["difficulty_step"]
+        return min(max(d, self.min_difficulty), self.max_difficulty)
+
+    def _fixed_discrete(self, global_steps: int) -> int:
+        sc = self.schedule_config
+        for diff, max_step in zip(sc["difficulty"], sc["max_step"]):
+            if global_steps <= max_step:
+                return int(diff)
+        return int(sc["difficulty"][-1])
+
+    def get_difficulty(self, global_steps: int) -> int:
+        if self.schedule_type == "fixed_discrete":
+            return self._fixed_discrete(global_steps)
+        if self.schedule_type == "fixed_linear":
+            return self._fixed_root(global_steps, 1)
+        if self.schedule_type == "fixed_root":
+            return self._fixed_root(global_steps,
+                                    self.schedule_config["root_degree"])
+        if self.custom_get_difficulty is None:
+            raise RuntimeError("custom schedule needs "
+                               "set_custom_get_difficulty")
+        return self.custom_get_difficulty(global_steps)
+
+    def update_difficulty(self, global_steps: int) -> int:
+        if self.current_difficulty < self.max_difficulty:
+            self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]) -> None:
+        self.custom_get_difficulty = fn
+
+    def get_state(self) -> Dict:
+        return {"current_difficulty": self.current_difficulty}
+
+    def set_state(self, state: Dict) -> None:
+        self.current_difficulty = state["current_difficulty"]

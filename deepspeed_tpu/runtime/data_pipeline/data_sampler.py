@@ -1,0 +1,93 @@
+"""Curriculum data sampling — difficulty-ordered batches + seqlen truncation.
+
+Capability parity with the reference's
+``data_pipeline/data_sampling/data_sampler.py:33`` (DeepSpeedDataSampler:
+difficulty-bucketed index sampling driven by a CurriculumScheduler) and the
+legacy seqlen curriculum the engine applies to each batch
+(runtime/engine.py curriculum hooks). Two pieces:
+
+  * DeepSpeedDataSampler — index-level: samples only examples whose
+    difficulty metric is within the current threshold (metric values
+    supplied as an array, the role of the reference's analyzer output).
+  * apply_seqlen_curriculum — batch-level: truncate [B, S] token batches to
+    the scheduled sequence length (the Megatron-style seqlen curriculum;
+    note each new difficulty value compiles a fresh step, so schedules
+    should move in coarse difficulty_step increments on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+PyTree = Any
+
+
+def apply_seqlen_curriculum(batch: PyTree, seqlen: int) -> PyTree:
+    """Truncate every rank>=2 leaf's axis 1 (sequence) to ``seqlen``."""
+    import jax
+
+    def trunc(x):
+        arr = np.asarray(x) if not hasattr(x, "ndim") else x
+        if arr.ndim >= 2 and arr.shape[1] > seqlen:
+            return arr[:, :seqlen]
+        return arr
+
+    return jax.tree.map(trunc, batch)
+
+
+class CurriculumBatchTransform:
+    """Engine-side seqlen curriculum: call on each global batch."""
+
+    def __init__(self, config: Dict):
+        self.scheduler = CurriculumScheduler(config)
+        self.curriculum_type = config.get("curriculum_type", "seqlen")
+        if self.curriculum_type != "seqlen":
+            raise ValueError("batch-level curriculum supports "
+                             f"curriculum_type='seqlen', got "
+                             f"'{self.curriculum_type}' (use "
+                             "DeepSpeedDataSampler for metric-based types)")
+
+    def __call__(self, batch: PyTree, global_steps: int) -> PyTree:
+        seqlen = self.scheduler.update_difficulty(global_steps)
+        return apply_seqlen_curriculum(batch, seqlen)
+
+
+class DeepSpeedDataSampler:
+    """Difficulty-gated index sampler.
+
+    ``difficulties``: per-example metric values (the reference reads these
+    from the offline data analyzer's indexed store; any array-like works).
+    Yields batches of indices drawn uniformly from examples whose difficulty
+    <= the scheduler's current threshold — ramping the pool open exactly like
+    the reference's curriculum sampling.
+    """
+
+    def __init__(self, difficulties, batch_size: int,
+                 curriculum_config: Dict, seed: int = 1234,
+                 drop_last: bool = True):
+        self.difficulties = np.asarray(difficulties)
+        self.order = np.argsort(self.difficulties)
+        self.sorted_vals = self.difficulties[self.order]
+        self.batch_size = batch_size
+        self.scheduler = CurriculumScheduler(curriculum_config)
+        self.rng = np.random.default_rng(seed)
+        self.global_steps = 0
+
+    def set_step(self, global_steps: int) -> None:
+        self.global_steps = global_steps
+
+    def _eligible(self) -> np.ndarray:
+        thresh = self.scheduler.update_difficulty(self.global_steps)
+        n = int(np.searchsorted(self.sorted_vals, thresh, side="right"))
+        return self.order[:max(n, self.batch_size)]
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            pool = self._eligible()
+            yield self.rng.choice(pool, size=self.batch_size,
+                                  replace=len(pool) < self.batch_size)
+            self.global_steps += 1

@@ -1,0 +1,98 @@
+"""Memory-mapped indexed dataset — zero-copy token storage.
+
+Capability parity with the reference's
+``data_pipeline/data_sampling/indexed_dataset.py`` MMapIndexedDataset
+(Megatron format: a .bin of contiguous token arrays + a .idx of dtypes/
+sizes/pointers, read through np.memmap so the OS page cache is the only
+copy). Same two-file layout and builder/reader API; the header magic
+differs (this is not a byte-compatible Megatron reader — it is the same
+mechanism rebuilt).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """reference: MMapIndexedDatasetBuilder (indexed_dataset.py:602)."""
+
+    def __init__(self, out_prefix: str, dtype=np.int32):
+        self._prefix = out_prefix
+        self._dtype = np.dtype(dtype)
+        self._data = open(data_file_path(out_prefix), "wb")
+        self._sizes: List[int] = []
+
+    def add_item(self, tokens: Sequence[int]) -> None:
+        arr = np.asarray(tokens, dtype=self._dtype)
+        self._data.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def finalize(self) -> None:
+        self._data.close()
+        with open(index_file_path(self._prefix), "wb") as idx:
+            idx.write(_MAGIC)
+            idx.write(struct.pack("<QBQ", _VERSION,
+                                  _DTYPE_CODES[self._dtype],
+                                  len(self._sizes)))
+            sizes = np.asarray(self._sizes, np.int64)
+            pointers = np.zeros_like(sizes)
+            np.cumsum(sizes[:-1] * self._dtype.itemsize, out=pointers[1:])
+            idx.write(sizes.tobytes(order="C"))
+            idx.write(pointers.tobytes(order="C"))
+
+
+class MMapIndexedDataset:
+    """reference: MMapIndexedDataset (indexed_dataset.py:381)."""
+
+    def __init__(self, prefix: str):
+        with open(index_file_path(prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{index_file_path(prefix)} is not a "
+                                 "deepspeed_tpu indexed dataset")
+            version, dtype_code, count = struct.unpack("<QBQ", f.read(17))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            self._dtype = np.dtype(_DTYPES[dtype_code])
+            self._len = count
+            self._sizes = np.frombuffer(f.read(8 * count), np.int64)
+            self._pointers = np.frombuffer(f.read(8 * count), np.int64)
+        self._bin = np.memmap(data_file_path(prefix), mode="r", dtype=np.uint8)
+
+    def __len__(self) -> int:
+        return self._len
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return self._sizes
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._len))]
+        ptr = self._pointers[i]
+        nbytes = self._sizes[i] * self._dtype.itemsize
+        return self._bin[ptr:ptr + nbytes].view(self._dtype)
+
+    def get(self, i: int, offset: int = 0, length: int = None) -> np.ndarray:
+        item = self[i]
+        return item[offset:offset + length if length is not None else None]

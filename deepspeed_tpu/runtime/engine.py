@@ -297,6 +297,25 @@ class DeepSpeedEngine:
         self.skipped_steps = 0
         self.micro_steps = 0
 
+        # data efficiency: seqlen curriculum (reference: engine curriculum
+        # hooks + data_pipeline/data_sampling) -------------------------------
+        self.curriculum = None
+        cl_cfg = None
+        if self.config.curriculum_learning.enabled:
+            cl_cfg = self.config.curriculum_learning.model_dump()
+        elif self.config.data_efficiency.enabled:
+            clc = (self.config.data_efficiency.data_sampling or {}).get(
+                "curriculum_learning") or {}
+            if clc.get("enabled"):
+                cl_cfg = clc
+        if cl_cfg is not None:
+            from .data_pipeline import CurriculumBatchTransform
+            self.curriculum = CurriculumBatchTransform(cl_cfg)
+            log_dist(f"curriculum learning: {cl_cfg.get('curriculum_type', 'seqlen')} "
+                     f"{cl_cfg['min_difficulty']}->{cl_cfg['max_difficulty']} "
+                     f"({cl_cfg.get('schedule_type', 'fixed_linear')})",
+                     ranks=[0])
+
         from ..config.config import warn_unconsumed
         warn_unconsumed(self.config)
         log_dist(f"DeepSpeedEngine initialized: ZeRO stage {stage}, "
@@ -610,6 +629,8 @@ class DeepSpeedEngine:
                 "engine has no optimizer: add an 'optimizer' section to the "
                 "config or pass optimizer= to initialize()")
         from ..parallel.mesh import BATCH_AXES
+        if self.curriculum is not None:
+            batch = self.curriculum(batch, self.global_steps)
         gas = self.config.gradient_accumulation_steps
         micro_sharding = NamedSharding(self.mesh, P(None, BATCH_AXES))
         micros = jax.tree.map(
@@ -828,10 +849,21 @@ class DeepSpeedEngine:
         client_state["global_steps"] = self.global_steps
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "state_dict"):
             client_state["lr_scheduler"] = self.lr_scheduler.state_dict()
+        if not hasattr(self, "checkpoint_engine"):
+            from ..checkpoint.engine import build_checkpoint_engine
+            self.checkpoint_engine = build_checkpoint_engine(self.config)
         return ckpt_lib.save_checkpoint(
             save_dir, tag, self._ckpt_view(), client_state,
             master_aliases_params=(not self.keep_master
-                                   and self.offload is None))
+                                   and self.offload is None),
+            ckpt_engine=self.checkpoint_engine)
+
+    def wait_for_checkpoints(self) -> bool:
+        """Durability barrier for async checkpointing (reference: Nebula
+        commit semantics); no-op with the sync engine."""
+        if hasattr(self, "checkpoint_engine"):
+            return self.checkpoint_engine.commit("all")
+        return True
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_module_only: bool = False):
@@ -864,8 +896,8 @@ class DeepSpeedEngine:
         with open(os.path.join(ckpt_dir, "meta.json")) as f:
             meta = json.load(f)
         sd_like = self.offload.state_dict()
-        with np.load(os.path.join(ckpt_dir, "optim_states.npz")) as data:
-            flat = {k: data[k] for k in data.files}
+        flat = ckpt_lib.read_flat_npz(
+            os.path.join(ckpt_dir, "optim_states.npz"))
         optim = ckpt_lib._flat_dict_to_tree(
             flat, {"master": sd_like["master"],
                    "opt_state": {"offload": sd_like["state"]}})

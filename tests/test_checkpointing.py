@@ -1,0 +1,158 @@
+"""Checkpoint hardening tests: bf16 preservation, streaming writer, async
+engine ordering, cross-topology round trip, and the inspector.
+
+The cross-topology test makes round-1's "universal by construction" claim
+real: save under dp=8, load under tp=2 x sp=2 x dp=2 and continue training
+with identical losses (reference needs deepspeed/checkpoint/ reshape tools
+for this).
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.checkpoint import (AsyncCheckpointEngine,
+                                      DeepSpeedCheckpoint,
+                                      NpzCheckpointEngine, inspect_checkpoint)
+from deepspeed_tpu.runtime.checkpointing import (read_flat_npz, save_tree,
+                                                 load_tree, write_flat_npz)
+
+from util import SimpleModel, random_batch
+
+
+def test_bf16_preserved_bit_exact(tmp_path):
+    """bf16 leaves round-trip as bf16 — no f32 upcast (round-1 Weak #6:
+    checkpoint size doubled)."""
+    import ml_dtypes
+    tree = {"w": jnp.asarray(np.random.RandomState(0).randn(64, 8),
+                             jnp.bfloat16),
+            "b": jnp.arange(8, dtype=jnp.float32)}
+    path = str(tmp_path / "t.npz")
+    save_tree(tree, path)
+    flat = read_flat_npz(path)
+    assert flat["w"].dtype == np.dtype(ml_dtypes.bfloat16)
+    assert (flat["w"].view(np.uint16) ==
+            np.asarray(tree["w"]).view(np.uint16)).all()
+    back = load_tree(path, tree)
+    assert back["w"].dtype == jnp.bfloat16
+    # on-disk footprint ~2 bytes/elem for the bf16 leaf, not 4
+    assert os.path.getsize(path) < 64 * 8 * 3 + 8 * 4 + 2048
+
+
+def test_streaming_writer_lazy_thunks(tmp_path):
+    """The writer must call each thunk exactly once, sequentially (one leaf
+    on host at a time — the no-whole-model-gather property)."""
+    calls = []
+
+    def thunk(name, arr):
+        def f():
+            calls.append(name)
+            return arr
+        return f
+
+    flat = {f"k{i}": thunk(f"k{i}", np.full((4,), i, np.float32))
+            for i in range(5)}
+    path = str(tmp_path / "s.npz")
+    write_flat_npz(flat, path)
+    assert calls == [f"k{i}" for i in range(5)]
+    out = read_flat_npz(path)
+    assert np.array_equal(out["k3"], np.full((4,), 3, np.float32))
+
+
+def test_async_engine_orders_latest_after_data(tmp_path):
+    """latest must only appear after the (slow) data writes complete."""
+    eng = AsyncCheckpointEngine()
+    path = str(tmp_path / "big.npz")
+
+    def slow_dict():
+        time.sleep(0.3)
+        return np.zeros(10, np.float32)
+
+    eng.save({"a": slow_dict}, path)          # thunk runs on the worker
+    marker = str(tmp_path / "latest")
+    eng.run(lambda: open(marker, "w").write("tag"))
+    assert not os.path.exists(marker) or os.path.exists(path)
+    assert eng.commit("tag")
+    assert os.path.exists(path) and os.path.exists(marker)
+
+
+def test_engine_async_checkpoint_roundtrip(tmp_path):
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "checkpoint": {"async_save": True}}
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg,
+                               example_batch=random_batch(8))
+    for i in range(3):
+        engine.train_batch(random_batch(8, seed=i))
+    engine.save_checkpoint(str(tmp_path / "ck"))
+    assert isinstance(engine.checkpoint_engine, AsyncCheckpointEngine)
+    assert engine.wait_for_checkpoints()
+    engine2, *_ = ds.initialize(model=SimpleModel(), config=cfg,
+                                example_batch=random_batch(8))
+    engine2.load_checkpoint(str(tmp_path / "ck"))
+    b = random_batch(8, seed=77)
+    l1 = float(engine.train_batch(b)["loss"])
+    l2 = float(engine2.train_batch(b)["loss"])
+    assert abs(l1 - l2) < 1e-5
+
+
+def _gpt_engine(mesh_sizes, tmp=None):
+    from deepspeed_tpu.models import build_model, causal_lm_loss
+    model, cfg = build_model("gpt2-tiny", max_seq_len=64,
+                             attention_impl="reference")
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "seed": 5,
+        **mesh_sizes,
+    }
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 32))
+    engine, *_ = ds.initialize(
+        model=model, config=config, loss_fn=causal_lm_loss,
+        example_batch={"input_ids": ids}, sharding_rules=cfg.tp_rules())
+    return engine
+
+
+def _lm_batch(i):
+    return {"input_ids": np.random.default_rng(100 + i).integers(
+        0, 1024, (8, 32))}
+
+
+def test_cross_topology_roundtrip(tmp_path):
+    """Save under pure dp=8, restore under tp=2 x sp=2 x dp=2: the loaded
+    model must produce the same losses stepping forward."""
+    e_dp = _gpt_engine({})                              # data=8
+    for i in range(3):
+        e_dp.train_batch(_lm_batch(i))
+    e_dp.save_checkpoint(str(tmp_path / "ck"))
+    ref = [float(e_dp.train_batch(_lm_batch(10 + i))["loss"])
+           for i in range(2)]
+
+    e_3d = _gpt_engine({"tensor_parallel": {"tp_size": 2},
+                        "sequence_parallel": {"sp_size": 2}})
+    e_3d.load_checkpoint(str(tmp_path / "ck"))
+    got = [float(e_3d.train_batch(_lm_batch(10 + i))["loss"])
+           for i in range(2)]
+    np.testing.assert_allclose(ref, got, rtol=2e-2)
+
+
+def test_checkpoint_inspector(tmp_path):
+    engine = _gpt_engine({})
+    engine.train_batch(_lm_batch(0))
+    engine.save_checkpoint(str(tmp_path / "ck"))
+    ck = DeepSpeedCheckpoint(str(tmp_path / "ck"))
+    assert ck.global_step == 1
+    names = ck.parameter_names()
+    assert any("attn_qkv" in n for n in names)
+    assert ck.num_parameters() > 0
+    summary = inspect_checkpoint(str(tmp_path / "ck"))
+    assert summary["num_tensors"] == len(names)
+    assert "bfloat16" in summary["dtypes"]
