@@ -245,6 +245,10 @@ class PipelineConfig(DeepSpeedConfigModel):
     seed_layers: bool = False
     activation_checkpoint_interval: int = 0
     micro_batches: Optional[int] = None
+    # "gpipe": AD through the scan (memory ∝ n_micro, f32 boundary);
+    # "1f1b": hand-scheduled interleave (memory ∝ stages, bf16 boundary) —
+    # the reference TrainSchedule's execution regime
+    schedule: str = "gpipe"
 
 
 class TensorParallelConfig(DeepSpeedConfigModel):

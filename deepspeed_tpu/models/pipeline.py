@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..runtime.pipe.spmd import pipeline_apply, stack_stage_params
+from ..runtime.pipe.spmd import (pipeline_apply, stack_stage_params,
+                                 unstack_stage_params)
 from .transformer import Block, Transformer, TransformerConfig
 
 PyTree = Any
@@ -42,10 +43,8 @@ class PipelinedTransformer:
         if cfg.dropout != 0.0:
             raise NotImplementedError("pipelined path does not thread dropout "
                                       "rngs yet; set dropout=0")
-        if cfg.moe_experts > 0:
-            raise NotImplementedError("MoE + pipeline composition lands with "
-                                      "aux-loss threading through the pipe "
-                                      "loop; use pp=1 for MoE models")
+        # MoE + PP: the MoE aux loss rides the pipe as a scalar side channel
+        # next to the activations (spmd.pipeline_apply with_aux)
         self.cfg = cfg
         self.pp = pp
         self.n_micro = n_micro
@@ -92,24 +91,97 @@ class PipelinedTransformer:
                   wpe.astype(cfg.dtype)[jnp.arange(S)][None, None, :])
         stage_params = stack_stage_params(params["blocks"], self.pp)
 
+        moe = cfg.moe_experts > 0
+
         def stage_fn(block_stack, h):
             # scan this stage's L/pp blocks (same compiled body per layer)
             def layer(carry, p):
-                out, _aux = self._block.apply({"params": p}, carry, None, train)
-                return out, None
-            h, _ = jax.lax.scan(layer, h, block_stack)
+                out, aux = self._block.apply({"params": p}, carry, None, train)
+                return out, aux
+            h, auxes = jax.lax.scan(layer, h, block_stack)
+            if moe:
+                return h, jnp.sum(auxes)
             return h
 
-        outs = pipeline_apply(stage_fn, stage_params, micros, mesh=mesh,
-                              pp=self.pp, remat=cfg.remat)
+        res = pipeline_apply(stage_fn, stage_params, micros, mesh=mesh,
+                             pp=self.pp, remat=cfg.remat, with_aux=moe)
+        outs, aux_total = res if moe else (res, None)
         # head runs per-micro; only the fp32 logits are reshaped back to the
         # flat batch (fp32 resharding avoids the bf16 SPMD copy bug above)
         h = self._ln_f.apply({"params": params["ln_f"]}, outs)
         logits = jnp.einsum("nbsh,vh->nbsv", h,
                             wte.astype(cfg.dtype)).astype(jnp.float32)
-        return logits.reshape((B, S, cfg.vocab_size))
+        logits = logits.reshape((B, S, cfg.vocab_size))
+        if moe:
+            return logits, aux_total
+        return logits
 
     __call__ = apply
+
+    # -- 1F1B training path --------------------------------------------------
+
+    def train_value_and_grad(self, params, batch, mesh=None):
+        """Causal-LM loss + grads via the hand-scheduled 1F1B executor
+        (runtime/pipe/one_f_one_b): activation memory ∝ pp (not n_micro) and
+        the boundary stays bf16. Returns (loss, grads) with grads matching
+        the params tree. MoE models use the GPipe path (the aux side channel
+        is not threaded through the manual backward)."""
+        cfg = self.cfg
+        if cfg.moe_experts > 0:
+            raise NotImplementedError("1F1B + MoE: use pipeline schedule "
+                                      "'gpipe' for MoE models")
+        mesh = mesh or self.mesh
+        if mesh is None:
+            from ..parallel.mesh import get_global_mesh
+            mesh = get_global_mesh().mesh
+        from ..runtime.pipe.one_f_one_b import pipeline_1f1b_value_and_grad
+        if isinstance(batch, dict) and batch.get("attention_mask") is not None:
+            raise NotImplementedError(
+                "1F1B does not thread attention_mask; pad-free batches only")
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        labels = (batch.get("labels", input_ids) if isinstance(batch, dict)
+                  else input_ids)
+        B, S = input_ids.shape
+        mb = B // self.n_micro
+        ids_micros = input_ids.reshape(self.n_micro, mb, S)
+        lab_micros = labels.reshape(self.n_micro, mb, S)
+
+        def embed(wte, wpe):
+            return (wte.astype(cfg.dtype)[ids_micros] +
+                    wpe.astype(cfg.dtype)[jnp.arange(S)][None, None])
+
+        micros, embed_vjp = jax.vjp(embed, params["wte"]["embedding"],
+                                    params["wpe"]["embedding"])
+        stage_params = stack_stage_params(params["blocks"], self.pp)
+
+        def stage_fn(block_stack, h):
+            def layer(carry, p):
+                out, _ = self._block.apply({"params": p}, carry, None, False)
+                return out, None
+            h, _ = jax.lax.scan(layer, h, block_stack)
+            return h
+
+        head = {"ln_f": params["ln_f"], "wte": params["wte"]["embedding"]}
+
+        def loss_fn(head_p, y, lab):
+            h = self._ln_f.apply({"params": head_p["ln_f"]}, y)
+            logits = jnp.einsum("bsh,vh->bsv", h,
+                                head_p["wte"].astype(h.dtype))
+            from .transformer import cross_entropy
+            return cross_entropy(logits[:, :-1].astype(jnp.float32),
+                                 lab[:, 1:])
+
+        loss, gs, gh, dmicros = pipeline_1f1b_value_and_grad(
+            stage_fn, loss_fn, stage_params, head, micros, lab_micros,
+            mesh=mesh, pp=self.pp)
+        dwte_embed, dwpe = embed_vjp(dmicros)
+        grads = {
+            "wte": {"embedding": dwte_embed + gh["wte"]},
+            "wpe": {"embedding": dwpe},
+            "blocks": unstack_stage_params(gs),
+            "ln_f": gh["ln_f"],
+        }
+        return loss, grads
 
     # -- sharding rules ------------------------------------------------------
 

@@ -28,13 +28,42 @@ class PipelineEngine(DeepSpeedEngine):
     """
 
     def _make_train_step(self):
-        def train_step(state, batch, rng, lr_arg):
-            def scaled_loss(p):
-                out = self.apply_fn(p, batch, rng, True)
-                loss = self.loss_fn(out, batch)
-                return (loss * state.scale.scale).astype(jnp.float32), loss
+        schedule = self.config.pipeline.schedule
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline.schedule '{schedule}' "
+                             "(gpipe | 1f1b)")
+        use_1f1b = schedule == "1f1b"
+        if use_1f1b and not hasattr(self.module, "train_value_and_grad"):
+            raise ValueError(
+                "pipeline.schedule='1f1b' needs a model exposing "
+                "train_value_and_grad (models.pipeline.PipelinedTransformer); "
+                "this module only supports the gpipe schedule")
+        if use_1f1b and self.loss_scaler.enabled:
+            raise ValueError("pipeline schedule '1f1b' computes unscaled "
+                             "grads (no fp16 loss scaling); use bf16/fp32")
+        if use_1f1b:
+            from ..engine import _default_loss_fn
+            from ...models.transformer import causal_lm_loss
+            if self.loss_fn not in (causal_lm_loss, _default_loss_fn):
+                raise ValueError(
+                    "pipeline.schedule='1f1b' computes the causal-LM loss at "
+                    "the last stage (labels from batch['labels']/input_ids); "
+                    "custom loss_fn needs the gpipe schedule")
 
-            grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params)
+        def train_step(state, batch, rng, lr_arg):
+            if use_1f1b:
+                # hand-scheduled interleave: loss+grads straight from the
+                # 1F1B executor (runtime/pipe/one_f_one_b), no AD through
+                # the pipeline scan
+                loss, grads = self.module.train_value_and_grad(
+                    state.params, batch, mesh=self.mesh)
+            else:
+                def scaled_loss(p):
+                    out = self.apply_fn(p, batch, rng, True)
+                    loss = self.loss_fn(out, batch)
+                    return (loss * state.scale.scale).astype(jnp.float32), loss
+
+                grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params)
             grads = jax.tree.map(
                 lambda g, s: jax.lax.with_sharding_constraint(
                     g.astype(jnp.float32), s), grads, self.grad_shardings)

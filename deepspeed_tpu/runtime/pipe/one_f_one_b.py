@@ -1,0 +1,254 @@
+"""1F1B pipeline execution — hand-scheduled forward/backward interleave.
+
+The GPipe executor (spmd.py) differentiates THROUGH a lax.scan, so autodiff
+saves every tick's carry: activation memory grows with n_micro. This module
+is the reference's actual 1F1B regime (runtime/pipe/schedule.py TrainSchedule
++ engine.py _exec_schedule): gradients are computed by a hand-written
+interleave where each stage holds at most ``pp`` saved boundary inputs —
+activation memory ∝ stages, not microbatches — and backward recomputes the
+stage body from the saved input (the reference holds outputs instead; the
+recompute trades one extra forward for not storing internals, the same deal
+as its activation checkpointing interleave).
+
+Mechanics, all inside one SPMD program over the 'pipe' mesh axis:
+  * a host-side event simulation produces clock-aligned instruction tables
+    (fwd/bwd micro id per [tick, stage], plus the matching receive tables);
+    one tick = one compute slot, sends land one tick later — the alignment
+    TrainSchedule's abstract clock doesn't guarantee;
+  * the scan body does (masked) one forward + one backward per tick: ring
+    buffers hold received activations/cotangents and saved inputs, keyed by
+    micro % pp; jax.vjp of the stage body yields dx (sent upstream via the
+    reversed ppermute) and accumulated param grads;
+  * the last stage computes the per-micro loss in-tick and seeds its own
+    backward; the loss head's grads psum over 'pipe' at the end.
+
+Because no AD runs through the scan or the collectives, the boundary stays
+in the COMPUTE dtype (bf16) end to end — the f32 crossing the GPipe path
+needs to dodge the low-precision-collective transpose bug does not apply.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+PyTree = Any
+
+
+def build_1f1b_tables(n_micro: int, pp: int
+                      ) -> Dict[str, np.ndarray]:
+    """Clock-aligned 1F1B tables via event simulation.
+
+    Returns arrays [T, pp]: fwd[t,s] / bwd[t,s] = micro id computed (-1 =
+    bubble), recv_f[t,s] = micro id whose activation ARRIVES at (t,s) from
+    s-1 (sent at t-1), recv_b[t,s] = cotangent arriving from s+1. Every
+    stage obeys: warmup of (pp-1-s) forwards, then backward-priority
+    alternation (the reference TrainSchedule discipline, schedule.py:151).
+    """
+    slots = min(pp, n_micro)
+    fwd_done = -np.ones((pp, n_micro), np.int64)    # tick fwd finished
+    bwd_done = -np.ones((pp, n_micro), np.int64)
+    fwd_next = [0] * pp
+    bwd_next = [0] * pp
+    rows_f, rows_b = [], []
+    t = 0
+    while any(b < n_micro for b in bwd_next):
+        row_f = [-1] * pp
+        row_b = [-1] * pp
+        for s in range(pp):
+            f, b = fwd_next[s], bwd_next[s]
+            # a tick holds one forward AND one backward (the executor's scan
+            # body computes both — that IS the 1F1B steady state); the ring
+            # capacity caps in-flight forwards
+            if f < n_micro and f - b < slots and (
+                    s == 0 or 0 <= fwd_done[s - 1, f] < t):
+                row_f[s] = f
+                fwd_done[s, f] = t
+                fwd_next[s] += 1
+            if b < n_micro and (
+                    (s == pp - 1 and 0 <= fwd_done[s, b] <= t)
+                    or (s < pp - 1 and 0 <= bwd_done[s + 1, b] < t)):
+                row_b[s] = b
+                bwd_done[s, b] = t
+                bwd_next[s] += 1
+        rows_f.append(row_f)
+        rows_b.append(row_b)
+        t += 1
+        if t > 6 * (n_micro + pp) + 8:
+            raise RuntimeError("1F1B schedule failed to converge")
+    fwd = np.asarray(rows_f, np.int32)
+    bwd = np.asarray(rows_b, np.int32)
+    T = fwd.shape[0]
+    recv_f = -np.ones_like(fwd)
+    recv_b = -np.ones_like(bwd)
+    recv_f[1:, 1:] = fwd[:-1, :-1]
+    recv_b[1:, :-1] = bwd[:-1, 1:]
+    return {"fwd": fwd, "bwd": bwd, "recv_f": recv_f, "recv_b": recv_b,
+            "ticks": T}
+
+
+def pipeline_1f1b_value_and_grad(
+        stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
+        loss_fn: Callable[[PyTree, jnp.ndarray, jnp.ndarray], jnp.ndarray],
+        stage_params: PyTree,
+        head_params: PyTree,
+        micros: jnp.ndarray,
+        labels: jnp.ndarray,
+        *,
+        mesh,
+        pp: int,
+        pipe_axis: str = "pipe"
+) -> Tuple[jnp.ndarray, PyTree, PyTree, jnp.ndarray]:
+    """One 1F1B pass. Returns (mean loss, stage grads, head grads, dmicros).
+
+    stage_fn(one_stage_params, x [mb, ...]) -> y      every stage's body
+    loss_fn(head_params, y, labels_micro) -> scalar   LAST stage only (head
+        + per-micro loss; its grads seed the backward)
+    micros [n_micro, mb, ...] stage-0 inputs (e.g. embedded tokens);
+    labels [n_micro, ...] per-micro targets; dmicros lets the caller
+    backprop the embedding outside the pipe.
+    """
+    n_micro = micros.shape[0]
+    tables = build_1f1b_tables(n_micro, pp)
+    fwd_t = jnp.asarray(tables["fwd"])
+    bwd_t = jnp.asarray(tables["bwd"])
+    rf_t = jnp.asarray(tables["recv_f"])
+    rb_t = jnp.asarray(tables["recv_b"])
+    T = tables["ticks"]
+    slots = min(pp, n_micro)                    # 1F1B in-flight bound
+
+    def inner(stage_params, head_params, micros, labels):
+        local = jax.tree.map(lambda x: x[0], stage_params)
+        stage = jax.lax.axis_index(pipe_axis)
+        mshape = micros.shape[1:]
+        zero_m = jnp.zeros(mshape, micros.dtype)
+
+        rings = {
+            "in_act": jnp.zeros((slots,) + mshape, micros.dtype),
+            "in_grad": jnp.zeros((slots,) + mshape, micros.dtype),
+            "saved_x": jnp.zeros((slots,) + mshape, micros.dtype),
+        }
+        grads0 = jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), local)
+        hgrads0 = jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32),
+                               head_params)
+        dmicros0 = jnp.zeros_like(micros)
+        loss0 = jnp.zeros((), jnp.float32)
+        send0 = (zero_m, zero_m)                # (fwd payload, bwd payload)
+
+        down = [(i, i + 1) for i in range(pp - 1)]
+        up = [(i + 1, i) for i in range(pp - 1)]
+
+        def stage_bwd(xb, lab, ring_dy, is_last):
+            """ONE stage VJP per tick: the head's loss/cotangent is computed
+            separately (loss_fn reduces locally — no collectives), and a
+            where selects the head's dy on the last stage vs the ring's dy
+            elsewhere before the single backward through the stage body."""
+            y, stage_vjp = jax.vjp(lambda p, x: stage_fn(p, x), local, xb)
+            loss, head_vjp = jax.vjp(
+                lambda h, yy: loss_fn(h, yy, lab), head_params, y)
+            dh, head_dy = head_vjp(jnp.ones((), loss.dtype))
+            dy = jnp.where(is_last, head_dy.astype(y.dtype),
+                           ring_dy.astype(y.dtype))
+            dp, dx = stage_vjp(dy)
+            return loss, dp, dh, dx
+
+        def tick(carry, t):
+            rings, grads, hgrads, dmicros, loss_acc, send = carry
+            prev_y, prev_dx = send
+
+            # -- receive what was sent last tick ------------------------------
+            got_f = jax.lax.ppermute(prev_y, pipe_axis, down)
+            # chain the second permute on the first: independent collectives
+            # may be scheduled in different orders on different devices,
+            # deadlocking the rendezvous (observed on the 8-device CPU
+            # runtime); the zero-valued dependency forces a global order
+            token = jnp.zeros((), prev_dx.dtype) * jnp.sum(got_f).astype(
+                prev_dx.dtype)
+            got_b = jax.lax.ppermute(prev_dx + token, pipe_axis, up)
+            rf = rf_t[t, stage]
+            rb = rb_t[t, stage]
+            rings["in_act"] = jnp.where(
+                rf >= 0,
+                jax.lax.dynamic_update_index_in_dim(
+                    rings["in_act"], got_f, jnp.maximum(rf, 0) % slots, 0),
+                rings["in_act"])
+            rings["in_grad"] = jnp.where(
+                rb >= 0,
+                jax.lax.dynamic_update_index_in_dim(
+                    rings["in_grad"], got_b, jnp.maximum(rb, 0) % slots, 0),
+                rings["in_grad"])
+
+            # -- forward ------------------------------------------------------
+            f_id = fwd_t[t, stage]
+            f_on = f_id >= 0
+            f_slot = jnp.maximum(f_id, 0) % slots
+            x = jnp.where(stage == 0,
+                          micros[jnp.maximum(f_id, 0)],
+                          rings["in_act"][f_slot])
+            y = stage_fn(local, x)
+            rings["saved_x"] = jnp.where(
+                f_on,
+                jax.lax.dynamic_update_index_in_dim(rings["saved_x"], x,
+                                                    f_slot, 0),
+                rings["saved_x"])
+
+            # -- backward -----------------------------------------------------
+            b_id = bwd_t[t, stage]
+            b_on = b_id >= 0
+            b_slot = jnp.maximum(b_id, 0) % slots
+            xb = rings["saved_x"][b_slot]
+            lab = labels[jnp.maximum(b_id, 0)]
+            dy = rings["in_grad"][b_slot]
+            is_last = stage == pp - 1
+
+            # executed UNCONDITIONALLY on every rank with where-selects: a
+            # lax.cond here diverges by pipe rank, and any collective XLA
+            # partitions into a branch would deadlock the rendezvous
+            lloss, dp, dh, dx = stage_bwd(xb, lab, dy, is_last)
+            mask = b_on.astype(jnp.float32)
+            last_f = is_last.astype(jnp.float32)
+            grads = jax.tree.map(
+                lambda g, d: g + mask * d.astype(jnp.float32), grads, dp)
+            hgrads = jax.tree.map(
+                lambda g, d: g + (mask * last_f) * d.astype(jnp.float32),
+                hgrads, dh)
+            loss_acc = loss_acc + jnp.where(b_on & is_last,
+                                            lloss.astype(jnp.float32), 0.0)
+            dx = dx.astype(micros.dtype)
+            # stage 0's dx is the embedding cotangent
+            dmicros = jnp.where(
+                b_on & (stage == 0),
+                jax.lax.dynamic_update_index_in_dim(
+                    dmicros, dx, jnp.maximum(b_id, 0), 0),
+                dmicros)
+
+            send = (jnp.where(f_on, y, zero_m).astype(micros.dtype),
+                    jnp.where(b_on, dx, zero_m))
+            return (rings, grads, hgrads, dmicros, loss_acc, send), None
+
+        carry0 = (rings, grads0, hgrads0, dmicros0, loss0, send0)
+        (rings, grads, hgrads, dmicros, loss_acc, _), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(T))
+
+        # loss + head grads live on the last stage; dmicros on stage 0 —
+        # psum replicates (the masks above zero the other stages' terms)
+        loss = jax.lax.psum(loss_acc, pipe_axis) / n_micro
+        hgrads = jax.tree.map(
+            lambda g: jax.lax.psum(g / n_micro, pipe_axis), hgrads)
+        dmicros = jax.lax.psum(dmicros.astype(jnp.float32),
+                               pipe_axis).astype(micros.dtype) / n_micro
+        grads = jax.tree.map(lambda g: g[None] / n_micro, grads)
+        return loss, grads, hgrads, dmicros
+
+    spec_params = jax.tree.map(lambda _: P(pipe_axis), stage_params)
+    mapped = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(spec_params, P(), P(), P()),
+        out_specs=(P(), spec_params, P(), P()),
+        axis_names={pipe_axis},
+        check_vma=False)
+    return mapped(stage_params, head_params, micros, labels)

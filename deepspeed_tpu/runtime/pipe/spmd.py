@@ -39,22 +39,33 @@ def pipeline_apply(stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
                    mesh,
                    pp: int,
                    remat: bool = False,
-                   pipe_axis: str = "pipe") -> jnp.ndarray:
+                   pipe_axis: str = "pipe",
+                   with_aux: bool = False):
     """Run stacked pipeline stages over microbatches.
 
     stage_fn(params_of_one_stage, x) -> y   applies ONE stage's layer stack
+      (with_aux=True: -> (y, aux_scalar) — a per-stage additive side channel
+      e.g. the MoE load-balance loss; aux rides the pipe next to the
+      activations and sums across stages per microbatch)
     stage_params: pytree with leading dim pp on every leaf (sharded over pipe)
     micros: [n_micro, micro_batch, ...] activations entering stage 0
-    returns [n_micro, micro_batch, ...] outputs of the last stage, replicated
-    over the pipe axis (so the head/loss can run everywhere).
+    returns [n_micro, micro_batch, ...] outputs of the last stage (plus the
+    summed aux scalar when with_aux), replicated over the pipe axis.
     """
     n_micro = micros.shape[0]
-    if pp == 1:
-        body = jax.checkpoint(stage_fn) if remat else stage_fn
-        one = jax.tree.map(lambda x: x[0], stage_params)
-        return jax.lax.map(lambda m: body(one, m), micros)
+    base_fn = stage_fn
+    if not with_aux:
+        def base_fn(p, x):  # noqa: F811 - uniform (y, aux) contract inside
+            return stage_fn(p, x), jnp.zeros((), jnp.float32)
+    fn = jax.checkpoint(base_fn) if remat else base_fn
 
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    if pp == 1:
+        one = jax.tree.map(lambda x: x[0], stage_params)
+        outs, auxes = jax.lax.map(lambda m: fn(one, m), micros)
+        # MEAN over microbatches: the per-layer aux is a token-mean, so the
+        # pipelined aux must match the pp=1 model batch-for-batch
+        return (outs, jnp.mean(auxes)) if with_aux else outs
+
     compute_dtype = micros.dtype
 
     def inner(params, micros):
@@ -65,18 +76,24 @@ def pipeline_apply(stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
         stage = jax.lax.axis_index(pipe_axis)
         n_ticks = n_micro + pp - 1
         state = jnp.zeros_like(micros[0])
+        aux_state = jnp.zeros((), jnp.float32)
         outs = jnp.zeros_like(micros)
+        aux_outs = jnp.zeros((n_micro,), jnp.float32)
 
         def tick(carry, t):
-            state, outs = carry
+            state, aux_state, outs, aux_outs = carry
             # shift activations downstream (stage pp-1 sends nowhere; the
             # GPipe fill/drain means its output was already emitted)
             recv = jax.lax.ppermute(state, pipe_axis,
                                     [(i, i + 1) for i in range(pp - 1)])
+            recv_aux = jax.lax.ppermute(aux_state, pipe_axis,
+                                        [(i, i + 1) for i in range(pp - 1)])
             inject = micros[jnp.clip(t, 0, n_micro - 1)]
             is_first = (stage == 0)
             x = jnp.where(is_first, inject, recv)
-            y = fn(local, x)
+            aux_in = jnp.where(is_first, 0.0, recv_aux)
+            y, aux = fn(local, x)
+            aux = aux_in + aux.astype(jnp.float32)
             # last stage emits microbatch t-(pp-1) at tick t
             emit_idx = jnp.clip(t - (pp - 1), 0, n_micro - 1)
             emit = jnp.logical_and(stage == pp - 1, t >= pp - 1)
@@ -84,9 +101,15 @@ def pipeline_apply(stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
                 emit,
                 jax.lax.dynamic_update_index_in_dim(outs, y, emit_idx, 0),
                 outs)
-            return (y, outs), None
+            aux_outs = jnp.where(
+                emit,
+                jax.lax.dynamic_update_index_in_dim(aux_outs, aux, emit_idx,
+                                                    0),
+                aux_outs)
+            return (y, aux, outs, aux_outs), None
 
-        (_, outs), _ = jax.lax.scan(tick, (state, outs), jnp.arange(n_ticks))
+        (_, _, outs, aux_outs), _ = jax.lax.scan(
+            tick, (state, aux_state, outs, aux_outs), jnp.arange(n_ticks))
         # replicate the last stage's buffer across pipe ranks. The psum runs
         # in f32: low-precision collectives inside partial-auto shard_map hit
         # an XLA SPMD bug ("Invalid binary instruction opcode copy") — the
@@ -94,20 +117,23 @@ def pipeline_apply(stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
         # pipe-replicated input is a psum of its cotangent over pipe). The
         # per-tick ppermute stays in the compute dtype, so steady-state ICI
         # traffic is unaffected.
+        mask = (stage == pp - 1)
         outs = jax.lax.psum(
-            jnp.where(stage == pp - 1, outs.astype(jnp.float32), 0.0),
-            pipe_axis)
-        return outs
+            jnp.where(mask, outs.astype(jnp.float32), 0.0), pipe_axis)
+        aux_total = jax.lax.psum(jnp.where(mask, jnp.mean(aux_outs), 0.0),
+                                 pipe_axis)
+        return outs, aux_total
 
-    out = jax.shard_map(
+    out, aux_total = jax.shard_map(
         inner,
         mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(pipe_axis), stage_params), P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names={pipe_axis},
         check_vma=False,
     )(stage_params, micros.astype(jnp.float32))
-    return out.astype(compute_dtype)
+    out = out.astype(compute_dtype)
+    return (out, aux_total) if with_aux else out
 
 
 def stack_stage_params(per_layer_params: PyTree, pp: int) -> PyTree:

@@ -152,3 +152,150 @@ def test_pipelined_training_descends():
     assert losses[-1] < losses[0], losses
     with pytest.raises(RuntimeError):
         engine.forward(mk())
+
+
+# -- 1F1B executor (runtime/pipe/one_f_one_b) ---------------------------------
+
+from deepspeed_tpu.runtime.pipe.one_f_one_b import (
+    build_1f1b_tables, pipeline_1f1b_value_and_grad)
+
+
+def test_1f1b_tables_valid():
+    """Every micro forwards and backwards exactly once per stage, sends
+    always land one tick before their consumption, and in-flight forwards
+    never exceed the ring capacity."""
+    for m, pp in [(4, 2), (8, 4), (3, 4), (6, 3)]:
+        t = build_1f1b_tables(m, pp)
+        fwd, bwd = t["fwd"], t["bwd"]
+        for s in range(pp):
+            assert sorted(x for x in fwd[:, s] if x >= 0) == list(range(m))
+            assert sorted(x for x in bwd[:, s] if x >= 0) == list(range(m))
+            # in-flight bound (the 1F1B memory claim): #fwd - #bwd <= min(pp,m)
+            inflight = np.cumsum(fwd[:, s] >= 0) - np.cumsum(bwd[:, s] >= 0)
+            assert inflight.max() <= min(pp, m)
+        # fwd of micro f on stage s strictly after on stage s-1
+        for s in range(1, pp):
+            for f in range(m):
+                t_prev = int(np.where(fwd[:, s - 1] == f)[0][0])
+                t_here = int(np.where(fwd[:, s] == f)[0][0])
+                assert t_here > t_prev
+
+
+def test_1f1b_grads_match_sequential():
+    """Hand-scheduled 1F1B loss + grads == plain autodiff of the stacked
+    stages (the executor's correctness oracle)."""
+    from jax.sharding import Mesh
+    pp, n_micro, mb, H = 4, 6, 2, 8
+    rng = np.random.RandomState(0)
+    sp = {"w": jnp.asarray(rng.randn(pp, H, H) * 0.3, jnp.float32),
+          "b": jnp.asarray(rng.randn(pp, H) * 0.1, jnp.float32)}
+    head = {"v": jnp.asarray(rng.randn(H) * 0.5, jnp.float32)}
+    micros = jnp.asarray(rng.randn(n_micro, mb, H), jnp.float32)
+    labels = jnp.asarray(rng.randn(n_micro, mb), jnp.float32)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def loss_fn(h, y, lab):
+        return jnp.mean((y @ h["v"] - lab) ** 2)
+
+    def ref_loss(sp, hp, mi):
+        def one(m, lab):
+            x = m
+            for s in range(pp):
+                x = stage_fn(jax.tree.map(lambda a: a[s], sp), x)
+            return loss_fn(hp, x, lab)
+        return jnp.mean(jax.vmap(one)(mi, labels))
+
+    ref_l, (rgs, rgh, rgm) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1, 2))(sp, head, micros)
+    mesh = Mesh(np.asarray(jax.devices()[:pp]).reshape(pp), ("pipe",))
+    loss, gs, gh, gm = jax.jit(
+        lambda a, b, c, d: pipeline_1f1b_value_and_grad(
+            stage_fn, loss_fn, a, b, c, d, mesh=mesh, pp=pp))(
+        sp, head, micros, labels)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(gs[k]), np.asarray(rgs[k]),
+                                   rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gh["v"]), np.asarray(rgh["v"]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gm), np.asarray(rgm), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_pipeline_engine_1f1b_matches_gpipe():
+    """Same model trained one step under schedule=gpipe vs schedule=1f1b:
+    losses and updated params agree (bf16 boundary, no f32 crossing)."""
+    kw = dict(hidden_size=64, num_layers=4, num_heads=4, vocab_size=256,
+              max_seq_len=64, dtype=jnp.float32, attention_impl="reference")
+
+    def make(schedule):
+        piped, cfg = build_pipelined_model("gpt2-tiny", pp=2, n_micro=4, **kw)
+        config = {
+            "train_batch_size": 32,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+            "zero_optimization": {"stage": 0},
+            "pipeline": {"stages": 2, "schedule": schedule},
+            "seed": 11,
+        }
+        rng = np.random.default_rng(2)
+        batch = _mk_batch(rng, cfg.vocab_size, 32, 32)
+        engine, *_ = ds.initialize(model=piped, config=config,
+                                   loss_fn=causal_lm_loss,
+                                   example_batch=batch,
+                                   rng=jax.random.PRNGKey(7))
+        return engine, cfg
+
+    e_g, cfg = make("gpipe")
+    e_f, _ = make("1f1b")
+    # strongest check: 1F1B grads == autodiff grads at the shared init
+    # (post-Adam params drift by design — Adam sign-amplifies fp roundoff)
+    batch = _mk_batch(np.random.default_rng(49), cfg.vocab_size, 32, 32)
+    batch_j = {k: jnp.asarray(v) for k, v in batch.items()}
+    params = e_f.state.params
+    mesh = e_f.mesh
+    with mesh:
+        _, g1 = jax.jit(lambda p, b: e_f.module.train_value_and_grad(
+            p, b, mesh=mesh))(params, batch_j)
+        _, g2 = jax.jit(jax.value_and_grad(lambda p: causal_lm_loss(
+            e_f.module.apply({"params": p}, batch_j, mesh=mesh),
+            batch_j)))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-6)
+    for i in range(3):
+        b = _mk_batch(np.random.default_rng(50 + i), cfg.vocab_size, 32, 32)
+        lg = float(e_g.train_batch(b)["loss"])
+        lf = float(e_f.train_batch(b)["loss"])
+        assert abs(lg - lf) < 2e-3, (i, lg, lf)
+
+
+def test_moe_pipeline_composition():
+    """MoE + PP (round-1 gap: raised NotImplementedError): the aux loss
+    rides the pipe and the composition trains."""
+    from deepspeed_tpu.models.transformer import make_moe_loss
+    piped, cfg = build_pipelined_model(
+        "gpt2-tiny", pp=2, n_micro=2, hidden_size=64, num_layers=4,
+        num_heads=4, vocab_size=256, max_seq_len=64, moe_experts=4,
+        dtype=jnp.float32, attention_impl="reference")
+    config = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+        "zero_optimization": {"stage": 0},
+        "pipeline": {"stages": 2},
+        "seed": 3,
+    }
+    rng = np.random.default_rng(4)
+    mk = lambda: _mk_batch(rng, cfg.vocab_size, 16, 32)
+    engine, *_ = ds.initialize(model=piped, config=config,
+                               loss_fn=make_moe_loss(), example_batch=mk())
+    losses = [float(engine.train_batch(mk())["loss"]) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+    # aux channel really contributes: eval returns (logits, aux)
+    logits, aux = engine.eval_batch(mk())
+    assert float(aux) > 0.0
