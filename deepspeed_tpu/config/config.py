@@ -514,12 +514,6 @@ def unconsumed_sections(cfg: "DeepSpeedConfig") -> List[str]:
                 and sub.get("enabled"):
             out.append(f"data_efficiency.data_sampling.{key} "
                        "(use runtime.data_pipeline.DeepSpeedDataSampler)")
-    if cfg.eigenvalue.enabled:
-        out.append("eigenvalue")
-    if cfg.progressive_layer_drop.enabled:
-        out.append("progressive_layer_drop")
-    if cfg.quantize_training.get("enabled"):
-        out.append("quantize_training")
     return out
 
 

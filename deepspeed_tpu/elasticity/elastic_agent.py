@@ -1,0 +1,88 @@
+"""Elastic agent — restart training on membership change or worker failure.
+
+Capability parity with the reference's ``elasticity/elastic_agent.py:23``
+(DSElasticAgent over torch-elastic's LocalElasticAgent: monitor workers,
+re-rendezvous and restart on scale-up/down) without the torch rendezvous
+store: membership is the hostfile (the thing cluster managers actually
+mutate), the agent polls it, and on change — or on worker crash, up to
+``max_restarts`` — it terminates the run and relaunches with the new world,
+re-deriving the elastic batch config (elasticity.compute_elastic_config's
+HCN math) for the new node count. Training resumes from the engine's own
+checkpoints (topology-free by construction).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..launcher.runner import fetch_hostfile
+from ..utils.logging import log_dist, logger
+
+
+class DSElasticAgent:
+    def __init__(self,
+                 launch_fn: Callable[[List[str]], subprocess.Popen],
+                 hostfile: str,
+                 max_restarts: int = 100,
+                 check_interval: float = 1.0,
+                 min_nodes: int = 1):
+        """launch_fn(active_hosts) -> Popen for one training run."""
+        self.launch_fn = launch_fn
+        self.hostfile = hostfile
+        self.max_restarts = max_restarts
+        self.check_interval = check_interval
+        self.min_nodes = min_nodes
+        self.restarts = 0
+        self.membership_changes = 0
+
+    def _members(self) -> List[str]:
+        pool = fetch_hostfile(self.hostfile)
+        return list(pool) if pool else ["localhost"]
+
+    def run(self) -> int:
+        """Supervise until a run exits 0 (or restarts are exhausted).
+        Returns the final exit code (reference: _invoke_run's monitor loop,
+        elastic_agent.py:115)."""
+        while True:
+            members = self._members()
+            if len(members) < self.min_nodes:
+                logger.warning("elastic agent: %d nodes < min %d; waiting",
+                               len(members), self.min_nodes)
+                time.sleep(self.check_interval)
+                continue
+            log_dist(f"elastic agent: launching over {len(members)} nodes "
+                     f"(restart {self.restarts})", ranks=[0])
+            proc = self.launch_fn(members)
+            rc = self._monitor(proc, members)
+            if rc == 0:
+                return 0
+            if rc == -1:
+                self.membership_changes += 1
+                continue                      # membership change: relaunch
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                logger.error("elastic agent: max_restarts exceeded (rc=%d)",
+                             rc)
+                return rc
+
+    def _monitor(self, proc: subprocess.Popen, members: List[str]) -> int:
+        """Poll worker + membership. Returns the worker rc, or -1 when the
+        hostfile changed (worker is terminated first)."""
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                return rc
+            if self._members() != members:
+                log_dist("elastic agent: membership changed — restarting",
+                         ranks=[0])
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+                return -1
+            time.sleep(self.check_interval)

@@ -18,15 +18,41 @@ import sys
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(prog="deepspeed_tpu.launcher.launch")
-    p.add_argument("--node_rank", type=int, required=True)
+    p.add_argument("--node_rank", type=int, required=True,
+                   help="-1 = autodetect from the scheduler env "
+                        "(OMPI/SLURM/PMI rank) or hostname-in-world_info")
     p.add_argument("--nnodes", type=int, required=True)
     p.add_argument("--coordinator", required=True,
                    help="host:port of process 0")
     p.add_argument("--world_info", default="",
-                   help="base64 host->slots map (informational on TPU)")
+                   help="base64 host->slots map (rank autodetect + info)")
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
+
+
+def resolve_node_rank(args) -> int:
+    """-1 -> scheduler env rank (mpirun/srun set these per process) or the
+    host's position in world_info (the pdsh path, where every node gets the
+    identical command — reference launch.py derives rank the same two ways)."""
+    if args.node_rank >= 0:
+        return args.node_rank
+    import os
+    for var in ("OMPI_COMM_WORLD_RANK", "SLURM_PROCID", "PMI_RANK",
+                "PMIX_RANK"):
+        if var in os.environ:
+            return int(os.environ[var])
+    if args.world_info:
+        import socket
+        from .runner import decode_world_info
+        hosts = list(decode_world_info(args.world_info))
+        name = socket.gethostname()
+        for i, h in enumerate(hosts):
+            if h == name or name.startswith(h) or h.startswith(name):
+                return i
+    raise RuntimeError(
+        "cannot autodetect node_rank: no scheduler rank env var and the "
+        "hostname is not in world_info")
 
 
 def main(argv=None):
@@ -36,7 +62,7 @@ def main(argv=None):
         jax.distributed.initialize(
             coordinator_address=args.coordinator,
             num_processes=args.nnodes,
-            process_id=args.node_rank)
+            process_id=resolve_node_rank(args))
     sys.argv = [args.user_script] + args.user_args
     runpy.run_path(args.user_script, run_name="__main__")
 

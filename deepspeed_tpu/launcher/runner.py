@@ -163,7 +163,8 @@ def parse_args(argv=None):
     p.add_argument("--num_nodes", type=int, default=-1)
     p.add_argument("--master_port", type=int, default=29500)
     p.add_argument("--master_addr", default="")
-    p.add_argument("--launcher", default="ssh", choices=["ssh", "local"])
+    p.add_argument("--launcher", default="ssh",
+                   choices=["ssh", "local", "pdsh", "openmpi", "slurm"])
     p.add_argument("--autotuning", default="", choices=["", "run", "tune"],
                    help="search ds_configs instead of launching directly "
                         "(reference: deepspeed --autotuning)")
@@ -205,6 +206,18 @@ def main(argv=None):
     coordinator = args.master_addr or hosts[0]
     world_info = encode_world_info(active)
     exports = collect_env_exports()
+    if args.launcher in ("pdsh", "openmpi", "slurm"):
+        # backend fans out itself — ONE scheduler command (reference:
+        # multinode_runner.py get_cmd per backend)
+        from .multinode_runner import build_runner
+        runner = build_runner(args.launcher, args, world_info)
+        if not runner.backend_exists():
+            sys.exit(f"launcher backend '{args.launcher}' not found in PATH")
+        env = {"DSTPU_WORLD_INFO": world_info,
+               "DSTPU_COORDINATOR": coordinator,
+               "DSTPU_MASTER_PORT": str(args.master_port), **exports}
+        cmd = runner.get_cmd(env, active)
+        sys.exit(subprocess.call(cmd))
     procs = []
     for idx, host in enumerate(hosts):
         remote = build_launch_cmd(idx, len(hosts), coordinator,

@@ -162,14 +162,26 @@ class PipelinedTransformer:
             return h
 
         head = {"ln_f": params["ln_f"], "wte": params["wte"]["embedding"]}
+        # global token mean: the executor averages per-micro losses, so each
+        # micro contributes its nll SUM scaled by n_micro/total_valid — with
+        # unevenly -100-masked micros a per-micro mean would overweight
+        # sparse ones vs the gpipe/causal_lm_loss objective
+        total_valid = jnp.maximum(
+            jnp.sum((lab_micros[:, :, 1:] != -100).astype(jnp.float32)), 1.0)
 
         def loss_fn(head_p, y, lab):
             h = self._ln_f.apply({"params": head_p["ln_f"]}, y)
             logits = jnp.einsum("bsh,vh->bsv", h,
                                 head_p["wte"].astype(h.dtype))
-            from .transformer import cross_entropy
-            return cross_entropy(logits[:, :-1].astype(jnp.float32),
-                                 lab[:, 1:])
+            logits = logits[:, :-1].astype(jnp.float32)
+            tgt = lab[:, 1:]
+            valid = tgt != -100
+            safe = jnp.where(valid, tgt, 0)
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, safe[..., None],
+                                       axis=-1)[..., 0]
+            nll_sum = jnp.sum((logz - gold) * valid)
+            return nll_sum * (self.n_micro / total_valid)
 
         loss, gs, gh, dmicros = pipeline_1f1b_value_and_grad(
             stage_fn, loss_fn, stage_params, head, micros, lab_micros,

@@ -89,6 +89,10 @@ class TransformerConfig:
     ltd_tokens: int = 0
     ltd_start: int = 0
     ltd_end: int = 0
+    # progressive layer drop (reference: runtime/progressive_layer_drop.py):
+    # keep layer l with prob 1 - (l/L)(1-theta); theta arrives per step via
+    # the "pld_theta" batch key (so no recompile as the schedule moves)
+    pld: bool = False
     # MoE (reference: deepspeed/moe/*): >0 replaces every block's MLP with a
     # mixture of moe_experts experts; aux loss returned next to the logits
     moe_experts: int = 0
@@ -401,15 +405,48 @@ class Transformer(nn.Module):
                              policy=policies[cfg.remat_policy])
         windows = (jnp.asarray(cfg.layer_windows, jnp.int32)
                    if cfg.layer_windows is not None else None)
+        pld_on = cfg.pld and train and self.has_rng("pld")
+        theta = jnp.asarray(1.0, jnp.float32)
+        if pld_on and isinstance(batch, dict) and \
+                batch.get("pld_theta") is not None:
+            theta = batch["pld_theta"].reshape(-1)[0].astype(jnp.float32)
+        L = cfg.num_layers
+
+        def pld_gate(mdl_rng, carry, out, aux, layer_idx):
+            keep_p = 1.0 - ((layer_idx + 1.0) / L) * (1.0 - theta)
+            keep = jax.random.bernoulli(mdl_rng, keep_p)
+            return (jnp.where(keep, out, carry),
+                    jnp.where(keep, aux, 0.0))
+
         if cfg.scan_layers:
+            # the PLD variant threads an extra rng stream + layer index
+            # through the scan; keep the plain body when PLD is off — the
+            # extra scanned state disturbs the remat policy's saved set
+            # (measured ~20% step-time regression on the bench model)
+            if pld_on:
+                def body(mdl, carry, xs):
+                    w, li = xs
+                    out, aux = mdl(carry, attn_mask, train, w, position_ids)
+                    out, aux = pld_gate(mdl.make_rng("pld"), carry, out, aux,
+                                        li.astype(jnp.float32))
+                    return out, aux
+
+                xs = (windows, jnp.arange(L))
+                split = {"params": True, "dropout": True, "gating": True,
+                         "pld": True}
+            else:
+                def body(mdl, carry, w):
+                    return mdl(carry, attn_mask, train, w, position_ids)
+
+                xs = windows
+                split = {"params": True, "dropout": True, "gating": True}
             x, auxes = nn.scan(
-                lambda mdl, carry, w: mdl(carry, attn_mask, train, w,
-                                          position_ids),
+                body,
                 variable_axes={"params": 0},
-                split_rngs={"params": True, "dropout": True, "gating": True},
+                split_rngs=split,
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(block(cfg, name="blocks"), x, windows)
+            )(block(cfg, name="blocks"), x, xs)
             aux_total = jnp.sum(auxes)
         else:
             aux_total = jnp.zeros((), jnp.float32)
@@ -423,6 +460,8 @@ class Transformer(nn.Module):
             for i in range(cfg.num_layers):
                 w = windows[i] if windows is not None else None
                 blk = block(cfg, name=f"blocks_{i}")
+                if pld_on:
+                    x_in = x
                 if ltd_active and cfg.ltd_start <= i < cfg.ltd_end \
                         and cfg.ltd_tokens < S:
                     # random-LTD: this layer sees only a sampled token subset
@@ -440,6 +479,9 @@ class Transformer(nn.Module):
                     x = x.at[:, idx].set(out)
                 else:
                     x, aux = blk(x, attn_mask, train, w, position_ids)
+                if pld_on:
+                    x, aux = pld_gate(self.make_rng("pld"), x_in, x, aux,
+                                      float(i))
                 aux_total = aux_total + aux
 
         if not cfg.post_ln:

@@ -106,7 +106,14 @@ class DeepSpeedEngine:
         from ..compression import init_compression
         spec = init_compression({"compression_training":
                                  self.config.compression_training.model_dump()})
+        # MoQ (reference: runtime/quantize.py) compiles into the same
+        # weight-quantization machinery
+        from .quantize import build_moq_spec
+        moq = build_moq_spec(self.config.quantize_training)
+        if moq is not None:
+            spec.groups.extend(moq.groups)
         self.compression_spec = spec if spec.enabled else None
+        self._moq_enabled = moq is not None
         if self.compression_spec is not None:
             log_dist(f"compression training: "
                      f"{[g.kind + ':' + g.name for g in spec.groups]}",
@@ -297,6 +304,24 @@ class DeepSpeedEngine:
         self.skipped_steps = 0
         self.micro_steps = 0
 
+        # progressive layer drop + eigenvalue (reference: engine hooks for
+        # runtime/progressive_layer_drop.py + runtime/eigenvalue.py) ---------
+        self.progressive_layer_drop = None
+        if self.config.progressive_layer_drop.enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            pld_cfg = self.config.progressive_layer_drop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld_cfg.theta, gamma=pld_cfg.gamma)
+        self.eigenvalue = None
+        if self.config.eigenvalue.enabled:
+            from .eigenvalue import Eigenvalue
+            ev = self.config.eigenvalue
+            self.eigenvalue = Eigenvalue(
+                verbose=ev.verbose, max_iter=ev.max_iter, tol=ev.tol,
+                stability=ev.stability,
+                gas_boundary_resolution=ev.gas_boundary_resolution,
+                layer_name=ev.layer_name, layer_num=ev.layer_num)
+
         # data efficiency: seqlen curriculum (reference: engine curriculum
         # hooks + data_pipeline/data_sampling) -------------------------------
         self.curriculum = None
@@ -352,12 +377,19 @@ class DeepSpeedEngine:
         except (TypeError, ValueError):
             pass
 
+        # the "pld" stream is threaded only when the engine actually runs
+        # progressive layer drop — unused extra rng streams through nn.scan
+        # disturb the remat policy (measured bench regression)
+        wants_pld = self.config.progressive_layer_drop.enabled
+
         def apply_fn(params, batch, rng, train):
             kwargs = {"train": train} if takes_train else {}
             if takes_rngs:
                 if train:
-                    r_drop, r_gate = jax.random.split(rng)
+                    r_drop, r_gate, r_pld = jax.random.split(rng, 3)
                     kwargs["rngs"] = {"dropout": r_drop, "gating": r_gate}
+                    if wants_pld:
+                        kwargs["rngs"]["pld"] = r_pld
                 else:
                     kwargs["rngs"] = None
             return model.apply({"params": params}, batch, **kwargs)
@@ -631,6 +663,10 @@ class DeepSpeedEngine:
         from ..parallel.mesh import BATCH_AXES
         if self.curriculum is not None:
             batch = self.curriculum(batch, self.global_steps)
+        if self.progressive_layer_drop is not None and isinstance(batch, dict):
+            theta = self.progressive_layer_drop.update_state(self.global_steps)
+            bsz = len(next(iter(batch.values())))
+            batch = dict(batch, pld_theta=np.full((bsz,), theta, np.float32))
         gas = self.config.gradient_accumulation_steps
         micro_sharding = NamedSharding(self.mesh, P(None, BATCH_AXES))
         micros = jax.tree.map(
@@ -788,6 +824,47 @@ class DeepSpeedEngine:
         sys.exit(0)
 
     # ------------------------------------------------------------- accessors
+
+    def compute_eigenvalue(self, batch):
+        """Max Hessian eigenvalue of the loss on ``batch`` (reference:
+        engine eigenvalue hook at gas boundaries, feeding MoQ)."""
+        if self.eigenvalue is None:
+            raise RuntimeError("enable the 'eigenvalue' config section")
+        batch = self.shard_batch(batch)
+        if not hasattr(self, "_eig_loss"):
+            # STABLE closure: batch/rng flow through loss_args so the
+            # eigenvalue's jitted HVP step caches across calls
+            def _eig_loss(p, batch, rng):
+                out = self.apply_fn(p, batch, rng, True)
+                return self.loss_fn(out, batch)
+            self._eig_loss = _eig_loss
+        return self.eigenvalue.compute_eigenvalue(
+            self._eig_loss, self.state.params, self.next_rng(),
+            loss_args=(batch, self.next_rng()))
+
+    def moq_rescale(self, batch):
+        """Curvature-paced MoQ (reference: quantize.py eigenvalue gating):
+        measure the Hessian eigenvalue on ``batch`` and stretch the MoQ bit
+        schedule's period proportionally. Recompiles the train step with the
+        updated spec."""
+        if not getattr(self, "_moq_enabled", False) or self.eigenvalue is None:
+            raise RuntimeError("moq_rescale needs both quantize_training and "
+                               "eigenvalue enabled")
+        if not hasattr(self, "_moq_scheduler"):
+            from .quantize import MoQScheduler
+            self._moq_scheduler = MoQScheduler(self.compression_spec,
+                                               self.eigenvalue)
+        sharded = self.shard_batch(batch)
+        if not hasattr(self, "_eig_loss"):
+            self.compute_eigenvalue(batch)   # builds the stable closure
+        new_spec = self._moq_scheduler.maybe_rescale(
+            self._eig_loss, self.state.params, self.next_rng(),
+            loss_args=(sharded, self.next_rng()))
+        if new_spec is not self.compression_spec:
+            self.compression_spec = new_spec
+            if self._train_step is not None:
+                self._train_step = self._make_train_step()
+        return self.compression_spec
 
     def get_lr(self):
         if self.lr_scheduler is not None:

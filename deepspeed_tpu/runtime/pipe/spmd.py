@@ -86,7 +86,11 @@ def pipeline_apply(stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
             # GPipe fill/drain means its output was already emitted)
             recv = jax.lax.ppermute(state, pipe_axis,
                                     [(i, i + 1) for i in range(pp - 1)])
-            recv_aux = jax.lax.ppermute(aux_state, pipe_axis,
+            # chained on recv: two independent collectives can be scheduled
+            # in different orders per device, deadlocking the rendezvous
+            # (same hazard one_f_one_b.py documents)
+            tok = jnp.sum(recv).astype(jnp.float32) * 0.0
+            recv_aux = jax.lax.ppermute(aux_state + tok, pipe_axis,
                                         [(i, i + 1) for i in range(pp - 1)])
             inject = micros[jnp.clip(t, 0, n_micro - 1)]
             is_first = (stage == 0)

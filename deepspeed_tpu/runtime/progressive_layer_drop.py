@@ -1,0 +1,37 @@
+"""Progressive Layer Drop — compressed training via stochastic depth.
+
+Capability parity with the reference's ``runtime/progressive_layer_drop.py:5``
+(ProgressiveLayerDrop: theta(t) = (1-theta)*exp(-gamma*t) + theta schedule,
+handed to the model as pld_theta; the model keeps layer l with probability
+1 - (l/L)(1-theta), arXiv:2010.13369). The schedule object is identical
+math; the model side lives in models/transformer.py (cfg.pld + the
+"pld_theta" batch key, so theta changes per step without recompiling).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+from ..utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})",
+                 ranks=[0])
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = ((1.0 - self.theta)
+                              * math.exp(-self.gamma * global_step)
+                              + self.theta)
+        return self.current_theta
+
+    def get_state(self) -> Dict:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}

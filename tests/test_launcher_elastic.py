@@ -1,0 +1,127 @@
+"""Launcher end-to-end + elastic agent + multinode runner command building.
+
+Round-1 Weak #10 (launcher never tested end-to-end) and missing #8 (elastic
+agent). Mirrors the reference's tests/unit/test_ds_arguments + elasticity
+coverage.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- multinode runner command construction ------------------------------------
+
+def _args(**kw):
+    ns = types.SimpleNamespace(user_script="train.py", user_args=["--x", "1"],
+                               hostfile="/job/hostfile", include="")
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_pdsh_runner_cmd():
+    from deepspeed_tpu.launcher.multinode_runner import PDSHRunner
+    r = PDSHRunner(_args())
+    r.add_export("XLA_FLAGS", "--foo")
+    cmd = r.get_cmd({"A": "b"}, {"h1": [0], "h2": [0]})
+    assert cmd[0] == "pdsh"
+    assert "h1,h2" in cmd
+    joined = " ".join(cmd)
+    assert "export A=b" in joined and "export XLA_FLAGS" in joined
+    assert cmd[-1] == "1" and cmd[-2] == "--x" and cmd[-3] == "train.py"
+
+
+def test_openmpi_and_slurm_runner_cmds():
+    from deepspeed_tpu.launcher.multinode_runner import (OpenMPIRunner,
+                                                         SlurmRunner,
+                                                         build_runner)
+    cmd = OpenMPIRunner(_args()).get_cmd({"E": "v"}, {"a": [0], "b": [0]})
+    assert cmd[:3] == ["mpirun", "-n", "2"]
+    assert "-x" in cmd and "E=v" in cmd
+    cmd = SlurmRunner(_args()).get_cmd({"E": "v"}, {"a": [0]})
+    assert cmd[:2] == ["srun", "-n"]
+    assert any(c.startswith("--export=ALL,") for c in cmd)
+    with pytest.raises(ValueError, match="unknown launcher"):
+        build_runner("nope", _args())
+
+
+# -- launcher end-to-end on localhost -----------------------------------------
+
+def test_launcher_end_to_end_localhost(tmp_path):
+    """dstpu with a localhost hostfile + --launcher local actually runs the
+    user script through the per-host bootstrap (launch.py)."""
+    script = tmp_path / "probe.py"
+    marker = tmp_path / "ran.txt"
+    script.write_text(
+        "import sys\n"
+        f"open({str(marker)!r}, 'w').write(' '.join(sys.argv[1:]))\n")
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("localhost slots=1\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "dstpu"),
+         "--hostfile", str(hostfile), "--launcher", "local",
+         str(script), "--hello", "world"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert marker.exists()
+    assert marker.read_text() == "--hello world"
+
+
+# -- elastic agent ------------------------------------------------------------
+
+def test_elastic_agent_restarts_on_crash(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("localhost slots=1\n")
+    attempts = tmp_path / "attempts"
+
+    def launch(members):
+        # crash on the first attempt, succeed on the second
+        code = (f"import os\np={str(attempts)!r}\n"
+                "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+                "open(p, 'w').write(str(n + 1))\n"
+                "raise SystemExit(1 if n == 0 else 0)\n")
+        return subprocess.Popen([sys.executable, "-c", code])
+
+    agent = DSElasticAgent(launch, str(hostfile), max_restarts=3,
+                           check_interval=0.05)
+    assert agent.run() == 0
+    assert agent.restarts == 1
+    assert attempts.read_text() == "2"
+
+
+def test_elastic_agent_restarts_on_membership_change(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-0 slots=1\n")
+    seen_worlds = []
+
+    def launch(members):
+        seen_worlds.append(list(members))
+        if len(seen_worlds) == 1:
+            return subprocess.Popen([sys.executable, "-c",
+                                     "import time; time.sleep(60)"])
+        return subprocess.Popen([sys.executable, "-c", "raise SystemExit(0)"])
+
+    def scale_up():
+        time.sleep(0.4)
+        hostfile.write_text("worker-0 slots=1\nworker-1 slots=1\n")
+
+    t = threading.Thread(target=scale_up)
+    t.start()
+    agent = DSElasticAgent(launch, str(hostfile), check_interval=0.05)
+    rc = agent.run()
+    t.join()
+    assert rc == 0
+    assert agent.membership_changes == 1
+    assert seen_worlds[0] == ["worker-0"]
+    assert seen_worlds[1] == ["worker-0", "worker-1"]
