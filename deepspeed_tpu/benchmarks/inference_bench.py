@@ -1,0 +1,76 @@
+"""Inference latency benchmarks — prefill/forward + generation sweeps.
+
+Capability parity with the reference's ``benchmarks/inference`` (bert/gpt
+latency scripts): measures forward latency over batch/seq and per-token
+decode latency with the KV-cache generate loop, on the current backend.
+
+    python -m deepspeed_tpu.benchmarks.inference_bench \
+        [--preset gpt2-125m] [--batches 1,8] [--seqs 128,1024] [--new 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fence(out):
+    # fetch ONE element: forces execution without the full D2H (axon's
+    # block_until_ready does not fence — see benchmarks/sparse_attention_bench)
+    leaf = jax.tree.leaves(out)[0]
+    return np.asarray(leaf.reshape(-1)[0])
+
+
+def _timed(fn, iters=5):
+    _fence(fn())                         # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        _fence(fn())
+    return (time.perf_counter() - t0) / iters
+
+
+def run(preset: str, batches: List[int], seqs: List[int], new_tokens: int):
+    from ..models import build_model
+    from ..models.generation import generate
+    rows = []
+    for B in batches:
+        for S in seqs:
+            model, cfg = build_model(preset, max_seq_len=S + new_tokens)
+            ids = jnp.asarray(np.random.default_rng(0).integers(
+                0, cfg.vocab_size, (B, S)))
+            params = jax.jit(lambda r: model.init(r, {"input_ids": ids})
+                             ["params"])(jax.random.PRNGKey(0))
+            fwd = jax.jit(lambda p, i: model.apply({"params": p},
+                                                   {"input_ids": i}))
+            t_fwd = _timed(lambda: fwd(params, ids))
+            t_gen = _timed(
+                lambda: generate(cfg, params, ids, new_tokens), iters=3)
+            per_tok = (t_gen - t_fwd) / new_tokens
+            rows.append({
+                "preset": preset, "batch": B, "seq": S,
+                "forward_ms": round(t_fwd * 1e3, 2),
+                "generate_ms": round(t_gen * 1e3, 2),
+                "ms_per_token": round(per_tok * 1e3, 3),
+                "tokens_per_sec": round(B / max(per_tok, 1e-9), 1)})
+            print(rows[-1])
+    return rows
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="gpt2-125m")
+    p.add_argument("--batches", default="1,8")
+    p.add_argument("--seqs", default="128,1024")
+    p.add_argument("--new", type=int, default=64)
+    args = p.parse_args(argv)
+    run(args.preset, [int(x) for x in args.batches.split(",")],
+        [int(x) for x in args.seqs.split(",")], args.new)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,8 @@ from typing import Callable, Dict, List, Optional
 from ..launcher.runner import fetch_hostfile
 from ..utils.logging import log_dist, logger
 
+MEMBERSHIP_CHANGED = object()       # monitor sentinel; never equals an rc
+
 
 class DSElasticAgent:
     def __init__(self,
@@ -59,7 +61,7 @@ class DSElasticAgent:
             rc = self._monitor(proc, members)
             if rc == 0:
                 return 0
-            if rc == -1:
+            if rc is MEMBERSHIP_CHANGED:
                 self.membership_changes += 1
                 continue                      # membership change: relaunch
             self.restarts += 1
@@ -68,9 +70,11 @@ class DSElasticAgent:
                              rc)
                 return rc
 
-    def _monitor(self, proc: subprocess.Popen, members: List[str]) -> int:
-        """Poll worker + membership. Returns the worker rc, or -1 when the
-        hostfile changed (worker is terminated first)."""
+    def _monitor(self, proc: subprocess.Popen, members: List[str]):
+        """Poll worker + membership. Returns the worker rc, or the
+        MEMBERSHIP_CHANGED sentinel when the hostfile changed (a distinct
+        object — a signal-killed worker's negative rc must count as a crash,
+        not a rescale)."""
         while True:
             rc = proc.poll()
             if rc is not None:
@@ -84,5 +88,5 @@ class DSElasticAgent:
                 except subprocess.TimeoutExpired:
                     proc.kill()
                     proc.wait()
-                return -1
+                return MEMBERSHIP_CHANGED
             time.sleep(self.check_interval)

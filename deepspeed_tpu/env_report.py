@@ -74,6 +74,13 @@ def report_text() -> str:
         status = GREEN_OK if ok else f"{RED_NO} ({err})"
         lines.append(f"  {name:<28s} {status}")
     lines.append("-" * 60)
+    lines.append("op registry (impl selection; reference: op_builder/ALL_OPS)")
+    from deepspeed_tpu.ops.registry import compatibility_report
+    for op, impls in compatibility_report().items():
+        for impl, ok in impls.items():
+            status = GREEN_OK if ok else RED_NO
+            lines.append(f"  {op + '/' + impl:<28s} {status}")
+    lines.append("-" * 60)
     return "\n".join(lines)
 
 

@@ -47,8 +47,11 @@ def resolve_node_rank(args) -> int:
         from .runner import decode_world_info
         hosts = list(decode_world_info(args.world_info))
         name = socket.gethostname()
+        short = name.split(".")[0]
         for i, h in enumerate(hosts):
-            if h == name or name.startswith(h) or h.startswith(name):
+            # exact or FQDN-vs-shortname match ONLY: prefix matching would
+            # give worker-1 and worker-10 the same rank
+            if h == name or h == short or h.split(".")[0] == name:
                 return i
     raise RuntimeError(
         "cannot autodetect node_rank: no scheduler rank env var and the "

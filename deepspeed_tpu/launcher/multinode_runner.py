@@ -85,6 +85,7 @@ class OpenMPIRunner(MultiNodeRunner):
         total = len(active_resources)
         cmd = ["mpirun", "-n", str(total), "--hostfile",
                getattr(self.args, "hostfile", "/job/hostfile"),
+               "--map-by", "ppr:1:node",   # ONE rank per host (TPU contract)
                "--mca", "btl", "^openib",
                "--mca", "btl_tcp_if_include", "eth0"]
         for k, v in {**environment, **self.exports}.items():
@@ -103,9 +104,10 @@ class SlurmRunner(MultiNodeRunner):
 
     def get_cmd(self, environment, active_resources):
         total = len(active_resources)
-        cmd = ["srun", "-n", str(total), "--ntasks-per-node=1"]
-        if getattr(self.args, "include", ""):
-            cmd += ["--nodelist", self.args.include.replace("@", ",")]
+        cmd = ["srun", "-n", str(total), "--ntasks-per-node=1",
+               # the filtered pool IS the node list (the include syntax's
+               # ':slot' parts are not valid slurm node names)
+               "--nodelist", ",".join(active_resources)]
         exports = ",".join(f"{k}={v}" for k, v in
                            {**environment, **self.exports}.items())
         if exports:
@@ -113,7 +115,27 @@ class SlurmRunner(MultiNodeRunner):
         return cmd + self._user_cmd(environment, active_resources)
 
 
-RUNNERS = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner, "slurm": SlurmRunner}
+class MVAPICHRunner(OpenMPIRunner):
+    """reference: multinode_runner.py:218 — mpirun_rsh with MV2 env; the
+    TPU-relevant delta from OpenMPI is just the launcher binary + env names."""
+
+    name = "mvapich"
+
+    def backend_exists(self) -> bool:
+        import shutil
+        return shutil.which("mpirun_rsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total = len(active_resources)
+        cmd = ["mpirun_rsh", "-np", str(total), "-hostfile",
+               getattr(self.args, "hostfile", "/job/hostfile")]
+        for k, v in {**environment, **self.exports}.items():
+            cmd.append(f"{k}={v}")
+        return cmd + self._user_cmd(environment, active_resources)
+
+
+RUNNERS = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner, "slurm": SlurmRunner,
+           "mvapich": MVAPICHRunner}
 
 
 def build_runner(launcher: str, args, world_info_base64: str = ""

@@ -164,7 +164,8 @@ def parse_args(argv=None):
     p.add_argument("--master_port", type=int, default=29500)
     p.add_argument("--master_addr", default="")
     p.add_argument("--launcher", default="ssh",
-                   choices=["ssh", "local", "pdsh", "openmpi", "slurm"])
+                   choices=["ssh", "local", "pdsh", "openmpi", "slurm",
+                            "mvapich"])
     p.add_argument("--autotuning", default="", choices=["", "run", "tune"],
                    help="search ds_configs instead of launching directly "
                         "(reference: deepspeed --autotuning)")
@@ -206,7 +207,7 @@ def main(argv=None):
     coordinator = args.master_addr or hosts[0]
     world_info = encode_world_info(active)
     exports = collect_env_exports()
-    if args.launcher in ("pdsh", "openmpi", "slurm"):
+    if args.launcher in ("pdsh", "openmpi", "slurm", "mvapich"):
         # backend fans out itself — ONE scheduler command (reference:
         # multinode_runner.py get_cmd per backend)
         from .multinode_runner import build_runner

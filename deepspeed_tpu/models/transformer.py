@@ -398,6 +398,16 @@ class Transformer(nn.Module):
                     jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
                     jax.checkpoint_policies.save_only_these_names("attn_out")),
             }
+            # CPU activation checkpointing (reference: checkpointing.py
+            # cpu_checkpointing — saved activations live in host memory):
+            # offload the attention outputs to pinned host, recompute the rest
+            if hasattr(jax.checkpoint_policies,
+                       "save_and_offload_only_these_names"):
+                policies["offload"] = \
+                    jax.checkpoint_policies.save_and_offload_only_these_names(
+                        names_which_can_be_saved=[],
+                        names_which_can_be_offloaded=["attn_out"],
+                        offload_src="device", offload_dst="pinned_host")
             if cfg.remat_policy not in policies:
                 raise ValueError(f"unknown remat_policy '{cfg.remat_policy}'; "
                                  f"have {sorted(policies)}")

@@ -1,0 +1,94 @@
+"""Offline data analyzer — per-sample difficulty metrics for curriculum
+sampling.
+
+Capability parity with the reference's
+``data_pipeline/data_sampling/data_analyzer.py:527`` (DataAnalyzer: map a
+metric function over the dataset with worker sharding, persist per-sample
+values + a sample-index-sorted-by-metric file consumed by
+DeepSpeedDataSampler). Metrics ship for the reference's two canonical
+curricula — sequence length and vocabulary rarity — plus any user callable.
+Output is npz (values + argsort), loadable by DeepSpeedDataSampler.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Iterable, Optional, Sequence
+
+import numpy as np
+
+
+def seqlen_metric(sample) -> float:
+    """Token count (reference: seqlen curriculum metric)."""
+    ids = sample["input_ids"] if isinstance(sample, dict) else sample
+    arr = np.asarray(ids)
+    return float(arr.shape[-1] if arr.ndim else 1)
+
+
+def vocab_rarity_metric(vocab_freq: np.ndarray) -> Callable:
+    """-mean log frequency of the sample's tokens (reference:
+    voc curriculum — rarer vocabulary = harder)."""
+    logf = np.log(np.maximum(vocab_freq, 1e-12))
+
+    def metric(sample) -> float:
+        ids = np.asarray(sample["input_ids"] if isinstance(sample, dict)
+                         else sample).reshape(-1)
+        return float(-logf[ids].mean())
+
+    return metric
+
+
+METRICS: Dict[str, Callable] = {"seqlen": seqlen_metric}
+
+
+class DataAnalyzer:
+    def __init__(self, dataset: Sequence, metric: Callable | str = "seqlen",
+                 num_workers: int = 1, worker_id: int = 0,
+                 save_path: Optional[str] = None):
+        """dataset: indexable samples; metric: callable(sample)->float or a
+        METRICS name. num_workers/worker_id shard the scan like the
+        reference's distributed analyzer."""
+        self.dataset = dataset
+        self.metric = METRICS[metric] if isinstance(metric, str) else metric
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.save_path = save_path
+
+    def run(self) -> Dict[str, np.ndarray]:
+        n = len(self.dataset)
+        idx = np.arange(self.worker_id, n, self.num_workers)
+        values = np.empty(len(idx), np.float32)
+        for j, i in enumerate(idx):
+            values[j] = self.metric(self.dataset[int(i)])
+        out = {"index": idx.astype(np.int64), "values": values}
+        if self.save_path:
+            os.makedirs(os.path.dirname(self.save_path) or ".", exist_ok=True)
+            np.savez(self._worker_file(), **out)
+        return out
+
+    def _worker_file(self) -> str:
+        return f"{self.save_path}.worker{self.worker_id}.npz"
+
+    @staticmethod
+    def merge(save_path: str, num_workers: int) -> str:
+        """Combine worker shards into the final metric file: values ordered
+        by sample index + the metric-sorted sample order (the file
+        DeepSpeedDataSampler consumes)."""
+        idx_parts, val_parts = [], []
+        for w in range(num_workers):
+            with np.load(f"{save_path}.worker{w}.npz") as d:
+                idx_parts.append(d["index"])
+                val_parts.append(d["values"])
+        index = np.concatenate(idx_parts)
+        values = np.concatenate(val_parts)
+        order = np.argsort(index)
+        dense = values[order]                       # values by sample id
+        np.savez(save_path, values=dense,
+                 sorted_indices=np.argsort(dense, kind="stable"))
+        return save_path
+
+    @staticmethod
+    def load(save_path: str) -> Dict[str, np.ndarray]:
+        with np.load(save_path if save_path.endswith(".npz")
+                     else save_path + ".npz") as d:
+            return {k: d[k] for k in d.files}

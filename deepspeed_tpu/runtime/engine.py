@@ -341,6 +341,25 @@ class DeepSpeedEngine:
                      f"({cl_cfg.get('schedule_type', 'fixed_linear')})",
                      ranks=[0])
 
+        # activation checkpointing section (reference:
+        # runtime/activation_checkpointing/): remat lives in the model config;
+        # surface mismatches instead of silently ignoring the section
+        act = self.config.activation_checkpointing
+        mcfg = getattr(model, "cfg", None)
+        if act.cpu_checkpointing and mcfg is not None and \
+                getattr(mcfg, "remat_policy", None) != "offload":
+            logger.warning(
+                "activation_checkpointing.cpu_checkpointing is set but the "
+                "model's remat_policy is %r — build the model with "
+                "remat=True, remat_policy='offload' to host-offload saved "
+                "activations", getattr(mcfg, "remat_policy", None))
+        if act.partition_activations and mcfg is not None and \
+                not getattr(mcfg, "remat", False):
+            logger.warning(
+                "activation_checkpointing.partition_activations: saved "
+                "activations are mesh-sharded by construction on TPU; set "
+                "the model's remat=True to activate checkpointing itself")
+
         from ..config.config import warn_unconsumed
         warn_unconsumed(self.config)
         log_dist(f"DeepSpeedEngine initialized: ZeRO stage {stage}, "
@@ -856,7 +875,10 @@ class DeepSpeedEngine:
                                                self.eigenvalue)
         sharded = self.shard_batch(batch)
         if not hasattr(self, "_eig_loss"):
-            self.compute_eigenvalue(batch)   # builds the stable closure
+            def _eig_loss(p, batch, rng):
+                out = self.apply_fn(p, batch, rng, True)
+                return self.loss_fn(out, batch)
+            self._eig_loss = _eig_loss
         new_spec = self._moq_scheduler.maybe_rescale(
             self._eig_loss, self.state.params, self.next_rng(),
             loss_args=(sharded, self.next_rng()))

@@ -1,0 +1,66 @@
+"""Memory introspection — see_memory_usage for TPU + host.
+
+Capability parity with the reference's ``utils/see_memory_usage``
+(runtime/utils.py: cuda allocated/reserved + host RSS logging at tagged
+points). TPU edition: per-device live-buffer bytes from
+``device.memory_stats()`` (PJRT exposes bytes_in_use/peak) + host RSS from
+/proc, same call shape: ``see_memory_usage("after step", force=True)``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+from .logging import logger
+
+
+def host_rss_bytes() -> int:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+def device_memory_stats() -> Dict[str, int]:
+    """Summed live/peak bytes over addressable devices (0s when the backend
+    doesn't expose memory_stats, e.g. CPU)."""
+    in_use = peak = limit = 0
+    for d in jax.local_devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except (RuntimeError, AttributeError):
+            pass
+        in_use += stats.get("bytes_in_use", 0)
+        peak += stats.get("peak_bytes_in_use", 0)
+        limit += stats.get("bytes_limit", 0)
+    return {"bytes_in_use": in_use, "peak_bytes_in_use": peak,
+            "bytes_limit": limit}
+
+
+def see_memory_usage(message: str, force: bool = False,
+                     ranks=(0,)) -> Optional[Dict[str, float]]:
+    """Log device + host memory at a tagged point; returns the numbers.
+    ``force=False`` mirrors the reference's no-op default so call sites can
+    stay in production code."""
+    if not force:
+        return None
+    if jax.process_index() not in ranks:
+        return None
+    dev = device_memory_stats()
+    gb = 1024 ** 3
+    out = {"device_GB": dev["bytes_in_use"] / gb,
+           "device_peak_GB": dev["peak_bytes_in_use"] / gb,
+           "device_limit_GB": dev["bytes_limit"] / gb,
+           "host_rss_GB": host_rss_bytes() / gb}
+    logger.info(
+        "MEM %s | device %.2fGB (peak %.2fGB / limit %.2fGB) | host RSS %.2fGB",
+        message, out["device_GB"], out["device_peak_GB"],
+        out["device_limit_GB"], out["host_rss_GB"])
+    return out

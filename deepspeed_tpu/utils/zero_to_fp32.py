@@ -1,0 +1,54 @@
+"""zero_to_fp32 — consolidate a training checkpoint into one fp32 weights file.
+
+Capability parity with the reference's ``utils/zero_to_fp32.py`` CLI (walk
+the zero partitioned checkpoint, merge shards, emit a load_state_dict-able
+file). Our checkpoints store whole name-keyed tensors already, so
+consolidation = read the master (fp32) weights (falling back to the model
+weights upcast) and write a single fp32 npz::
+
+    python -m deepspeed_tpu.utils.zero_to_fp32 ckpt_dir output.npz [--tag T]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(
+        ckpt_dir: str, output_file: str, tag: Optional[str] = None) -> dict:
+    from ..runtime.checkpointing import get_latest_tag, read_flat_npz
+    if tag is None:
+        tag = get_latest_tag(ckpt_dir)
+        if tag is None:
+            raise FileNotFoundError(f"no 'latest' tag in {ckpt_dir}")
+    d = os.path.join(ckpt_dir, tag)
+    optim = read_flat_npz(os.path.join(d, "optim_states.npz"))
+    masters = {k[len("master/"):]: v for k, v in optim.items()
+               if k.startswith("master/")}
+    if not masters:
+        # fp32 runs alias master into the model file
+        masters = read_flat_npz(os.path.join(d, "model_states.npz"))
+    state_dict = {k: np.asarray(v, np.float32) for k, v in masters.items()}
+    np.savez(output_file, **state_dict)
+    return state_dict
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="zero_to_fp32")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    args = p.parse_args(argv)
+    sd = convert_zero_checkpoint_to_fp32_state_dict(
+        args.checkpoint_dir, args.output_file, args.tag)
+    total = sum(int(np.prod(v.shape)) for v in sd.values())
+    print(f"wrote {len(sd)} fp32 tensors ({total:,} params) "
+          f"to {args.output_file}")
+
+
+if __name__ == "__main__":
+    main()
