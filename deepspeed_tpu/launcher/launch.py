@@ -39,7 +39,7 @@ def resolve_node_rank(args) -> int:
         return args.node_rank
     import os
     for var in ("OMPI_COMM_WORLD_RANK", "SLURM_PROCID", "PMI_RANK",
-                "PMIX_RANK"):
+                "PMIX_RANK", "MV2_COMM_WORLD_RANK", "MPIRUN_RANK"):
         if var in os.environ:
             return int(os.environ[var])
     if args.world_info:

@@ -74,8 +74,8 @@ def attention(q: jnp.ndarray,
               dropout_rate: float = 0.0,
               dropout_rng: Optional[jax.Array] = None,
               impl: str = "auto",
-              block_q: int = 256,
-              block_k: int = 256) -> jnp.ndarray:
+              block_q: int = 1024,
+              block_k: int = 1024) -> jnp.ndarray:
     """Dispatching attention entry point. Shapes: [batch, heads, seq, head_dim]."""
     needs_reference = bias is not None or mask is not None or dropout_rate > 0.0
     if impl == "auto":

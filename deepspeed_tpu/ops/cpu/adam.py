@@ -30,14 +30,17 @@ class DeepSpeedCPUAdam:
 
     def __init__(self, lr: float = 1e-3, betas: Tuple[float, float] = (0.9, 0.999),
                  eps: float = 1e-8, weight_decay: float = 0.0,
-                 bias_correction: bool = True, adamw_mode: bool = True):
+                 bias_correction: bool = True, adamw_mode: bool = True,
+                 use_native: bool = True):
         self.lr = float(lr)
         self.betas = (float(betas[0]), float(betas[1]))
         self.eps = float(eps)
         self.weight_decay = float(weight_decay)
         self.bias_correction = bool(bias_correction)
         self.adamw_mode = bool(adamw_mode)
-        self._lib = load_cpu_kernels()
+        # use_native=False forces the numpy path (op-registry impl selection
+        # and C++-kernel triage both need an honest fallback switch)
+        self._lib = load_cpu_kernels() if use_native else None
 
     @property
     def has_native(self) -> bool:
@@ -100,9 +103,9 @@ class DeepSpeedCPUAdagrad:
     """reference: deepspeed/ops/adagrad/cpu_adagrad.py over csrc/adagrad."""
 
     def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
-                 weight_decay: float = 0.0):
+                 weight_decay: float = 0.0, use_native: bool = True):
         self.lr, self.eps, self.weight_decay = float(lr), float(eps), float(weight_decay)
-        self._lib = load_cpu_kernels()
+        self._lib = load_cpu_kernels() if use_native else None
 
     def init_state(self, param: np.ndarray) -> Dict[str, np.ndarray]:
         return {"sum": np.zeros_like(param, dtype=np.float32)}

@@ -23,11 +23,11 @@ class AsyncIOHandle:
 
     def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
                  single_submit: bool = False, overlap_events: bool = True,
-                 thread_count: int = 4):
+                 thread_count: int = 4, use_native: bool = True):
         self.block_size = int(block_size)
         self.queue_depth = int(queue_depth)
         self.thread_count = int(thread_count)
-        self._lib = load_aio()
+        self._lib = load_aio() if use_native else None
         self._handle = None
         self._py_pending = []        # fallback: (write, array, path, offset)
         if self._lib is not None:

@@ -299,16 +299,17 @@ def flash_attention(q: jnp.ndarray,
                     *,
                     causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 256,
-                    block_k: int = 256,
+                    block_q: int = 1024,
+                    block_k: int = 1024,
                     block_q_bwd: Optional[int] = None,
                     block_k_bwd: Optional[int] = None,
                     interpret: bool = False) -> jnp.ndarray:
     """Flash attention. q,k,v: [batch, heads, seq, head_dim] -> same shape.
 
-    Forward and backward take independent block sizes: measured on v5e the
-    online-softmax forward peaks at 256x256 while the recompute-heavy backward
-    kernels want 512x512 (fewer grid steps, better MXU occupancy per step).
+    Forward and backward take independent block sizes: measured on v5e
+    (gpt2-350m, seq 1024, D=64) 1024x1024 blocks win for BOTH passes — at
+    seq<=1024 the whole sequence sits in one tile (no online-softmax loop),
+    and per-step MXU occupancy dominates VMEM pressure up to that size.
 
     Falls back to the jnp reference when shapes don't tile (short sequences):
     kernels want seq % block == 0 and head_dim lane-friendly.
@@ -317,16 +318,19 @@ def flash_attention(q: jnp.ndarray,
     Sk = k.shape[-2]
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(D))
-    block_q = min(block_q, S)
-    block_k = min(block_k, Sk)
-    # bwd defaults to 512 blocks but must not push a sequence that tiles at
-    # the fwd sizes onto the dense fallback — snap down to the fwd block
-    block_q_bwd = min(block_q_bwd or max(block_q, 512), S)
-    block_k_bwd = min(block_k_bwd or max(block_k, 512), Sk)
-    if S % block_q_bwd != 0:
-        block_q_bwd = block_q
-    if Sk % block_k_bwd != 0:
-        block_k_bwd = block_k
+
+    def snap(seq_len: int, want: int) -> int:
+        """Largest 16-multiple divisor of seq_len <= want (keeps e.g.
+        seq=1280 on the kernel at block 256 instead of falling back dense)."""
+        b = min(want, seq_len)
+        while b > 16 and (seq_len % b or b % 16):
+            b -= 16
+        return b
+
+    block_q = snap(S, block_q)
+    block_k = snap(Sk, block_k)
+    block_q_bwd = snap(S, block_q_bwd or max(block_q, 512))
+    block_k_bwd = snap(Sk, block_k_bwd or max(block_k, 512))
     # fall back unless blocks tile the sequences AND are TPU-tile aligned
     # (sublane multiple of 16 covers bf16; lane dim D padded by Mosaic)
     aligned = all(s % b == 0 and b % 16 == 0

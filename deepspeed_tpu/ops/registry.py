@@ -92,9 +92,21 @@ def _register_builtins():
         from .cpu.adam import DeepSpeedCPUAdam
         return DeepSpeedCPUAdam
 
+    def _cpu_adam_numpy():
+        import functools
+
+        from .cpu.adam import DeepSpeedCPUAdam
+        return functools.partial(DeepSpeedCPUAdam, use_native=False)
+
     def _aio():
         from .cpu.aio import AsyncIOHandle
         return AsyncIOHandle
+
+    def _aio_python():
+        import functools
+
+        from .cpu.aio import AsyncIOHandle
+        return functools.partial(AsyncIOHandle, use_native=False)
 
     REGISTRY.register("attention", OpImpl(
         "pallas_flash", _flash, _on_tpu, priority=10))
@@ -108,13 +120,14 @@ def _register_builtins():
                            fromlist=["load_cpu_kernels"]
                            ).load_cpu_kernels() is not None, priority=10))
     REGISTRY.register("cpu_adam", OpImpl(
-        "numpy", _cpu_adam, lambda: True, priority=0))
+        "numpy", _cpu_adam_numpy, lambda: True, priority=0))
     REGISTRY.register("aio", OpImpl(
         "cpp_threadpool", _aio,
         lambda: __import__("deepspeed_tpu.ops.cpu.build",
                            fromlist=["load_aio"]).load_aio() is not None,
         priority=10))
-    REGISTRY.register("aio", OpImpl("python", _aio, lambda: True, priority=0))
+    REGISTRY.register("aio", OpImpl("python", _aio_python, lambda: True,
+                                    priority=0))
 
 
 _register_builtins()

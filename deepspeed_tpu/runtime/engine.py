@@ -850,16 +850,19 @@ class DeepSpeedEngine:
         if self.eigenvalue is None:
             raise RuntimeError("enable the 'eigenvalue' config section")
         batch = self.shard_batch(batch)
+        return self.eigenvalue.compute_eigenvalue(
+            self._ensure_eig_loss(), self.state.params, self.next_rng(),
+            loss_args=(batch, self.next_rng()))
+
+    def _ensure_eig_loss(self):
+        """STABLE loss closure (batch/rng flow through loss_args) so the
+        eigenvalue's jitted HVP step caches across calls."""
         if not hasattr(self, "_eig_loss"):
-            # STABLE closure: batch/rng flow through loss_args so the
-            # eigenvalue's jitted HVP step caches across calls
             def _eig_loss(p, batch, rng):
                 out = self.apply_fn(p, batch, rng, True)
                 return self.loss_fn(out, batch)
             self._eig_loss = _eig_loss
-        return self.eigenvalue.compute_eigenvalue(
-            self._eig_loss, self.state.params, self.next_rng(),
-            loss_args=(batch, self.next_rng()))
+        return self._eig_loss
 
     def moq_rescale(self, batch):
         """Curvature-paced MoQ (reference: quantize.py eigenvalue gating):
@@ -874,13 +877,8 @@ class DeepSpeedEngine:
             self._moq_scheduler = MoQScheduler(self.compression_spec,
                                                self.eigenvalue)
         sharded = self.shard_batch(batch)
-        if not hasattr(self, "_eig_loss"):
-            def _eig_loss(p, batch, rng):
-                out = self.apply_fn(p, batch, rng, True)
-                return self.loss_fn(out, batch)
-            self._eig_loss = _eig_loss
         new_spec = self._moq_scheduler.maybe_rescale(
-            self._eig_loss, self.state.params, self.next_rng(),
+            self._ensure_eig_loss(), self.state.params, self.next_rng(),
             loss_args=(sharded, self.next_rng()))
         if new_spec is not self.compression_spec:
             self.compression_spec = new_spec
