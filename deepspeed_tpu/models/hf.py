@@ -8,8 +8,8 @@ fused CUDA modules; here the model IS the TPU-native Transformer, so a
 pytree. TP slicing happens downstream via sharding rules (the reference
 slices 1/tp_size by hand, containers/base.py:243).
 
-Policies implemented: GPT-2, GPT-Neo, GPT-J, OPT, BLOOM, BERT — the training
-/inference arches the reference's replace_policy.py:18-32 list headlines.
+Policies implemented: GPT-2, GPT-Neo, GPT-J, OPT, BLOOM, BERT, RoBERTa,
+DistilBERT — 8 of the arches the reference's replace_policy.py:18-32 lists.
 torch Linear weights are [out, in] and transpose into flax kernels; GPT-2's
 Conv1D is already [in, out].
 """
@@ -365,6 +365,34 @@ def load_hf_bloom(model_or_state_dict, config=None, max_seq_len=None):
     return _to_f32(params), cfg
 
 
+
+def _bert_encoder_blocks(g, L: int, enc: str = "encoder.layer."):
+    """BERT-family encoder mapping shared by the BERT and RoBERTa loaders
+    (identical HF key names and layouts)."""
+    qkv_w, qkv_b = _concat_qkv_linear(
+        g, enc + "{i}.attention.self.{p}.weight",
+        names=("query", "key", "value"))
+    stack = _stacker(g, L)
+    return {
+        "attn_qkv": {"kernel": stack(qkv_w), "bias": stack(qkv_b)},
+        "attn_proj": {"kernel": stack(
+            lambda i: g(f"{enc}{i}.attention.output.dense.weight").T),
+            "bias": stack(lambda i: g(f"{enc}{i}.attention.output.dense.bias"))},
+        "ln1": {"scale": stack(
+            lambda i: g(f"{enc}{i}.attention.output.LayerNorm.weight")),
+            "bias": stack(
+                lambda i: g(f"{enc}{i}.attention.output.LayerNorm.bias"))},
+        "mlp_fc": {"kernel": stack(
+            lambda i: g(f"{enc}{i}.intermediate.dense.weight").T),
+            "bias": stack(lambda i: g(f"{enc}{i}.intermediate.dense.bias"))},
+        "mlp_proj": {"kernel": stack(
+            lambda i: g(f"{enc}{i}.output.dense.weight").T),
+            "bias": stack(lambda i: g(f"{enc}{i}.output.dense.bias"))},
+        "ln2": {"scale": stack(lambda i: g(f"{enc}{i}.output.LayerNorm.weight")),
+                "bias": stack(lambda i: g(f"{enc}{i}.output.LayerNorm.bias"))},
+    }
+
+
 def load_hf_bert(model_or_state_dict, config=None):
     """BERT (HF BertForMaskedLM): post-LN encoder with token-type embeddings
     and the MLM prediction head (transform + tied decoder + bias)."""
@@ -391,38 +419,7 @@ def load_hf_bert(model_or_state_dict, config=None):
         token_type_vocab=config.type_vocab_size,
         mlm_head=True,
     )
-    enc = "encoder.layer."
-
-    def qkv_w(i):
-        ws = [g(f"{enc}{i}.attention.self.{p}.weight").T
-              for p in ("query", "key", "value")]
-        return np.concatenate(ws, axis=1)
-
-    def qkv_b(i):
-        return np.concatenate(
-            [g(f"{enc}{i}.attention.self.{p}.bias")
-             for p in ("query", "key", "value")])
-
-    stack = _stacker(g, L)
-
-    blocks = {
-        "attn_qkv": {"kernel": stack(qkv_w), "bias": stack(qkv_b)},
-        "attn_proj": {"kernel": stack(
-            lambda i: g(f"{enc}{i}.attention.output.dense.weight").T),
-            "bias": stack(lambda i: g(f"{enc}{i}.attention.output.dense.bias"))},
-        "ln1": {"scale": stack(
-            lambda i: g(f"{enc}{i}.attention.output.LayerNorm.weight")),
-            "bias": stack(
-                lambda i: g(f"{enc}{i}.attention.output.LayerNorm.bias"))},
-        "mlp_fc": {"kernel": stack(
-            lambda i: g(f"{enc}{i}.intermediate.dense.weight").T),
-            "bias": stack(lambda i: g(f"{enc}{i}.intermediate.dense.bias"))},
-        "mlp_proj": {"kernel": stack(
-            lambda i: g(f"{enc}{i}.output.dense.weight").T),
-            "bias": stack(lambda i: g(f"{enc}{i}.output.dense.bias"))},
-        "ln2": {"scale": stack(lambda i: g(f"{enc}{i}.output.LayerNorm.weight")),
-                "bias": stack(lambda i: g(f"{enc}{i}.output.LayerNorm.bias"))},
-    }
+    blocks = _bert_encoder_blocks(g, L)
     params = {
         "wte": {"embedding": g("embeddings.word_embeddings.weight")},
         "wpe": {"embedding": g("embeddings.position_embeddings.weight")},
@@ -436,6 +433,108 @@ def load_hf_bert(model_or_state_dict, config=None):
         "mlm_ln": {"scale": _np(sd["cls.predictions.transform.LayerNorm.weight"]),
                    "bias": _np(sd["cls.predictions.transform.LayerNorm.bias"])},
         "mlm_bias": _np(sd["cls.predictions.bias"]),
+    }
+    return _to_f32(params), cfg
+
+
+def load_hf_roberta(model_or_state_dict, config=None):
+    """RoBERTa (HF RobertaForMaskedLM): BERT's post-LN encoder with position
+    ids offset by padding_idx+1 (baked by dropping the first rows) and the
+    lm_head transform instead of cls.predictions."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    prefix = _prefix(sd, "roberta.")
+    g = lambda n: _np(sd[prefix + n])
+    L = config.num_hidden_layers
+    offset = config.pad_token_id + 1          # RoBERTa position offset
+    act = {"gelu": "gelu_exact", "gelu_new": "gelu", "relu": "relu"}[
+        config.hidden_act]
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_position_embeddings - offset,
+        hidden_size=config.hidden_size,
+        num_layers=L,
+        num_heads=config.num_attention_heads,
+        mlp_ratio=config.intermediate_size // config.hidden_size,
+        causal=False,
+        tie_embeddings=True,
+        scan_layers=True,
+        layer_norm_eps=float(config.layer_norm_eps),
+        activation=act,
+        post_ln=True,
+        embed_ln=True,
+        token_type_vocab=config.type_vocab_size,
+        mlm_head=True,
+    )
+    blocks = _bert_encoder_blocks(g, L)
+    params = {
+        "wte": {"embedding": g("embeddings.word_embeddings.weight")},
+        "wpe": {"embedding": g("embeddings.position_embeddings.weight")[offset:]},
+        "tte": {"embedding": g("embeddings.token_type_embeddings.weight")},
+        "ln_emb": {"scale": g("embeddings.LayerNorm.weight"),
+                   "bias": g("embeddings.LayerNorm.bias")},
+        "blocks": blocks,
+        "mlm_transform": {"kernel": _np(sd["lm_head.dense.weight"]).T,
+                          "bias": _np(sd["lm_head.dense.bias"])},
+        "mlm_ln": {"scale": _np(sd["lm_head.layer_norm.weight"]),
+                   "bias": _np(sd["lm_head.layer_norm.bias"])},
+        "mlm_bias": _np(sd["lm_head.bias"]),
+    }
+    return _to_f32(params), cfg
+
+
+def load_hf_distilbert(model_or_state_dict, config=None):
+    """DistilBERT (HF DistilBertForMaskedLM): BERT-style post-LN encoder,
+    no token-type embeddings, vocab_transform/vocab_projector MLM head."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    prefix = _prefix(sd, "distilbert.")
+    g = lambda n: _np(sd[prefix + n])
+    L = config.n_layers
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_position_embeddings,
+        hidden_size=config.dim,
+        num_layers=L,
+        num_heads=config.n_heads,
+        mlp_ratio=config.hidden_dim // config.dim,
+        causal=False,
+        tie_embeddings=True,
+        scan_layers=True,
+        layer_norm_eps=1e-12,
+        activation="gelu_exact" if config.activation == "gelu" else "relu",
+        post_ln=True,
+        embed_ln=True,
+        mlm_head=True,
+    )
+    lyr = "transformer.layer."
+    qkv_w, qkv_b = _concat_qkv_linear(
+        g, lyr + "{i}.attention.{p}_lin.weight", names=("q", "k", "v"))
+    stack = _stacker(g, L)
+    blocks = {
+        "attn_qkv": {"kernel": stack(qkv_w), "bias": stack(qkv_b)},
+        "attn_proj": {"kernel": stack(
+            lambda i: g(f"{lyr}{i}.attention.out_lin.weight").T),
+            "bias": stack(lambda i: g(f"{lyr}{i}.attention.out_lin.bias"))},
+        "ln1": {"scale": stack(lambda i: g(f"{lyr}{i}.sa_layer_norm.weight")),
+                "bias": stack(lambda i: g(f"{lyr}{i}.sa_layer_norm.bias"))},
+        "mlp_fc": {"kernel": stack(lambda i: g(f"{lyr}{i}.ffn.lin1.weight").T),
+                   "bias": stack(lambda i: g(f"{lyr}{i}.ffn.lin1.bias"))},
+        "mlp_proj": {"kernel": stack(lambda i: g(f"{lyr}{i}.ffn.lin2.weight").T),
+                     "bias": stack(lambda i: g(f"{lyr}{i}.ffn.lin2.bias"))},
+        "ln2": {"scale": stack(
+            lambda i: g(f"{lyr}{i}.output_layer_norm.weight")),
+            "bias": stack(lambda i: g(f"{lyr}{i}.output_layer_norm.bias"))},
+    }
+    params = {
+        "wte": {"embedding": g("embeddings.word_embeddings.weight")},
+        "wpe": {"embedding": g("embeddings.position_embeddings.weight")},
+        "ln_emb": {"scale": g("embeddings.LayerNorm.weight"),
+                   "bias": g("embeddings.LayerNorm.bias")},
+        "blocks": blocks,
+        "mlm_transform": {"kernel": _np(sd["vocab_transform.weight"]).T,
+                          "bias": _np(sd["vocab_transform.bias"])},
+        "mlm_ln": {"scale": _np(sd["vocab_layer_norm.weight"]),
+                   "bias": _np(sd["vocab_layer_norm.bias"])},
+        "mlm_bias": _np(sd["vocab_projector.bias"]),
     }
     return _to_f32(params), cfg
 
@@ -459,6 +558,10 @@ HF_POLICIES = {
     "BloomForCausalLM": load_hf_bloom,
     "bert": load_hf_bert,
     "BertForMaskedLM": load_hf_bert,
+    "roberta": load_hf_roberta,
+    "RobertaForMaskedLM": load_hf_roberta,
+    "distilbert": load_hf_distilbert,
+    "DistilBertForMaskedLM": load_hf_distilbert,
 }
 
 

@@ -694,6 +694,8 @@ class DeepSpeedEngine:
                 micro_sharding),
             batch)
         self.tput_timer.start()
+        if self.config.wall_clock_breakdown:
+            self.timers("train_batch").start()
         if self.onebit is not None:
             if self.lr_fn is not None:
                 lr = float(jax.device_get(self.lr_fn(self.state.step)))
@@ -718,6 +720,19 @@ class DeepSpeedEngine:
             self.state, metrics = self._train_step(
                 self.state, micros, self.next_rng(), self._current_lr())
         self.tput_timer.stop(sync=metrics["loss"])
+        if self.config.wall_clock_breakdown:
+            # the jitted step is one program: the breakdown the reference
+            # logs per phase (fwd/bwd/step) collapses into step wall time +
+            # sustained throughput (reference: engine wall_clock_breakdown
+            # timer logs, engine.py:2240). timers.log logs internally; the
+            # normalizer turns the accumulated window into a PER-STEP time
+            self.timers("train_batch").stop(sync=metrics["loss"])
+            if (self.global_steps + 1) % self.config.steps_per_print == 0:
+                self.timers.log(["train_batch"],
+                                normalizer=float(self.config.steps_per_print))
+                log_dist(f"throughput: "
+                         f"{self.tput_timer.avg_samples_per_sec:.1f} "
+                         "samples/sec", ranks=[0])
         self._after_step(metrics)
         return metrics
 

@@ -182,3 +182,29 @@ def test_moe_decode_parity():
     np.testing.assert_allclose(np.asarray(logits[:, -1]),
                                np.asarray(logits_full[:, -1]),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_hf_roberta_parity():
+    """RoBERTa: BERT encoder + position offset + lm_head transform."""
+    hf_cfg = transformers.RobertaConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=34, type_vocab_size=1, pad_token_id=1)
+    hf = transformers.RobertaForMaskedLM(hf_cfg).eval()
+    ids = np.random.default_rng(10).integers(2, 96, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    ours = _ours_from(hf, ids)
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_hf_distilbert_parity():
+    hf_cfg = transformers.DistilBertConfig(
+        vocab_size=96, dim=32, n_layers=2, n_heads=4, hidden_dim=64,
+        max_position_embeddings=32)
+    hf = transformers.DistilBertForMaskedLM(hf_cfg).eval()
+    ids = np.random.default_rng(11).integers(0, 96, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    ours = _ours_from(hf, ids)
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
