@@ -497,9 +497,6 @@ def unconsumed_sections(cfg: "DeepSpeedConfig") -> List[str]:
         out.append("sparse_gradients")
     if cfg.nebula.enabled:
         out.append("nebula (use checkpoint.async_save)")
-    zo = cfg.zero_optimization
-    if zo.offload_param is not None and zo.offload_param.device != "none":
-        out.append("zero_optimization.offload_param")
     if cfg.compression_training.layer_reduction.get("enabled"):
         out.append("compression_training.layer_reduction (apply explicitly "
                    "via compression.apply_layer_reduction)")

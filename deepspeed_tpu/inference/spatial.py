@@ -1,0 +1,63 @@
+"""Spatial (image-model) inference support — attention over feature maps.
+
+Capability slot of the reference's spatial inference path
+(deepspeed/module_inject for diffusion UNets: replaces the spatial
+transformer's attention with fused kernels and optimized layouts,
+model_implementations/diffusers/*). TPU shape: the hot op — self-attention
+over flattened H*W token grids — runs through ops.attention (Pallas flash on
+TPU; H*W rarely divides the tile sizes, and the kernel's block snapping
+keeps e.g. 64x64=4096-token maps on the fast path). The InferenceEngine
+already hosts arbitrary flax modules, so "spatial inference" = these
+building blocks + batch sharding, not module surgery.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+
+
+def spatial_attention(x: jnp.ndarray,
+                      num_heads: int,
+                      *,
+                      impl: str = "auto") -> jnp.ndarray:
+    """Identity-projected self-attention over a feature map [B, H, W, C]
+    (the geometry transform; real blocks use SpatialSelfAttention below)."""
+    B, H, W, C = x.shape
+    hd = C // num_heads
+    t = x.reshape(B, H * W, num_heads, hd).transpose(0, 2, 1, 3)
+    out = attention(t, t, t, causal=False, impl=impl)
+    return out.transpose(0, 2, 1, 3).reshape(B, H, W, C)
+
+
+class SpatialSelfAttention(nn.Module):
+    """Diffusion-UNet-style attention block: GroupNorm -> qkv -> attention
+    over the H*W token grid -> proj, residual (the structure the reference's
+    diffusers injection replaces with its fused kernels)."""
+    num_heads: int
+    num_groups: int = 32
+    dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        B, H, W, C = x.shape
+        hd = C // self.num_heads
+        h = nn.GroupNorm(num_groups=min(self.num_groups, C),
+                         dtype=self.dtype, param_dtype=jnp.float32,
+                         name="norm")(x)
+        qkv = nn.Dense(3 * C, dtype=self.dtype, param_dtype=jnp.float32,
+                       name="qkv")(h.reshape(B, H * W, C))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        heads = lambda t: t.reshape(B, H * W, self.num_heads, hd
+                                    ).transpose(0, 2, 1, 3)
+        out = attention(heads(q), heads(k), heads(v), causal=False,
+                        impl=self.attention_impl)
+        out = out.transpose(0, 2, 1, 3).reshape(B, H * W, C)
+        out = nn.Dense(C, dtype=self.dtype, param_dtype=jnp.float32,
+                       name="proj")(out)
+        return x + out.reshape(B, H, W, C)

@@ -134,11 +134,14 @@ class HostOffloadOptimizer:
     # -- the step ----------------------------------------------------------------
 
     def apply(self, grads: PyTree, step_1based: int, lr: float,
-              grad_scale: float = 1.0) -> PyTree:
+              grad_scale: float = 1.0, materialize: bool = True) -> PyTree:
         """Host optimizer step. ``grads`` is the device grad pytree (summed
         over microbatches, NOT yet unscaled); ``grad_scale`` is the total
         divisor (n_micro * loss_scale / clip_coef) folded into the kernel.
-        Returns the new compute-dtype device param pytree."""
+        Returns the new compute-dtype device param pytree —
+        ``materialize=False`` (offload_param transient mode) skips the H2D
+        entirely and returns None; the caller re-materializes at the next
+        step via current_params_device."""
         grad_leaves = self.treedef.flatten_up_to(grads)
         # start all D2H copies before touching any (overlaps transfers with
         # the per-leaf CPU compute below — the role of the reference's
@@ -156,7 +159,8 @@ class HostOffloadOptimizer:
                 self.cpu_opt.step(step_1based, self.master[j], g, state,
                                   lr=lr, grad_scale=grad_scale,
                                   bf16_out=self._bf16_out(j))
-                new_leaves[j] = self._put_param(j)
+                if materialize:
+                    new_leaves[j] = self._put_param(j)
 
             self.swapper.pipeline(compute)
         else:
@@ -166,8 +170,11 @@ class HostOffloadOptimizer:
                                   self.state[j], lr=lr, grad_scale=grad_scale,
                                   bf16_out=self._bf16_out(j))
                 # async H2D: returns immediately, transfer overlaps next leaf
-                new_leaves[j] = self._put_param(j)
+                if materialize:
+                    new_leaves[j] = self._put_param(j)
 
+        if not materialize:
+            return None
         return self.treedef.unflatten(new_leaves)
 
     # -- checkpoint plumbing ------------------------------------------------------
@@ -206,3 +213,18 @@ class HostOffloadOptimizer:
     def current_params_device(self) -> PyTree:
         return self.treedef.unflatten(
             [self._put_param(j) for j in range(len(self.master))])
+
+    def host_params(self) -> PyTree:
+        """Compute-dtype params as HOST arrays (checkpoint/export paths in
+        transient mode — no device round trip; the bf16 mirror is already
+        maintained by the step kernel)."""
+        leaves = []
+        for j in range(len(self.master)):
+            if (self.compute_dtype == jax.numpy.bfloat16
+                    and self._bf16_staging[j] is not None):
+                leaves.append(self._bf16_staging[j])
+            else:
+                dt = np.dtype(self.compute_dtype)
+                leaves.append(self.master[j] if dt == np.float32
+                              else self.master[j].astype(dt))
+        return self.treedef.unflatten(leaves)
