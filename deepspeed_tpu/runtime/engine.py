@@ -895,6 +895,28 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------- accessors
 
+    def profile_trace(self, log_dir: str, batches, warmup: int = 1):
+        """Capture a jax profiler trace (xplane, TensorBoard-loadable) over
+        the given train batches — the TPU face of the reference's tracing
+        aux (SURVEY §5: torch profiler ranges -> jax.profiler.trace).
+
+        ``batches``: iterable of global batches; the first ``warmup`` steps
+        run OUTSIDE the trace so compile time doesn't drown the timeline.
+        Returns log_dir."""
+        batches = list(batches)
+        if len(batches) <= warmup:
+            raise ValueError(
+                f"profile_trace needs more than warmup={warmup} batches "
+                f"(got {len(batches)}) — the traced region would be empty")
+        for batch in batches[:warmup]:
+            self.train_batch(batch)
+        with jax.profiler.trace(log_dir):
+            for batch in batches[warmup:]:
+                m = self.train_batch(batch)
+            jax.block_until_ready(m["loss"])
+        log_dist(f"profiler trace written to {log_dir}", ranks=[0])
+        return log_dir
+
     def compute_eigenvalue(self, batch):
         """Max Hessian eigenvalue of the loss on ``batch`` (reference:
         engine eigenvalue hook at gas boundaries, feeding MoQ)."""

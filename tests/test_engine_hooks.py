@@ -178,3 +178,15 @@ def test_moq_eigenvalue_rescale():
     p2 = [g.quantization_period for g in spec2.groups]
     assert all(b >= a for a, b in zip(p1, p2))        # never shrinks
     engine.train_batch(random_batch(8))               # still trains
+
+
+def test_profile_trace(tmp_path):
+    """engine.profile_trace captures an xplane trace (SURVEY §5 tracing)."""
+    import glob
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg,
+                               example_batch=random_batch(8))
+    out = engine.profile_trace(str(tmp_path / "trace"),
+                               [random_batch(8, seed=i) for i in range(3)])
+    assert glob.glob(out + "/**/*.xplane.pb", recursive=True)
