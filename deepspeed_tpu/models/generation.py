@@ -151,8 +151,8 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
         to_heads = lambda t: t.reshape(B, T_new, nh, hd).transpose(0, 2, 1, 3)
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
         if cfg.pos_embed == "rotary":
-            q = apply_rotary(q, q_abs, cfg.rotary_dim)
-            k = apply_rotary(k, q_abs, cfg.rotary_dim)
+            q = apply_rotary(q, q_abs, cfg.rotary_dim, cfg.rotary_interleaved)
+            k = apply_rotary(k, q_abs, cfg.rotary_dim, cfg.rotary_interleaved)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache).astype(jnp.float32)
@@ -174,7 +174,10 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
             return _dense(act(_dense(hin, p["mlp_fc"])), p["mlp_proj"])
 
         if cfg.parallel_residual:
-            x_out = x + attn_out + mlp(h)
+            # GPT-NeoX feeds the MLP branch from its own ln2; GPT-J shares ln1
+            m_in = (_layer_norm(x, p["ln2"], cfg.layer_norm_eps)
+                    if cfg.parallel_residual_dual_ln else h)
+            x_out = x + attn_out + mlp(m_in)
         else:
             x_mid = x + attn_out
             h2 = _layer_norm(x_mid, p["ln2"], cfg.layer_norm_eps)

@@ -539,6 +539,207 @@ def load_hf_distilbert(model_or_state_dict, config=None):
     return _to_f32(params), cfg
 
 
+def _deinterleave_qkv(w, b, nh: int, hd: int):
+    """Per-head-interleaved fused qkv ([nh, 3, hd] out-rows, GPT-NeoX /
+    Megatron v2+) -> our [H, 3H] kernel with q/k/v column groups."""
+    H = nh * hd
+    wr = w.reshape(nh, 3, hd, H)
+    kernel = np.concatenate(
+        [wr[:, j].reshape(H, H).T for j in range(3)], axis=1)    # [H, 3H]
+    bias = None
+    if b is not None:
+        br = b.reshape(nh, 3, hd)
+        bias = np.concatenate([br[:, j].reshape(H) for j in range(3)])
+    return kernel, bias
+
+
+def load_hf_gpt_neox(model_or_state_dict, config=None):
+    """GPT-NeoX (HF GPTNeoXForCausalLM, e.g. Pythia): dual-LayerNorm parallel
+    residual (x + attn(ln1 x) + mlp(ln2 x)), rotate_half rotary over
+    rotary_pct of head_dim, per-head-interleaved fused qkv, untied unbiased
+    embed_out. reference arch coverage: module_inject GPT-NeoX policy."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    prefix = _prefix(sd, "gpt_neox.")
+    g = lambda n: _np(sd[prefix + n])
+    L = config.num_hidden_layers
+    nh = config.num_attention_heads
+    H = config.hidden_size
+    hd = H // nh
+    parallel = bool(getattr(config, "use_parallel_residual", True))
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_position_embeddings,
+        hidden_size=H,
+        num_layers=L,
+        num_heads=nh,
+        mlp_ratio=config.intermediate_size // H,
+        tie_embeddings=False,
+        scan_layers=True,
+        layer_norm_eps=float(config.layer_norm_eps),
+        pos_embed="rotary",
+        rotary_dim=int(hd * config.rotary_pct),
+        rotary_interleaved=False,
+        parallel_residual=parallel,
+        parallel_residual_dual_ln=parallel,
+    )
+
+    qkv_ws, qkv_bs = zip(*[_deinterleave_qkv(
+        g(f"layers.{i}.attention.query_key_value.weight"),
+        g(f"layers.{i}.attention.query_key_value.bias"), nh, hd)
+        for i in range(L)])
+
+    stack = _stacker(g, L)
+    blocks = {
+        "ln1": {"scale": stack(lambda i: g(f"layers.{i}.input_layernorm.weight")),
+                "bias": stack(lambda i: g(f"layers.{i}.input_layernorm.bias"))},
+        "ln2": {"scale": stack(
+            lambda i: g(f"layers.{i}.post_attention_layernorm.weight")),
+            "bias": stack(
+            lambda i: g(f"layers.{i}.post_attention_layernorm.bias"))},
+        "attn_qkv": {"kernel": np.stack(qkv_ws), "bias": np.stack(qkv_bs)},
+        "attn_proj": {"kernel": stack(
+            lambda i: g(f"layers.{i}.attention.dense.weight").T),
+            "bias": stack(lambda i: g(f"layers.{i}.attention.dense.bias"))},
+        "mlp_fc": {"kernel": stack(
+            lambda i: g(f"layers.{i}.mlp.dense_h_to_4h.weight").T),
+            "bias": stack(lambda i: g(f"layers.{i}.mlp.dense_h_to_4h.bias"))},
+        "mlp_proj": {"kernel": stack(
+            lambda i: g(f"layers.{i}.mlp.dense_4h_to_h.weight").T),
+            "bias": stack(lambda i: g(f"layers.{i}.mlp.dense_4h_to_h.bias"))},
+    }
+    params = {
+        "wte": {"embedding": g("embed_in.weight")},
+        "blocks": blocks,
+        "ln_f": {"scale": g("final_layer_norm.weight"),
+                 "bias": g("final_layer_norm.bias")},
+        "lm_head": {"kernel": _np(sd["embed_out.weight"]).T},
+    }
+    return _to_f32(params), cfg
+
+
+def load_hf_clip_text(model_or_state_dict, config=None):
+    """CLIP text encoder (HF CLIPTextModel): causal pre-LN stack with
+    quick_gelu and no LM head — the output is the final hidden states
+    (reference: module_inject CLIP policy / diffusers generic_injection)."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    if hasattr(config, "text_config"):      # full CLIPConfig passed
+        config = config.text_config
+    prefix = _prefix(sd, "text_model.")
+    g = lambda n: _np(sd[prefix + n])
+    L = config.num_hidden_layers
+    H = config.hidden_size
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_position_embeddings,
+        hidden_size=H,
+        num_layers=L,
+        num_heads=config.num_attention_heads,
+        mlp_ratio=config.intermediate_size // H,
+        tie_embeddings=False,
+        no_lm_head=True,
+        scan_layers=True,
+        layer_norm_eps=float(config.layer_norm_eps),
+        activation={"quick_gelu": "quick_gelu", "gelu": "gelu_exact"}.get(
+            config.hidden_act, config.hidden_act),
+        causal=True,
+    )
+    fmt = "encoder.layers.{i}.self_attn.{p}_proj.weight"
+    qkv_kernel, qkv_bias = _concat_qkv_linear(g, fmt)
+    stack = _stacker(g, L)
+    blocks = {
+        "ln1": {"scale": stack(
+            lambda i: g(f"encoder.layers.{i}.layer_norm1.weight")),
+            "bias": stack(lambda i: g(f"encoder.layers.{i}.layer_norm1.bias"))},
+        "attn_qkv": {"kernel": stack(qkv_kernel), "bias": stack(qkv_bias)},
+        "attn_proj": {"kernel": stack(
+            lambda i: g(f"encoder.layers.{i}.self_attn.out_proj.weight").T),
+            "bias": stack(
+            lambda i: g(f"encoder.layers.{i}.self_attn.out_proj.bias"))},
+        "ln2": {"scale": stack(
+            lambda i: g(f"encoder.layers.{i}.layer_norm2.weight")),
+            "bias": stack(lambda i: g(f"encoder.layers.{i}.layer_norm2.bias"))},
+        "mlp_fc": {"kernel": stack(
+            lambda i: g(f"encoder.layers.{i}.mlp.fc1.weight").T),
+            "bias": stack(lambda i: g(f"encoder.layers.{i}.mlp.fc1.bias"))},
+        "mlp_proj": {"kernel": stack(
+            lambda i: g(f"encoder.layers.{i}.mlp.fc2.weight").T),
+            "bias": stack(lambda i: g(f"encoder.layers.{i}.mlp.fc2.bias"))},
+    }
+    params = {
+        "wte": {"embedding": g("embeddings.token_embedding.weight")},
+        "wpe": {"embedding": g("embeddings.position_embedding.weight")},
+        "blocks": blocks,
+        "ln_f": {"scale": g("final_layer_norm.weight"),
+                 "bias": g("final_layer_norm.bias")},
+    }
+    return _to_f32(params), cfg
+
+
+def load_megatron_gpt(state_dict, config, version: int = 2):
+    """Megatron-LM GPT (NVIDIA checkpoint 'model' dict): pre-LN GPT-2-shaped
+    stack under language_model.{embedding,transformer|encoder} keys with a
+    fused query_key_value whose row layout depends on checkpoint version —
+    >=2: per-head [q|k|v] interleaved; 0: q/k/v chunked. Tied embeddings.
+    (reference: module_inject megatron policy + its container's
+    megatron-version split.) `config` needs num_layers/hidden_size/num_heads/
+    vocab_size/max_seq_len (dict or any attr object)."""
+    get = (config.get if isinstance(config, dict)
+           else lambda k, d=None: getattr(config, k, d))
+    L, H = get("num_layers"), get("hidden_size")
+    nh = get("num_heads")
+    hd = H // nh
+    sd = dict(state_dict)
+    lm = _prefix(sd, "language_model.")
+    enc = "transformer." if any(
+        k.startswith(f"{lm}transformer.") for k in sd) else "encoder."
+    g = lambda n: _np(sd[lm + n])
+    ge = lambda n: g(enc + n)
+    cfg = TransformerConfig(
+        vocab_size=get("vocab_size"),
+        max_seq_len=get("max_seq_len", 1024),
+        hidden_size=H, num_layers=L, num_heads=nh,
+        mlp_ratio=get("mlp_ratio", 4),
+        tie_embeddings=True, scan_layers=True,
+        layer_norm_eps=float(get("layer_norm_eps", 1e-5)),
+    )
+
+    def qkv(i):
+        w = ge(f"layers.{i}.attention.query_key_value.weight")
+        b = ge(f"layers.{i}.attention.query_key_value.bias")
+        if version >= 2:
+            return _deinterleave_qkv(w, b, nh, hd)
+        return w.T, b                              # chunked: already [q|k|v]
+
+    qkv_ws, qkv_bs = zip(*[qkv(i) for i in range(L)])
+    stack = _stacker(g, L)
+    blocks = {
+        "ln1": {"scale": stack(lambda i: ge(f"layers.{i}.input_layernorm.weight")),
+                "bias": stack(lambda i: ge(f"layers.{i}.input_layernorm.bias"))},
+        "attn_qkv": {"kernel": np.stack(qkv_ws), "bias": np.stack(qkv_bs)},
+        "attn_proj": {"kernel": stack(
+            lambda i: ge(f"layers.{i}.attention.dense.weight").T),
+            "bias": stack(lambda i: ge(f"layers.{i}.attention.dense.bias"))},
+        "ln2": {"scale": stack(
+            lambda i: ge(f"layers.{i}.post_attention_layernorm.weight")),
+            "bias": stack(
+            lambda i: ge(f"layers.{i}.post_attention_layernorm.bias"))},
+        "mlp_fc": {"kernel": stack(
+            lambda i: ge(f"layers.{i}.mlp.dense_h_to_4h.weight").T),
+            "bias": stack(lambda i: ge(f"layers.{i}.mlp.dense_h_to_4h.bias"))},
+        "mlp_proj": {"kernel": stack(
+            lambda i: ge(f"layers.{i}.mlp.dense_4h_to_h.weight").T),
+            "bias": stack(lambda i: ge(f"layers.{i}.mlp.dense_4h_to_h.bias"))},
+    }
+    params = {
+        "wte": {"embedding": g("embedding.word_embeddings.weight")},
+        "wpe": {"embedding": g("embedding.position_embeddings.weight")},
+        "blocks": blocks,
+        "ln_f": {"scale": ge("final_layernorm.weight"),
+                 "bias": ge("final_layernorm.bias")},
+    }
+    return _to_f32(params), cfg
+
+
 def _to_f32(params):
     import jax
     return jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
@@ -562,6 +763,11 @@ HF_POLICIES = {
     "RobertaForMaskedLM": load_hf_roberta,
     "distilbert": load_hf_distilbert,
     "DistilBertForMaskedLM": load_hf_distilbert,
+    "gptneox": load_hf_gpt_neox,
+    "GPTNeoXForCausalLM": load_hf_gpt_neox,
+    "clip": load_hf_clip_text,
+    "CLIPTextModel": load_hf_clip_text,
+    "CLIPTextModelWithProjection": load_hf_clip_text,
 }
 
 

@@ -72,12 +72,21 @@ class TransformerConfig:
     attn_scale: Optional[float] = None   # None = 1/sqrt(head_dim); GPT-Neo: 1.0
     pos_embed: str = "learned"     # learned | rotary (GPT-J) | alibi (BLOOM) | none
     rotary_dim: int = 0            # 0 = whole head_dim
+    # True = GPT-J interleaved pairs (rotate_every_two); False = GPT-NeoX
+    # half-split (rotate_half)
+    rotary_interleaved: bool = True
     parallel_residual: bool = False  # GPT-J: x + attn(ln(x)) + mlp(ln(x))
+    # GPT-NeoX: parallel residual with a SEPARATE ln2 feeding the MLP branch:
+    # x + attn(ln1(x)) + mlp(ln2(x))
+    parallel_residual_dual_ln: bool = False
     post_ln: bool = False          # BERT: LayerNorm AFTER each residual add
     embed_ln: bool = False         # BLOOM/BERT: LayerNorm on the embeddings
     token_type_vocab: int = 0      # BERT segment embeddings
     mlm_head: bool = False         # BERT: transform (dense+act+LN) + decoder bias
     lm_head_bias: bool = False     # GPT-J: untied lm_head carries a bias
+    # no LM head at all: __call__ returns final hidden states [B, S, H]
+    # (CLIP text encoder; reference: module_inject CLIP policy)
+    no_lm_head: bool = False
     qkv_bias: Optional[bool] = None       # None = use_bias (GPT-Neo/J: False)
     attn_out_bias: Optional[bool] = None  # None = use_bias (GPT-J: False)
     # per-layer local attention window, 0 = global (GPT-Neo alternates 0/256)
@@ -178,16 +187,19 @@ _ACTIVATIONS = {
     "gelu": nn.gelu,                                    # tanh approximation
     "gelu_exact": lambda x: nn.gelu(x, approximate=False),
     "relu": nn.relu,
+    "quick_gelu": lambda x: x * nn.sigmoid(1.702 * x),  # CLIP
 }
 
 
 def apply_rotary(x: jnp.ndarray, positions: jnp.ndarray,
-                 rotary_dim: int = 0) -> jnp.ndarray:
-    """GPT-J-style rotary embedding (rotate_every_two / interleaved pairs).
+                 rotary_dim: int = 0, interleaved: bool = True) -> jnp.ndarray:
+    """Rotary embedding; interleaved=True is the GPT-J rotate_every_two pair
+    layout, False is the GPT-NeoX rotate_half half-split layout.
 
     x: [B, nh, S, hd]; positions: [B, S] or [S]. Only the first rotary_dim
-    channels rotate (GPT-J: 64 of 256); the rest pass through.
-    reference arch source: HF GPTJAttention._apply_rotary_pos_emb.
+    channels rotate (GPT-J: 64 of 256; NeoX: rotary_pct * hd); the rest pass
+    through. reference arch sources: HF GPTJAttention._apply_rotary_pos_emb,
+    HF GPTNeoXAttention (rotate_half).
     """
     B, nh, S, hd = x.shape
     rd = rotary_dim or hd
@@ -198,11 +210,17 @@ def apply_rotary(x: jnp.ndarray, positions: jnp.ndarray,
     sin = jnp.sin(ang)[:, None, :, :]                   # [B, 1, S, rd/2]
     cos = jnp.cos(ang)[:, None, :, :]
     xr = x[..., :rd].astype(jnp.float32)
-    x1 = xr[..., 0::2]
-    x2 = xr[..., 1::2]
-    rot1 = x1 * cos - x2 * sin
-    rot2 = x2 * cos + x1 * sin
-    rot = jnp.stack([rot1, rot2], axis=-1).reshape(B, nh, S, rd)
+    if interleaved:
+        x1 = xr[..., 0::2]
+        x2 = xr[..., 1::2]
+        rot1 = x1 * cos - x2 * sin
+        rot2 = x2 * cos + x1 * sin
+        rot = jnp.stack([rot1, rot2], axis=-1).reshape(B, nh, S, rd)
+    else:
+        x1 = xr[..., :rd // 2]
+        x2 = xr[..., rd // 2:]
+        rot = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                              axis=-1)
     return jnp.concatenate([rot.astype(x.dtype), x[..., rd:]], axis=-1)
 
 
@@ -268,8 +286,8 @@ class Block(nn.Module):
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
         if cfg.pos_embed == "rotary":
             pos = positions if positions is not None else jnp.arange(S)
-            q = apply_rotary(q, pos, cfg.rotary_dim)
-            k = apply_rotary(k, pos, cfg.rotary_dim)
+            q = apply_rotary(q, pos, cfg.rotary_dim, cfg.rotary_interleaved)
+            k = apply_rotary(k, pos, cfg.rotary_dim, cfg.rotary_interleaved)
         bias = None
         if cfg.pos_embed == "alibi":
             pos = positions if positions is not None else jnp.arange(S)
@@ -322,8 +340,9 @@ class Block(nn.Module):
             return h, aux
 
         if cfg.parallel_residual:
-            # GPT-J: one shared LN feeds both branches; single residual add
-            m, aux = mlp(h)
+            # GPT-J: one shared LN feeds both branches; GPT-NeoX: a separate
+            # ln2 feeds the MLP branch. Single residual add either way.
+            m, aux = mlp(ln("ln2")(x) if cfg.parallel_residual_dual_ln else h)
             if cfg.dropout > 0.0 and train:
                 m = nn.Dropout(cfg.dropout)(m, deterministic=False)
             return _batch_constraint(x + out + m), aux
@@ -510,6 +529,9 @@ class Transformer(nn.Module):
             bias = self.param("mlm_bias", nn.initializers.zeros,
                               (cfg.vocab_size,), jnp.float32)
             return (logits + bias).astype(jnp.float32)
+        if cfg.no_lm_head:
+            # encoder use (CLIP text): final hidden states are the output
+            return x.astype(jnp.float32)
         if cfg.fused_loss:
             if not cfg.tie_embeddings:
                 raise ValueError("fused_loss requires tie_embeddings")
