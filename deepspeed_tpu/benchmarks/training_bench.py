@@ -1,0 +1,112 @@
+"""Training-throughput benchmark: model-flops TFLOPs/chip for any preset.
+
+Covers the reference's headline training benchmarks (BASELINE.md):
+  - BERT-large seq128: 64 TFLOPS/GPU (docs/_posts/2020-05-28-fastest-bert
+    -training.md:36) and seq512: 53 TFLOPS/GPU
+  - GPT-2 sustained training throughput: 50 TFLOPS/GPU
+    (docs/_posts/2021-03-08-zero3-offload.md:65)
+
+The repo-root ``bench.py`` (the driver's entry) is the GPT-2 instance of this
+loop; this module generalizes it so ``ds_bench --training bert-large`` can
+reproduce every headline row on TPU.
+"""
+
+import json
+import time
+
+import numpy as np
+
+# reference headline numbers to report "vs" (V100, see BASELINE.md)
+REFERENCE_TFLOPS = {
+    ("bert-large", 128): 64.0,
+    ("bert-large", 512): 53.0,
+    ("gpt2-350m", 1024): 50.0,
+    ("gpt2-1.3b", 1024): 50.0,
+}
+
+
+def run_training_bench(preset: str = "bert-large", seq: int = 128,
+                       micro: int = 64, gas: int = 1, steps: int = 4,
+                       zero_stage: int = 1, remat: bool = False,
+                       remat_policy: str = "dots", verbose: bool = True):
+    """Measure sustained train-step model TFLOPs/chip for a preset.
+
+    Returns the result dict (also printed as one JSON line when verbose).
+    """
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, fused_loss_passthrough
+    from deepspeed_tpu.models.transformer import causal_lm_loss
+
+    n_chips = len(jax.devices())
+    kw = dict(max_seq_len=max(seq, 512), remat=remat,
+              remat_policy=remat_policy)
+    causal = not preset.startswith("bert")
+    if causal:
+        kw.update(fused_loss=True, loss_chunk=256)
+    model, cfg = build_model(preset, **kw)
+    batch_size = micro * gas * max(n_chips, 1)
+    config = {
+        "train_batch_size": batch_size,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": zero_stage},
+        "steps_per_print": 10_000,
+    }
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        return {"input_ids": rng.integers(0, cfg.vocab_size,
+                                          size=(batch_size, seq))}
+
+    # BERT presets emit [B,S,V] logits; token-level CE is the benchmark loss
+    # (same matmul/backward cost profile as the reference's MLM objective)
+    loss_fn = fused_loss_passthrough if causal else causal_lm_loss
+    engine, *_ = ds.initialize(model=model, config=config, loss_fn=loss_fn,
+                               example_batch=make_batch())
+    float(engine.train_batch(make_batch())["loss"])   # compile
+    float(engine.train_batch(make_batch())["loss"])   # steady state
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = engine.train_batch(make_batch())
+    float(m["loss"])
+    float(jax.tree.leaves(engine.state.params)[0].ravel()[0])
+    dt = (time.perf_counter() - t0) / steps
+
+    tflops = 6.0 * cfg.num_params() * batch_size * seq / dt / max(n_chips, 1) / 1e12
+    ref = REFERENCE_TFLOPS.get((preset, seq))
+    out = {
+        "metric": f"{preset}_seq{seq}_train_tflops_per_chip",
+        "value": round(tflops, 3),
+        "unit": "TFLOPs/chip",
+        "vs_baseline": round(tflops / ref, 4) if ref else None,
+        "detail": {"preset": preset, "seq": seq, "micro": micro, "gas": gas,
+                   "batch": batch_size, "chips": n_chips,
+                   "step_time_s": round(dt, 4),
+                   "samples_per_s": round(batch_size / dt, 2),
+                   "backend": jax.default_backend()},
+    }
+    if verbose:
+        print(json.dumps(out))
+    return out
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", default="bert-large")
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--micro", type=int, default=64)
+    p.add_argument("--gas", type=int, default=1)
+    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--zero", type=int, default=1)
+    p.add_argument("--remat", action="store_true")
+    a = p.parse_args(argv)
+    run_training_bench(a.preset, a.seq, a.micro, a.gas, a.steps, a.zero,
+                       a.remat)
+
+
+if __name__ == "__main__":
+    main()

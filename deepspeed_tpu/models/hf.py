@@ -566,6 +566,11 @@ def load_hf_gpt_neox(model_or_state_dict, config=None):
     H = config.hidden_size
     hd = H // nh
     parallel = bool(getattr(config, "use_parallel_residual", True))
+    base = float(getattr(config, "rotary_emb_base", 10000.0))
+    if base != 10000.0:
+        raise NotImplementedError(
+            f"GPT-NeoX rotary_emb_base={base}: apply_rotary currently "
+            "hard-codes base 10000; refusing to load with wrong angles")
     cfg = TransformerConfig(
         vocab_size=config.vocab_size,
         max_seq_len=config.max_position_embeddings,
@@ -581,6 +586,12 @@ def load_hf_gpt_neox(model_or_state_dict, config=None):
         rotary_interleaved=False,
         parallel_residual=parallel,
         parallel_residual_dual_ln=parallel,
+        # HF ACT2FN["gelu"] is exact-erf (the NeoX default); our "gelu" is
+        # the tanh approximation — map like the BERT/RoBERTa loaders do
+        activation={"gelu": "gelu_exact", "gelu_new": "gelu",
+                    "gelu_pytorch_tanh": "gelu"}.get(
+            getattr(config, "hidden_act", "gelu"),
+            getattr(config, "hidden_act", "gelu")),
     )
 
     qkv_ws, qkv_bs = zip(*[_deinterleave_qkv(
@@ -767,7 +778,9 @@ HF_POLICIES = {
     "GPTNeoXForCausalLM": load_hf_gpt_neox,
     "clip": load_hf_clip_text,
     "CLIPTextModel": load_hf_clip_text,
-    "CLIPTextModelWithProjection": load_hf_clip_text,
+    # CLIPTextModelWithProjection is deliberately NOT aliased: its output is
+    # text_embeds through text_projection, which this encoder-only policy
+    # does not model — aliasing it would silently return the wrong tensor
 }
 
 

@@ -1,81 +1,45 @@
 """Perf sweep: gpt2-350m train-step throughput across config points.
 
 Run on the real chip. Each point prints one JSON line; the last line is the
-ranked summary. Used to pick bench.py's tuned config (the autotuner's
+ranked summary. Thin wrapper over benchmarks.training_bench (the autotuner's
 grid search is the production version of this loop).
+
+Round-2 findings (v5e, 15.75GB HBM): micro>=32 or remat=False OOM at compile
+for gpt2-350m/seq1024; micro16 x gas16 with "dots" remat is the feasible
+optimum (~70 TFLOPs/chip) and is what bench.py ships.
 """
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
-
-
-def measure(preset, micro, gas, seq, remat, remat_policy, block_q, block_k,
-            steps=3):
-    import jax
-    import deepspeed_tpu as ds
-    from deepspeed_tpu.models import build_model, fused_loss_passthrough
-
-    model, cfg = build_model(preset, max_seq_len=seq, remat=remat,
-                             remat_policy=remat_policy, fused_loss=True,
-                             loss_chunk=256)
-    batch_size = micro * gas
-    config = {
-        "train_batch_size": batch_size,
-        "train_micro_batch_size_per_gpu": micro,
-        "gradient_accumulation_steps": gas,
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 1},
-        "steps_per_print": 1000,
-    }
-    rng = np.random.default_rng(0)
-
-    def make_batch():
-        return {"input_ids": rng.integers(0, cfg.vocab_size,
-                                          size=(batch_size, seq))}
-
-    engine, *_ = ds.initialize(model=model, config=config,
-                               loss_fn=fused_loss_passthrough,
-                               example_batch=make_batch())
-    float(engine.train_batch(make_batch())["loss"])
-    float(engine.train_batch(make_batch())["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        m = engine.train_batch(make_batch())
-    float(m["loss"])
-    float(jax.tree.leaves(engine.state.params)[0].ravel()[0])
-    dt = (time.perf_counter() - t0) / steps
-    tflops = 6.0 * cfg.num_params() * batch_size * seq / dt / 1e12
-    return tflops, dt
-
 
 def main():
+    from deepspeed_tpu.benchmarks.training_bench import run_training_bench
+
     points = [
-        # (micro, gas, remat, policy, bq, bk)   batch fixed at 256
-        (16, 16, True, "dots", None, None),     # current bench config
-        (32, 8, True, "dots", None, None),
-        (32, 8, False, "dots", None, None),
-        (16, 16, False, "dots", None, None),
-        (64, 4, True, "dots", None, None),
-        (32, 8, True, "full", None, None),
+        # (micro, gas, remat, policy)    batch fixed at 256
+        (16, 16, True, "dots"),     # current bench config
+        (32, 8, True, "dots"),
+        (32, 8, False, "dots"),
+        (16, 16, False, "dots"),
+        (64, 4, True, "dots"),
+        (32, 8, True, "full"),
     ]
     if len(sys.argv) > 1:      # run a single point by index
         points = [points[int(sys.argv[1])]]
     results = []
-    for (micro, gas, remat, pol, bq, bk) in points:
+    for (micro, gas, remat, pol) in points:
         try:
-            tf, dt = measure("gpt2-350m", micro, gas, 1024, remat, pol, bq, bk)
+            r = run_training_bench("gpt2-350m", seq=1024, micro=micro,
+                                   gas=gas, steps=3, remat=remat,
+                                   remat_policy=pol, verbose=False)
             rec = {"micro": micro, "gas": gas, "remat": remat, "policy": pol,
-                   "bq": bq, "bk": bk, "tflops": round(tf, 2),
-                   "step_s": round(dt, 4)}
+                   "tflops": r["value"], "step_s": r["detail"]["step_time_s"]}
         except Exception as e:  # OOM etc. — record and continue
             rec = {"micro": micro, "gas": gas, "remat": remat, "policy": pol,
-                   "bq": bq, "bk": bk, "error": str(e)[:200]}
+                   "error": str(e)[:200]}
         print(json.dumps(rec), flush=True)
         results.append(rec)
     ranked = sorted([r for r in results if "tflops" in r],
