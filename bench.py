@@ -6,86 +6,39 @@ Baseline: the reference's headline sustained training throughput of
 50 TFLOPS/GPU (ZeRO-3 Offload on V100, docs/_posts/2021-03-08-zero3-offload.md:65;
 see BASELINE.md). vs_baseline = our model TFLOPs/chip / 50.
 
-Tuned config (measured on v5e, round 2): micro-batch 16 x gas 16 in one
-compiled step, selective "dots" remat (save attention outputs, recompute the
-rest), fused chunked CE loss in 256-token chunks (no [B,S,V] fp32 logits
-materialization), Pallas flash attention with 1024x1024 blocks both passes
-(at seq<=1024 the whole sequence sits in one tile; measured +30% THROUGHPUT
-vs the round-1 256/512 blocks).
+Tuned config (measured on v5e, round 2 — sweep in scripts/perf_sweep.py):
+micro-batch 16 x gas 16 in one compiled step, selective "dots" remat (save
+matmul + flash-attention outputs, recompute elementwise), fused chunked CE
+loss in 256-token chunks (no [B,S,V] fp32 logits materialization), Pallas
+flash attention. micro>=32 or remat off exceed the chip's 15.75GB HBM at
+compile. The measurement loop itself lives in
+deepspeed_tpu/benchmarks/training_bench.py (shared with ds_bench --training).
 """
 
 import json
-import time
-
-import numpy as np
 
 BASELINE_TFLOPS_PER_CHIP = 50.0
 
 
 def main():
     import jax
-    import deepspeed_tpu as ds
-    from deepspeed_tpu.models import build_model, fused_loss_passthrough
+    from deepspeed_tpu.benchmarks.training_bench import run_training_bench
 
     on_tpu = jax.default_backend() == "tpu"
-    n_chips = len(jax.devices())
-
     if on_tpu:
         preset, micro, gas, seq, steps = "gpt2-350m", 16, 16, 1024, 4
     else:  # smoke path for CPU-only environments
         preset, micro, gas, seq, steps = "gpt2-tiny", 8, 1, 128, 3
 
-    model, cfg = build_model(preset, max_seq_len=seq, remat=on_tpu,
-                             remat_policy="dots", fused_loss=True,
-                             loss_chunk=256)
-    batch_size = micro * gas * max(n_chips, 1)
-    config = {
-        "train_batch_size": batch_size,
-        "train_micro_batch_size_per_gpu": micro,
-        "gradient_accumulation_steps": gas,
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 1},
-        "steps_per_print": 10,
-    }
-    rng = np.random.default_rng(0)
-
-    def make_batch():
-        return {"input_ids": rng.integers(
-            0, cfg.vocab_size, size=(batch_size, seq))}
-
-    engine, *_ = ds.initialize(model=model, config=config,
-                               loss_fn=fused_loss_passthrough,
-                               example_batch=make_batch())
-    # two warmup steps (compile + steady state); float() forces real completion
-    # (block_until_ready alone does not synchronize through remote relays)
-    float(engine.train_batch(make_batch())["loss"])
-    float(engine.train_batch(make_batch())["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        m = engine.train_batch(make_batch())
-    loss = float(m["loss"])
-    # the loss only depends on params through step N-1; read back a param
-    # element so the final optimizer update is included in the timed region
-    float(jax.tree.leaves(engine.state.params)[0].ravel()[0])
-    dt = (time.perf_counter() - t0) / steps
-
-    # 6 * N * T model flops per token-step (fwd 2NT + bwd 4NT)
-    n_params = cfg.num_params()
-    tokens = batch_size * seq
-    flops = 6.0 * n_params * tokens
-    tflops_per_chip = flops / dt / max(n_chips, 1) / 1e12
-
+    r = run_training_bench(preset, seq=seq, micro=micro, gas=gas, steps=steps,
+                           zero_stage=1, remat=on_tpu, remat_policy="dots",
+                           fused_loss=True, verbose=False)
     print(json.dumps({
         "metric": "gpt2_train_tflops_per_chip",
-        "value": round(tflops_per_chip, 3),
+        "value": r["value"],
         "unit": "TFLOPs/chip",
-        "vs_baseline": round(tflops_per_chip / BASELINE_TFLOPS_PER_CHIP, 4),
-        "detail": {"preset": preset, "micro": micro, "gas": gas,
-                   "batch": batch_size, "seq": seq,
-                   "chips": n_chips, "step_time_s": round(dt, 4),
-                   "loss": round(loss, 4), "backend": jax.default_backend()},
+        "vs_baseline": round(r["value"] / BASELINE_TFLOPS_PER_CHIP, 4),
+        "detail": {**r["detail"], "preset": preset},
     }))
 
 

@@ -28,7 +28,8 @@ REFERENCE_TFLOPS = {
 def run_training_bench(preset: str = "bert-large", seq: int = 128,
                        micro: int = 64, gas: int = 1, steps: int = 4,
                        zero_stage: int = 1, remat: bool = False,
-                       remat_policy: str = "dots", verbose: bool = True):
+                       remat_policy: str = "dots", fused_loss=None,
+                       verbose: bool = True):
     """Measure sustained train-step model TFLOPs/chip for a preset.
 
     Returns the result dict (also printed as one JSON line when verbose).
@@ -36,14 +37,18 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
     import jax
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import build_model, fused_loss_passthrough
-    from deepspeed_tpu.models.transformer import causal_lm_loss
+    from deepspeed_tpu.models.transformer import causal_lm_loss, cross_entropy
 
     n_chips = len(jax.devices())
-    kw = dict(max_seq_len=max(seq, 512), remat=remat,
-              remat_policy=remat_policy)
     causal = not preset.startswith("bert")
-    if causal:
-        kw.update(fused_loss=True, loss_chunk=256)
+    if fused_loss is None:
+        # measured on v5e: the chunked fused CE wins for causal seq>=1024
+        # (avoids [B,S,50257] fp32 logits) but LOSES ~20% for BERT seq128
+        # (logits fit; the checkpoint-recompute costs more than it saves)
+        fused_loss = causal
+    kw = dict(max_seq_len=max(seq, 512), remat=remat,
+              remat_policy=remat_policy, fused_loss=fused_loss,
+              loss_chunk=256)
     model, cfg = build_model(preset, **kw)
     batch_size = micro * gas * max(n_chips, 1)
     config = {
@@ -61,9 +66,13 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
         return {"input_ids": rng.integers(0, cfg.vocab_size,
                                           size=(batch_size, seq))}
 
-    # BERT presets emit [B,S,V] logits; token-level CE is the benchmark loss
-    # (same matmul/backward cost profile as the reference's MLM objective)
-    loss_fn = fused_loss_passthrough if causal else causal_lm_loss
+    # fused_loss models return the scalar loss (BERT variant predicts in
+    # place — same cost profile as the reference's MLM objective); plain
+    # models emit [B,S,V] logits scored with token-level CE
+    loss_fn = (fused_loss_passthrough if fused_loss
+               else (causal_lm_loss if causal else
+                     lambda out, b: cross_entropy(
+                         out, b.get("labels", b["input_ids"]))))
     engine, *_ = ds.initialize(model=model, config=config, loss_fn=loss_fn,
                                example_batch=make_batch())
     float(engine.train_batch(make_batch())["loss"])   # compile
@@ -103,9 +112,19 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=4)
     p.add_argument("--zero", type=int, default=1)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat-policy", default="dots",
+                   help="full | dots | offload (see TransformerConfig)")
+    fl = p.add_mutually_exclusive_group()
+    fl.add_argument("--fused-loss", dest="fused_loss", default=None,
+                    action="store_true",
+                    help="force the chunked fused CE (default: causal only)")
+    fl.add_argument("--no-fused-loss", dest="fused_loss",
+                    action="store_false",
+                    help="force the plain [B,S,V]-logits loss")
     a = p.parse_args(argv)
     run_training_bench(a.preset, a.seq, a.micro, a.gas, a.steps, a.zero,
-                       a.remat)
+                       a.remat, remat_policy=a.remat_policy,
+                       fused_loss=a.fused_loss)
 
 
 if __name__ == "__main__":

@@ -587,11 +587,12 @@ def load_hf_gpt_neox(model_or_state_dict, config=None):
         parallel_residual=parallel,
         parallel_residual_dual_ln=parallel,
         # HF ACT2FN["gelu"] is exact-erf (the NeoX default); our "gelu" is
-        # the tanh approximation — map like the BERT/RoBERTa loaders do
+        # the tanh approximation — map strictly like the BERT/RoBERTa
+        # loaders so unknown activations fail at load time, not in apply
         activation={"gelu": "gelu_exact", "gelu_new": "gelu",
-                    "gelu_pytorch_tanh": "gelu"}.get(
-            getattr(config, "hidden_act", "gelu"),
-            getattr(config, "hidden_act", "gelu")),
+                    "gelu_pytorch_tanh": "gelu", "relu": "relu",
+                    "quick_gelu": "quick_gelu"}[
+            getattr(config, "hidden_act", "gelu")],
     )
 
     qkv_ws, qkv_bs = zip(*[_deinterleave_qkv(

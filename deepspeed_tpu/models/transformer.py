@@ -537,8 +537,10 @@ class Transformer(nn.Module):
                 raise ValueError("fused_loss requires tie_embeddings")
             labels = batch.get("labels", input_ids) if isinstance(batch, dict) \
                 else input_ids
+            # encoder stacks (BERT bench path) predict in place: no shift
             loss = _fused_causal_lm_loss(x, wte.embedding, labels,
-                                         cfg.loss_chunk)
+                                         cfg.loss_chunk,
+                                         shift=1 if cfg.causal else 0)
             if cfg.moe_experts > 0:
                 return loss, aux_total
             return loss
@@ -554,7 +556,7 @@ class Transformer(nn.Module):
         return logits
 
 
-def _fused_causal_lm_loss(x, emb, labels, chunk: int):
+def _fused_causal_lm_loss(x, emb, labels, chunk: int, shift: int = 1):
     """Next-token CE without materializing [B, S, V] logits.
 
     x: [B, S, H] final hidden states (compute dtype); emb: [V, H] fp32 tied
@@ -565,9 +567,12 @@ def _fused_causal_lm_loss(x, emb, labels, chunk: int):
     (csrc/transformer/general_kernels.cu cross-entropy path) the XLA way.
     """
     B, S, H = x.shape
-    xs = x[:, :-1]
-    tgt = labels[:, 1:]
-    n = S - 1
+    if shift:
+        xs = x[:, :-1]          # causal LM: predict the NEXT token
+        tgt = labels[:, 1:]
+    else:
+        xs, tgt = x, labels     # encoder/MLM-style: predict in place
+    n = S - shift
     chunk = min(chunk, n)
     pad = (-n) % chunk
     if pad:

@@ -157,3 +157,29 @@ def test_remat_policies_agree():
         errs = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
                             base_grads, grads)
         assert max(jax.tree.leaves(errs)) < 1e-5
+
+
+def test_fused_loss_encoder_no_shift():
+    """causal=False (BERT-style) fused loss predicts in place: matches plain
+    per-token cross_entropy on the logits with no shift."""
+    from deepspeed_tpu.models.transformer import cross_entropy
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 256, size=(2, 48))
+    kw = dict(vocab_size=256, max_seq_len=64, causal=False,
+              dtype=jnp.float32, attention_impl="reference")
+    m1, _ = build_model("gpt2-tiny", **kw)
+    m2, _ = build_model("gpt2-tiny", fused_loss=True, loss_chunk=20, **kw)
+    batch = {"input_ids": jnp.asarray(ids)}
+    params = m1.init(jax.random.PRNGKey(0), batch)["params"]
+
+    logits = m1.apply({"params": params}, batch)
+    l1 = cross_entropy(logits, jnp.asarray(ids))
+    l2 = m2.apply({"params": params}, batch)
+    assert abs(float(l1 - l2)) < 1e-5
+
+    labels = ids.copy()
+    labels[:, :8] = -100            # masked-LM-style ignore positions
+    b2 = {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(labels)}
+    l1m = cross_entropy(m1.apply({"params": params}, b2), jnp.asarray(labels))
+    l2m = m2.apply({"params": params}, b2)
+    assert abs(float(l1m - l2m)) < 1e-5
