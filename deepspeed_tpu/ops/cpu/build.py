@@ -87,6 +87,24 @@ def load_cpu_kernels() -> Optional[ctypes.CDLL]:
     return lib
 
 
+def load_data_loader() -> Optional[ctypes.CDLL]:
+    """mmap batch assembly + prefetch thread (csrc/data_loader.cpp)."""
+    lib = _load("ds_data_loader", ["data_loader.cpp"])
+    if lib is not None and not getattr(lib, "_ds_typed", False):
+        c = ctypes
+        lib.ds_dl_open.restype = c.c_void_p
+        lib.ds_dl_open.argtypes = [c.c_char_p]
+        lib.ds_dl_close.argtypes = [c.c_void_p]
+        lib.ds_dl_gather.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64, c.c_int64,
+            c.c_void_p]
+        lib.ds_dl_prefetch.restype = c.c_int
+        lib.ds_dl_prefetch.argtypes = lib.ds_dl_gather.argtypes
+        lib.ds_dl_prefetch_wait.argtypes = [c.c_void_p]
+        lib._ds_typed = True
+    return lib
+
+
 def load_aio() -> Optional[ctypes.CDLL]:
     """thread-pool positional IO (csrc/aio.cpp)."""
     lib = _load("ds_aio", ["aio.cpp"])

@@ -129,6 +129,24 @@ def _register_builtins():
     REGISTRY.register("aio", OpImpl("python", _aio_python, lambda: True,
                                     priority=0))
 
+    def _native_loader():
+        from ..runtime.data_pipeline.native_loader import NativeBatchAssembler
+        return NativeBatchAssembler
+
+    def _py_loader():
+        import functools
+
+        from ..runtime.data_pipeline.native_loader import NativeBatchAssembler
+        return functools.partial(NativeBatchAssembler, use_native=False)
+
+    REGISTRY.register("data_loader", OpImpl(
+        "cpp_mmap", _native_loader,
+        lambda: __import__("deepspeed_tpu.ops.cpu.build",
+                           fromlist=["load_data_loader"]
+                           ).load_data_loader() is not None, priority=10))
+    REGISTRY.register("data_loader", OpImpl("python", _py_loader,
+                                            lambda: True, priority=0))
+
 
 _register_builtins()
 

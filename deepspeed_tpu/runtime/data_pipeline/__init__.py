@@ -8,7 +8,9 @@ from .curriculum_scheduler import CurriculumScheduler
 from .data_sampler import (CurriculumBatchTransform, DeepSpeedDataSampler,
                            apply_seqlen_curriculum)
 from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder)
+from .native_loader import NativeBatchAssembler
 
 __all__ = ["CurriculumScheduler", "CurriculumBatchTransform",
            "DeepSpeedDataSampler", "apply_seqlen_curriculum",
-           "MMapIndexedDataset", "MMapIndexedDatasetBuilder"]
+           "MMapIndexedDataset", "MMapIndexedDatasetBuilder",
+           "NativeBatchAssembler"]

@@ -65,6 +65,7 @@ class MMapIndexedDataset:
     """reference: MMapIndexedDataset (indexed_dataset.py:381)."""
 
     def __init__(self, prefix: str):
+        self._prefix = prefix
         with open(index_file_path(prefix), "rb") as f:
             magic = f.read(len(_MAGIC))
             if magic != _MAGIC:

@@ -1,0 +1,142 @@
+"""Native batch assembly over the mmap indexed dataset.
+
+Role of the reference's prefetching DataLoader workers for pretraining-scale
+token streams: the per-batch hot loop (gather N variable-length documents
+into one contiguous [N, seq_len] array with truncate/pad) runs in C++
+(ops/csrc/data_loader.cpp — mmap + OpenMP row memcpy) with one background
+prefetch thread double-buffering the next batch while the device steps.
+Falls back to a numpy loop when no toolchain is available, so behavior is
+identical everywhere.
+
+Usage::
+
+    ds = MMapIndexedDataset("corpus")
+    nb = NativeBatchAssembler(ds, seq_len=1024, pad_token=0)
+    for idx_batch in sampler:                  # list[int] document ids
+        batch = nb.gather(idx_batch)           # np [n, seq_len]
+    # or double-buffered:
+    nb.prefetch(ids0)
+    for next_ids in ...:
+        arr = nb.wait()                        # batch k
+        nb.prefetch(next_ids)                  # overlaps with the step
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .indexed_dataset import MMapIndexedDataset, data_file_path
+
+
+class NativeBatchAssembler:
+    def __init__(self, dataset: MMapIndexedDataset, seq_len: int,
+                 pad_token: int = 0, use_native: bool = True):
+        self._ds = dataset
+        self.seq_len = int(seq_len)
+        self.pad_token = pad_token
+        self._dtype = dataset._dtype
+        self._row_bytes = self.seq_len * self._dtype.itemsize
+        self._lib = None
+        self._handle = None
+        self._pending: Optional[np.ndarray] = None
+        if use_native:
+            from ...ops.cpu.build import load_data_loader
+            self._lib = load_data_loader()
+        if self._lib is not None:
+            self._handle = self._lib.ds_dl_open(
+                data_file_path(dataset._prefix).encode())
+            if not self._handle:
+                self._lib = None
+
+    @property
+    def has_native(self) -> bool:
+        return self._handle is not None
+
+    def close(self):
+        if self._handle:
+            self._lib.ds_dl_prefetch_wait(self._handle)
+            self._lib.ds_dl_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- internals -----------------------------------------------------------
+
+    def _index_arrays(self, ids: Sequence[int]):
+        ids = np.asarray(ids, np.int64)
+        ptrs = self._ds._pointers[ids]
+        nbytes = self._ds._sizes[ids] * self._dtype.itemsize
+        return np.ascontiguousarray(ptrs), np.ascontiguousarray(nbytes)
+
+    def _alloc(self, n: int) -> np.ndarray:
+        out = np.full((n, self.seq_len), self.pad_token, dtype=self._dtype)
+        return out
+
+    def _gather_py(self, ids, out):
+        for r, i in enumerate(ids):
+            item = self._ds[int(i)][:self.seq_len]
+            out[r, :len(item)] = item
+        return out
+
+    # -- API -----------------------------------------------------------------
+
+    def gather(self, ids: Sequence[int]) -> np.ndarray:
+        """Synchronous [n, seq_len] batch (truncate/pad to seq_len)."""
+        out = self._alloc(len(ids))
+        if self._handle is None:
+            return self._gather_py(ids, out)
+        ptrs, nbytes = self._index_arrays(ids)
+        self._lib.ds_dl_gather(
+            self._handle,
+            ptrs.ctypes.data_as(ctypes.c_void_p),
+            nbytes.ctypes.data_as(ctypes.c_void_p),
+            len(ids), self._row_bytes, out.ctypes.data_as(ctypes.c_void_p))
+        return out
+
+    def prefetch(self, ids: Sequence[int]) -> None:
+        """Assemble the batch on the background thread; wait() returns it.
+        One outstanding prefetch (double buffering)."""
+        if self._pending is not None:
+            raise RuntimeError("prefetch already in flight; call wait() first")
+        out = self._alloc(len(ids))
+        if self._handle is None:
+            # keep the overlap contract in the fallback too: assemble on a
+            # python thread so prefetch() stays non-blocking
+            import threading
+            t = threading.Thread(target=self._gather_py, args=(list(ids), out))
+            t.start()
+            self._py_thread = t
+            self._pending = out
+            return
+        ptrs, nbytes = self._index_arrays(ids)
+        rc = self._lib.ds_dl_prefetch(
+            self._handle,
+            ptrs.ctypes.data_as(ctypes.c_void_p),
+            nbytes.ctypes.data_as(ctypes.c_void_p),
+            len(ids), self._row_bytes, out.ctypes.data_as(ctypes.c_void_p))
+        if rc != 0:
+            raise RuntimeError("prefetch already in flight in native handle")
+        self._pending = out
+
+    def wait(self) -> np.ndarray:
+        """Block until the prefetched batch is ready and return it."""
+        if self._pending is None:
+            raise RuntimeError("no prefetch in flight")
+        if self._handle is not None:
+            self._lib.ds_dl_prefetch_wait(self._handle)
+        elif getattr(self, "_py_thread", None) is not None:
+            self._py_thread.join()
+            self._py_thread = None
+        out, self._pending = self._pending, None
+        return out
+
+    def __iter__(self):
+        raise TypeError("NativeBatchAssembler is not an iterator; drive it "
+                        "with a sampler via gather()/prefetch()")

@@ -162,3 +162,50 @@ def test_random_ltd_model_trains():
     # eval path ignores LTD (full sequence, no sampling rng needed)
     logits_eval = model.apply({"params": params}, batch)
     assert logits_eval.shape == (4, 32, cfg.vocab_size)
+
+
+def test_native_batch_assembler(tmp_path):
+    """C++ gather/prefetch matches the numpy fallback bit-for-bit, including
+    truncation, padding, and repeated double-buffered prefetch."""
+    from deepspeed_tpu.runtime.data_pipeline.native_loader import (
+        NativeBatchAssembler)
+    prefix = str(tmp_path / "tok")
+    builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    rng = np.random.default_rng(0)
+    docs = [rng.integers(0, 50000, size=n).astype(np.int32)
+            for n in (3, 40, 16, 64, 1, 31)]
+    for d in docs:
+        builder.add_item(d)
+    builder.finalize()
+    dset = MMapIndexedDataset(prefix)
+
+    nat = NativeBatchAssembler(dset, seq_len=16, pad_token=-1)
+    assert nat.has_native, ("C++ data_loader failed to build — the native "
+                            "path would be silently untested")
+    ref = NativeBatchAssembler(dset, seq_len=16, pad_token=-1,
+                               use_native=False)
+    ids = [0, 3, 5, 1, 4]
+    np.testing.assert_array_equal(nat.gather(ids), ref.gather(ids))
+    # explicit shape/pad/truncate checks against the docs themselves
+    out = nat.gather([0, 3])
+    assert out.shape == (2, 16)
+    np.testing.assert_array_equal(out[0, :3], docs[0])
+    assert (out[0, 3:] == -1).all()                  # padded
+    np.testing.assert_array_equal(out[1], docs[3][:16])   # truncated
+
+    # double-buffered prefetch: several rounds, results identical to gather
+    batches = [[1, 2], [5, 0, 3], [4]]
+    nat.prefetch(batches[0])
+    got = []
+    for nxt in batches[1:]:
+        got.append(nat.wait())
+        nat.prefetch(nxt)
+    got.append(nat.wait())
+    for ids_b, arr in zip(batches, got):
+        np.testing.assert_array_equal(arr, ref.gather(ids_b))
+    # one-outstanding-prefetch contract
+    nat.prefetch([0])
+    with pytest.raises(RuntimeError, match="in flight"):
+        nat.prefetch([1])
+    nat.wait()
+    nat.close()
