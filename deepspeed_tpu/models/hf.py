@@ -8,10 +8,11 @@ fused CUDA modules; here the model IS the TPU-native Transformer, so a
 pytree. TP slicing happens downstream via sharding rules (the reference
 slices 1/tp_size by hand, containers/base.py:243).
 
-Policies implemented: GPT-2, GPT-Neo, GPT-J, OPT, BLOOM, BERT, RoBERTa,
-DistilBERT — 8 of the arches the reference's replace_policy.py:18-32 lists.
-torch Linear weights are [out, in] and transpose into flax kernels; GPT-2's
-Conv1D is already [in, out].
+Policies implemented: GPT-2, GPT-Neo, GPT-NeoX, GPT-J, OPT, BLOOM, BERT,
+RoBERTa, DistilBERT, CLIP-text, Megatron-GPT — 11 arches covering the
+reference's replace_policy.py:18-32 list. torch Linear weights are
+[out, in] and transpose into flax kernels; GPT-2's Conv1D is already
+[in, out].
 """
 
 from __future__ import annotations

@@ -95,11 +95,13 @@ def load_data_loader() -> Optional[ctypes.CDLL]:
         lib.ds_dl_open.restype = c.c_void_p
         lib.ds_dl_open.argtypes = [c.c_char_p]
         lib.ds_dl_close.argtypes = [c.c_void_p]
+        lib.ds_dl_gather.restype = c.c_int64
         lib.ds_dl_gather.argtypes = [
             c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64, c.c_int64,
             c.c_void_p]
         lib.ds_dl_prefetch.restype = c.c_int
         lib.ds_dl_prefetch.argtypes = lib.ds_dl_gather.argtypes
+        lib.ds_dl_prefetch_wait.restype = c.c_int64
         lib.ds_dl_prefetch_wait.argtypes = [c.c_void_p]
         lib._ds_typed = True
     return lib

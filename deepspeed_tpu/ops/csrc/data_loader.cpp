@@ -35,6 +35,7 @@ struct DlHandle {
     std::mutex mu;
     std::condition_variable cv;
     bool busy = false;
+    int64_t last_bad = 0;   // bad-row count of the last finished prefetch
 
     ~DlHandle() {
         {
@@ -48,14 +49,23 @@ struct DlHandle {
 
 // gather rows[i] = bin[pointers[i] : pointers[i] + min(lengths, row)*item]
 // into out[i*row_bytes ...]; caller pre-fills `out` with the pad token.
-void gather(const DlHandle* h, const int64_t* pointers,
-            const int64_t* nbytes, int64_t n, int64_t row_bytes, char* out) {
-#pragma omp parallel for schedule(static)
+// Returns the number of rows whose pointer/length fell outside the .bin
+// (corrupt or stale index) so the caller can raise instead of training on
+// silently pad-filled rows.
+int64_t gather(const DlHandle* h, const int64_t* pointers,
+               const int64_t* nbytes, int64_t n, int64_t row_bytes,
+               char* out) {
+    int64_t bad = 0;
+#pragma omp parallel for schedule(static) reduction(+ : bad)
     for (int64_t i = 0; i < n; ++i) {
         int64_t take = nbytes[i] < row_bytes ? nbytes[i] : row_bytes;
-        if (pointers[i] < 0 || pointers[i] + take > h->size) continue;
+        if (pointers[i] < 0 || take < 0 || pointers[i] + take > h->size) {
+            ++bad;
+            continue;
+        }
         std::memcpy(out + i * row_bytes, h->base + pointers[i], take);
     }
+    return bad;
 }
 
 }  // namespace
@@ -70,8 +80,10 @@ void* ds_dl_open(const char* bin_path) {
     void* base = ::mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
     ::close(fd);
     if (base == MAP_FAILED) return nullptr;
-    // the gather is sequential-ish per row; let the kernel read ahead
-    ::madvise(base, st.st_size, MADV_WILLNEED);
+    // document sampling is random access over the corpus — tell the kernel
+    // NOT to read ahead the whole file (WILLNEED here would synchronously
+    // queue readahead of a multi-hundred-GB .bin and thrash the page cache)
+    ::madvise(base, st.st_size, MADV_RANDOM);
     auto* h = new DlHandle();
     h->base = static_cast<char*>(base);
     h->size = st.st_size;
@@ -80,11 +92,12 @@ void* ds_dl_open(const char* bin_path) {
 
 void ds_dl_close(void* h) { delete static_cast<DlHandle*>(h); }
 
-// synchronous assembly; caller pre-fills out with the pad token bytes
-void ds_dl_gather(void* h, const int64_t* pointers, const int64_t* nbytes,
-                  int64_t n, int64_t row_bytes, void* out) {
-    gather(static_cast<DlHandle*>(h), pointers, nbytes, n, row_bytes,
-           static_cast<char*>(out));
+// synchronous assembly; caller pre-fills out with the pad token bytes.
+// Returns the number of out-of-bounds rows (0 = clean).
+int64_t ds_dl_gather(void* h, const int64_t* pointers, const int64_t* nbytes,
+                     int64_t n, int64_t row_bytes, void* out) {
+    return gather(static_cast<DlHandle*>(h), pointers, nbytes, n, row_bytes,
+                  static_cast<char*>(out));
 }
 
 // asynchronous assembly into a caller-owned buffer; exactly one outstanding
@@ -104,10 +117,11 @@ int ds_dl_prefetch(void* hv, const int64_t* pointers, const int64_t* nbytes,
     std::vector<int64_t> lens(nbytes, nbytes + n);
     h->worker = std::thread(
         [h, p = std::move(ptrs), l = std::move(lens), n, row_bytes, out] {
-            gather(h, p.data(), l.data(), n, row_bytes,
-                   static_cast<char*>(out));
+            int64_t bad = gather(h, p.data(), l.data(), n, row_bytes,
+                                 static_cast<char*>(out));
             {
                 std::lock_guard<std::mutex> lk(h->mu);
+                h->last_bad = bad;
                 h->busy = false;
             }
             h->cv.notify_all();
@@ -115,11 +129,13 @@ int ds_dl_prefetch(void* hv, const int64_t* pointers, const int64_t* nbytes,
     return 0;
 }
 
-// blocks until the outstanding prefetch (if any) completes
-void ds_dl_prefetch_wait(void* hv) {
+// blocks until the outstanding prefetch (if any) completes; returns its
+// bad-row count (0 = clean)
+int64_t ds_dl_prefetch_wait(void* hv) {
     auto* h = static_cast<DlHandle*>(hv);
     std::unique_lock<std::mutex> lk(h->mu);
     h->cv.wait(lk, [h] { return !h->busy; });
+    return h->last_bad;
 }
 
 }  // extern "C"

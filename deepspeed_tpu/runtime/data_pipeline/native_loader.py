@@ -93,11 +93,15 @@ class NativeBatchAssembler:
         if self._handle is None:
             return self._gather_py(ids, out)
         ptrs, nbytes = self._index_arrays(ids)
-        self._lib.ds_dl_gather(
+        bad = self._lib.ds_dl_gather(
             self._handle,
             ptrs.ctypes.data_as(ctypes.c_void_p),
             nbytes.ctypes.data_as(ctypes.c_void_p),
             len(ids), self._row_bytes, out.ctypes.data_as(ctypes.c_void_p))
+        if bad:
+            raise IndexError(
+                f"{bad} of {len(ids)} rows fell outside the .bin (corrupt or "
+                "stale index?) — refusing to return pad-filled rows")
         return out
 
     def prefetch(self, ids: Sequence[int]) -> None:
@@ -108,9 +112,18 @@ class NativeBatchAssembler:
         out = self._alloc(len(ids))
         if self._handle is None:
             # keep the overlap contract in the fallback too: assemble on a
-            # python thread so prefetch() stays non-blocking
+            # python thread so prefetch() stays non-blocking; exceptions are
+            # captured and re-raised from wait() (native-path parity)
             import threading
-            t = threading.Thread(target=self._gather_py, args=(list(ids), out))
+            self._py_exc = None
+
+            def work(ids=list(ids), out=out):
+                try:
+                    self._gather_py(ids, out)
+                except BaseException as e:      # re-raised in wait()
+                    self._py_exc = e
+
+            t = threading.Thread(target=work)
             t.start()
             self._py_thread = t
             self._pending = out
@@ -130,10 +143,19 @@ class NativeBatchAssembler:
         if self._pending is None:
             raise RuntimeError("no prefetch in flight")
         if self._handle is not None:
-            self._lib.ds_dl_prefetch_wait(self._handle)
+            bad = self._lib.ds_dl_prefetch_wait(self._handle)
+            if bad:
+                self._pending = None
+                raise IndexError(
+                    f"{bad} prefetched rows fell outside the .bin (corrupt "
+                    "or stale index?) — refusing to return pad-filled rows")
         elif getattr(self, "_py_thread", None) is not None:
             self._py_thread.join()
             self._py_thread = None
+            if self._py_exc is not None:
+                self._pending = None
+                exc, self._py_exc = self._py_exc, None
+                raise exc
         out, self._pending = self._pending, None
         return out
 

@@ -123,4 +123,53 @@ class GatheredParameters(contextlib.AbstractContextManager):
         return False
 
 
-__all__ = ["Init", "GatheredParameters", "ZeroShardingPolicy", "get_active_init"]
+class OnDevice(contextlib.AbstractContextManager):
+    """Construct model params in a target dtype / on a target device.
+
+    reference: deepspeed/utils/init_on_device.py ``OnDevice`` (patches tensor
+    constructors so a huge model materializes as fp16/meta instead of fp32 on
+    the default device). jax init is an explicit function call, so the
+    capability is a wrapper around it:
+
+        with zero.OnDevice(dtype=jnp.bfloat16, device="cpu") as od:
+            params = od.init(model.init, rng, batch)
+        with zero.OnDevice(device="meta") as od:         # shapes only
+            abstract = od.init(model.init, rng, batch)
+    """
+
+    def __init__(self, dtype=None, device: Optional[str] = None,
+                 enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __exit__(self, *exc):
+        return False
+
+    def init(self, init_fn, *args, **kwargs):
+        if not self.enabled:
+            return init_fn(*args, **kwargs)
+        import jax.numpy as jnp
+
+        def casted(*a, **k):
+            tree = init_fn(*a, **k)
+            if self.dtype is None:
+                return tree
+            # cast INSIDE the traced init so XLA fuses it into each param's
+            # producer — the fp32 tree never materializes (the whole point
+            # of OnDevice for models whose fp32 copy would not fit)
+            return jax.tree.map(
+                lambda x: x.astype(self.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+        if self.device == "meta":
+            return jax.eval_shape(casted, *args, **kwargs)
+        if self.device is not None:
+            dev = jax.devices(self.device)[0]
+            with jax.default_device(dev):
+                return jax.jit(casted)(*args, **kwargs)
+        return jax.jit(casted)(*args, **kwargs)
+
+
+__all__ = ["Init", "GatheredParameters", "OnDevice", "ZeroShardingPolicy",
+           "get_active_init"]

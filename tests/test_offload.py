@@ -286,3 +286,27 @@ def test_offload_param_requires_offload_optimizer():
     with pytest.raises(ValueError, match="offload_param"):
         ds.initialize(model=SimpleModel(), config=config,
                       example_batch=random_batch(8))
+
+
+def test_on_device_init():
+    """zero.OnDevice: dtype-cast init, meta (shape-only) init, cpu placement
+    (reference: utils/init_on_device.py OnDevice)."""
+    import flax.linen as nn
+    from deepspeed_tpu import zero
+
+    model = nn.Dense(8)
+    x = jnp.ones((2, 4), jnp.float32)
+
+    with zero.OnDevice(dtype=jnp.bfloat16, device="cpu") as od:
+        params = od.init(model.init, jax.random.PRNGKey(0), x)
+    assert params["params"]["kernel"].dtype == jnp.bfloat16
+    assert "cpu" in str(jax.tree.leaves(params)[0].devices()).lower()
+
+    with zero.OnDevice(device="meta") as od:
+        shapes = od.init(model.init, jax.random.PRNGKey(0), x)
+    leaf = shapes["params"]["kernel"]
+    assert isinstance(leaf, jax.ShapeDtypeStruct) and leaf.shape == (4, 8)
+
+    with zero.OnDevice(enabled=False) as od:
+        real = od.init(model.init, jax.random.PRNGKey(0), x)
+    assert real["params"]["kernel"].dtype == jnp.float32
