@@ -4,8 +4,8 @@ reference: deepspeed/autotuning/ (Autotuner + tuner/ search strategies +
 scheduler.py experiment runner).
 """
 
-from .autotuner import (Autotuner, Experiment, GridSearchTuner, RandomTuner,
+from .autotuner import (Autotuner, Experiment, GridSearchTuner, ModelBasedTuner, RandomTuner,
                         engine_runner, subprocess_runner)
 
-__all__ = ["Autotuner", "Experiment", "GridSearchTuner", "RandomTuner",
+__all__ = ["Autotuner", "Experiment", "GridSearchTuner", "ModelBasedTuner", "RandomTuner",
            "engine_runner", "subprocess_runner"]

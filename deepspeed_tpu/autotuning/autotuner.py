@@ -95,6 +95,83 @@ class RandomTuner:
         return iter(self._combos)
 
 
+class ModelBasedTuner:
+    """Cost-model-guided search (reference: tuner/model_based_tuner.py).
+
+    The reference fits an XGBoost regressor over config-features ->
+    throughput and repeatedly runs the predicted-best untried config.
+    xgboost is not in this image, so the surrogate is closed-form ridge
+    regression over the same featurization (numeric keys as log2 values,
+    categorical keys one-hot) — enough to capture the monotone-ish
+    throughput surfaces of this space.
+
+    Protocol with Autotuner: ``num_seed`` shuffled combos are measured
+    first; after every experiment Autotuner calls ``observe(overrides,
+    score)``; each subsequent ``__next__`` refits and yields the untried
+    combo with the best predicted score, for ``num_trials`` total.
+    """
+
+    def __init__(self, space: Dict[str, List], num_trials: int = 16,
+                 num_seed: int = 4, seed: int = 0, ridge: float = 1e-3):
+        import numpy as np
+        self._np = np
+        self._keys = list(space)
+        self._space = space
+        combos = list(GridSearchTuner(space))
+        random.Random(seed).shuffle(combos)
+        self._combos = combos
+        self.num_trials = min(num_trials, len(combos))
+        self.num_seed = min(num_seed, self.num_trials)
+        self._obs_x: List = []
+        self._obs_y: List[float] = []
+        self._tried: List[Dict] = []
+        self.ridge = ridge
+
+    def _feat(self, overrides: Dict[str, Any]):
+        np = self._np
+        feats = [1.0]                                   # bias
+        for k in self._keys:
+            vals = self._space[k]
+            v = overrides[k]
+            if all(isinstance(x, bool) for x in vals):
+                feats.append(float(v))
+            elif all(isinstance(x, (int, float)) and not isinstance(x, bool)
+                     for x in vals):
+                feats.append(float(np.log2(float(v) + 1.0)))
+            else:                                       # categorical one-hot
+                feats.extend(1.0 if v == x else 0.0 for x in vals)
+        return np.asarray(feats, np.float64)
+
+    def observe(self, overrides: Dict[str, Any], score: float) -> None:
+        if score == float("-inf"):                      # failed run
+            score = 0.0
+        self._obs_x.append(self._feat(overrides))
+        self._obs_y.append(float(score))
+
+    def _predict_best(self) -> Optional[Dict[str, Any]]:
+        np = self._np
+        remaining = [c for c in self._combos if c not in self._tried]
+        if not remaining:
+            return None
+        if len(self._obs_y) < 2:
+            return remaining[0]
+        X = np.stack(self._obs_x)
+        y = np.asarray(self._obs_y)
+        d = X.shape[1]
+        w = np.linalg.solve(X.T @ X + self.ridge * np.eye(d), X.T @ y)
+        preds = [float(self._feat(c) @ w) for c in remaining]
+        return remaining[int(np.argmax(preds))]
+
+    def __iter__(self):
+        for i in range(self.num_trials):
+            nxt = (self._combos[i] if i < self.num_seed
+                   else self._predict_best())
+            if nxt is None:
+                return
+            self._tried.append(nxt)
+            yield nxt
+
+
 class Autotuner:
     """Experiment loop: generate -> run -> rank (reference autotuner.py:421).
 
@@ -117,9 +194,11 @@ class Autotuner:
             self.tuner = GridSearchTuner(self.space)
         elif tuner_type == "random":
             self.tuner = RandomTuner(self.space, num_trials)
+        elif tuner_type in ("model", "model_based"):
+            self.tuner = ModelBasedTuner(self.space, num_trials)
         else:
             raise ValueError(f"unknown tuner_type '{tuner_type}' "
-                             "(gridsearch | random)")
+                             "(gridsearch | random | model)")
         self.early_stopping = early_stopping
         self.results_dir = results_dir
         self.experiments: List[Experiment] = []
@@ -153,6 +232,8 @@ class Autotuner:
                 logger.warning("autotuning experiment %s failed: %s", name,
                                exp.error[:200])
             self.experiments.append(exp)
+            if hasattr(self.tuner, "observe"):          # model-based feedback
+                self.tuner.observe(overrides, exp.score)
             if exp.score > best:
                 best = exp.score
                 since_best = 0

@@ -33,7 +33,7 @@ def main(argv=None):
     p = argparse.ArgumentParser(prog="ds_autotune")
     p.add_argument("--config", required=True, help="base ds_config json")
     p.add_argument("--tuner", default="gridsearch",
-                   choices=["gridsearch", "random"])
+                   choices=["gridsearch", "random", "model"])
     p.add_argument("--mbs", default="", help="micro batch sizes, comma-sep")
     p.add_argument("--stages", default="", help="zero stages, comma-sep")
     p.add_argument("--remat", action="store_true",

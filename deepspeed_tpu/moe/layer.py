@@ -80,6 +80,10 @@ class MoE(nn.Module):
         B, S, H = x.shape
         E = self.num_experts
         tokens = x.reshape(B * S, H)
+        # the merged token dim inherits the batch x seq product sharding —
+        # spell it out so SPMD doesn't fall back to replicate-and-reshard
+        # (the "involuntary full rematerialization" warning on this reshape)
+        tokens = _constrain(tokens, ("data", "expert", "seq"), None)
         T = B * S
 
         gate_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
@@ -121,6 +125,7 @@ class MoE(nn.Module):
 
         y = jnp.einsum("tec,ech->th", combine.astype(self.dtype),
                        expert_out.astype(self.dtype))
+        y = _constrain(y, ("data", "expert", "seq"), None)
         return y.reshape(B, S, H), aux.astype(jnp.float32)
 
 

@@ -59,7 +59,10 @@ int64_t gather(const DlHandle* h, const int64_t* pointers,
 #pragma omp parallel for schedule(static) reduction(+ : bad)
     for (int64_t i = 0; i < n; ++i) {
         int64_t take = nbytes[i] < row_bytes ? nbytes[i] : row_bytes;
-        if (pointers[i] < 0 || take < 0 || pointers[i] + take > h->size) {
+        // overflow-safe form: pointers[i] + take could wrap for garbage
+        // int64 values from a corrupt index
+        if (pointers[i] < 0 || take < 0 || pointers[i] > h->size ||
+            take > h->size - pointers[i]) {
             ++bad;
             continue;
         }

@@ -165,9 +165,16 @@ class OnDevice(contextlib.AbstractContextManager):
         if self.device == "meta":
             return jax.eval_shape(casted, *args, **kwargs)
         if self.device is not None:
+            # pin the OUTPUTS to the requested device explicitly:
+            # jax.default_device only governs uncommitted inputs, so a
+            # committed (already device_put) arg would otherwise drag the
+            # whole init onto the accelerator this class exists to avoid
             dev = jax.devices(self.device)[0]
+            shapes = jax.eval_shape(casted, *args, **kwargs)
+            sharding = jax.sharding.SingleDeviceSharding(dev)
+            out_sh = jax.tree.map(lambda _: sharding, shapes)
             with jax.default_device(dev):
-                return jax.jit(casted)(*args, **kwargs)
+                return jax.jit(casted, out_shardings=out_sh)(*args, **kwargs)
         return jax.jit(casted)(*args, **kwargs)
 
 

@@ -111,3 +111,35 @@ raise SystemExit("engine did not exit at end_profile_step")
     metrics = json.load(open(metric_path))
     assert metrics["throughput"] > 0
     assert metrics["steps"] == 4
+
+
+def test_model_based_tuner_finds_optimum():
+    """The ridge-surrogate tuner (reference: tuner/model_based_tuner.py)
+    finds the best config on a synthetic throughput surface while trying
+    fewer configs than the full grid."""
+    from deepspeed_tpu.autotuning import Autotuner, ModelBasedTuner
+
+    space = {
+        "train_micro_batch_size_per_gpu": [1, 2, 4, 8, 16, 32],
+        "zero_optimization.stage": [0, 1, 2, 3],
+        "activation_checkpointing": [False, True],
+    }
+    # throughput rises with micro batch, dips at stage 3, remat costs 10%
+    def runner(cfg):
+        mb = cfg["train_micro_batch_size_per_gpu"]
+        stage = cfg["zero_optimization"]["stage"]
+        remat = cfg.get("activation_checkpointing", {}).get(
+            "partition_activations", False)
+        thr = mb * (0.8 if stage == 3 else 1.0) * (0.9 if remat else 1.0)
+        return {"throughput": thr}
+
+    tuner = Autotuner({"train_batch_size": 64}, runner, tuning_space=space,
+                      tuner_type="model", num_trials=14)
+    exps = tuner.tune()
+    assert len(exps) == 14 < 6 * 4 * 2            # fewer than the grid
+    best = tuner.best()
+    assert best.config["train_micro_batch_size_per_gpu"] == 32
+    assert best.config["zero_optimization"]["stage"] != 3
+    # the model guided later trials toward large micro batches: the best
+    # config must have been found despite sampling < 30% of the grid
+    assert best.score == 32.0
