@@ -51,6 +51,95 @@ def params_breakdown(params: PyTree, depth: int = 2) -> Dict[str, int]:
     return dict(sorted(out.items(), key=lambda kv: -kv[1]))
 
 
+def _dot_flops(eqn) -> float:
+    """2*M*N*K (x batch dims) for a dot_general equation."""
+    dnums = eqn.params["dimension_numbers"]
+    (lc, rc), (lb, rb) = dnums
+    a, b = eqn.invars[0].aval, eqn.invars[1].aval
+    batch = float(np.prod([a.shape[d] for d in lb], initial=1.0))
+    k = float(np.prod([a.shape[d] for d in lc], initial=1.0))
+    m = float(np.prod([a.shape[d] for d in range(a.ndim)
+                       if d not in lc and d not in lb], initial=1.0))
+    n = float(np.prod([b.shape[d] for d in range(b.ndim)
+                       if d not in rc and d not in rb], initial=1.0))
+    return 2.0 * batch * m * n * k
+
+
+def _conv_flops(eqn) -> float:
+    out = eqn.outvars[0].aval
+    rhs = eqn.invars[1].aval            # kernel
+    out_elems = float(np.prod(out.shape))
+    # kernel work per output element = in_ch * spatial = total / out_ch;
+    # the out-channel position comes from dimension_numbers (OIHW default
+    # puts it FIRST, so shape[-1] would divide by a spatial dim)
+    out_ch_dim = eqn.params["dimension_numbers"].rhs_spec[0]
+    per_out = float(np.prod(rhs.shape)) / max(float(rhs.shape[out_ch_dim]), 1.0)
+    return 2.0 * out_elems * per_out
+
+
+def module_flops_breakdown(fn: Callable, *args, depth: int = 2,
+                           **kwargs) -> Dict[str, float]:
+    """Per-module FLOPS tree from the jaxpr's name stack.
+
+    The reference gets its per-module MAC tree by monkey-patching
+    ``torch.nn.functional`` (profiler.py:805 ``_patch_functionals``); under
+    jax the traced program already carries the flax module path on every
+    equation (``source_info.name_stack``), so the tree falls out of a jaxpr
+    walk: dot/conv flops attributed to ``name_stack[:depth]``, scan bodies
+    multiplied by trip count. Elementwise flops are not counted (matmuls
+    dominate; XLA fuses the rest), so totals slightly undercount vs
+    ``compiled_cost`` — use both: this for WHERE, that for the exact total.
+    """
+    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+
+    def scope(eqn) -> str:
+        names = [getattr(e, "name", str(e))
+                 for e in getattr(eqn.source_info.name_stack, "stack", ())]
+        return "/".join(names[:depth]) if names else "<toplevel>"
+
+    def add(acc, key, val):
+        acc[key] = acc.get(key, 0.0) + val
+
+    def walk(jxp, mult: float, acc: Dict[str, float]):
+        for eqn in jxp.eqns:
+            prim = eqn.primitive.name
+            if prim == "dot_general":
+                add(acc, scope(eqn), mult * _dot_flops(eqn))
+            elif prim == "conv_general_dilated":
+                add(acc, scope(eqn), mult * _conv_flops(eqn))
+            elif prim == "scan":
+                walk(eqn.params["jaxpr"].jaxpr, mult * eqn.params["length"],
+                     acc)
+            elif prim == "while":
+                # trip count is dynamic; count one iteration
+                walk(eqn.params["body_jaxpr"].jaxpr, mult, acc)
+            elif prim == "cond":
+                # exactly one branch executes: charge the costliest one
+                branches = []
+                for br in eqn.params["branches"]:
+                    sub: Dict[str, float] = {}
+                    walk(br.jaxpr, mult, sub)
+                    branches.append(sub)
+                for k, v in max(branches, default={},
+                                key=lambda d: sum(d.values())).items():
+                    add(acc, k, v)
+            else:
+                def recurse(v):
+                    if isinstance(v, jax.extend.core.ClosedJaxpr):
+                        walk(v.jaxpr, mult, acc)
+                    elif hasattr(v, "eqns"):
+                        walk(v, mult, acc)
+                    elif isinstance(v, (tuple, list)):
+                        for item in v:
+                            recurse(item)
+                for v in eqn.params.values():
+                    recurse(v)
+
+    out: Dict[str, float] = {}
+    walk(jaxpr.jaxpr, 1.0, out)
+    return dict(sorted(out.items(), key=lambda kv: -kv[1]))
+
+
 class FlopsProfiler:
     """Profile a train/eval step: flops, wall clock, achieved TFLOPS.
 
@@ -87,13 +176,23 @@ class FlopsProfiler:
         return stats
 
     def print_model_profile(self, params: Optional[PyTree] = None,
-                            depth: int = 2, top_modules: int = 10):
+                            depth: int = 2, top_modules: int = 10,
+                            fn: Optional[Callable] = None, fn_args=()):
+        """reference: print_model_profile (profiler.py:236) — per-module
+        params and, when `fn` is given, per-module FLOPS with % of total."""
         params = params if params is not None else self.model_params
         lines = ["flops profiler " + "-" * 50]
         if params is not None:
             lines.append(f"params total: {params_count(params):,}")
             for name, n in list(params_breakdown(params, depth).items())[:top_modules]:
                 lines.append(f"  {name:<40s} {n:>14,d}")
+        if fn is not None:
+            tree = module_flops_breakdown(fn, *fn_args, depth=depth)
+            total = sum(tree.values()) or 1.0
+            lines.append(f"flops by module (dot/conv, analytic):")
+            for name, f in list(tree.items())[:top_modules]:
+                lines.append(f"  {name:<40s} {f:>14,.3e}  "
+                             f"{100.0 * f / total:5.1f}%")
         for k, v in self.last.items():
             lines.append(f"{k:<20s} {v:,.4g}" if isinstance(v, float)
                          else f"{k:<20s} {v}")

@@ -165,11 +165,15 @@ class OnDevice(contextlib.AbstractContextManager):
         if self.device == "meta":
             return jax.eval_shape(casted, *args, **kwargs)
         if self.device is not None:
-            # pin the OUTPUTS to the requested device explicitly:
-            # jax.default_device only governs uncommitted inputs, so a
-            # committed (already device_put) arg would otherwise drag the
-            # whole init onto the accelerator this class exists to avoid
+            # move COMMITTED args onto the target device first — default
+            # device only governs uncommitted inputs, and mixing a committed
+            # accelerator arg with cpu out_shardings is a jit error; init
+            # args (rngs, example batches) are small, so the transfer is
+            # cheap next to the params the init materializes
             dev = jax.devices(self.device)[0]
+            args, kwargs = jax.tree.map(
+                lambda x: jax.device_put(x, dev)
+                if isinstance(x, jax.Array) else x, (args, kwargs))
             shapes = jax.eval_shape(casted, *args, **kwargs)
             sharding = jax.sharding.SingleDeviceSharding(dev)
             out_sh = jax.tree.map(lambda _: sharding, shapes)

@@ -115,3 +115,30 @@ def test_env_report_runs():
     assert "deepspeed_tpu report" in text
     assert "jax" in text
     assert "[OKAY]" in text
+
+
+def test_module_flops_breakdown_tree():
+    """Per-module FLOPS attribution from the jaxpr name stack (reference:
+    print_model_profile's per-module MAC tree) — exact matmul counts, scan
+    bodies multiplied by layer count."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import build_model
+    from deepspeed_tpu.profiling.flops_profiler import module_flops_breakdown
+
+    model, cfg = build_model("gpt2-tiny", dtype=jnp.float32,
+                             attention_impl="reference")
+    B, S, H, L = 2, 64, cfg.hidden_size, cfg.num_layers
+    ids = jnp.zeros((B, S), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    tree = module_flops_breakdown(
+        lambda p: model.apply({"params": p}, {"input_ids": ids}), params,
+        depth=3)
+    by_leaf = {k.split("/")[-1]: v for k, v in tree.items()}
+    # exact analytic counts: 2*tokens*in*out, x L for scanned blocks
+    tokens = B * S
+    assert by_leaf["mlp_fc"] == 2.0 * tokens * H * cfg.mlp_dim * L
+    assert by_leaf["attn_qkv"] == 2.0 * tokens * H * 3 * H * L
+    assert by_leaf["wte.attend"] == 2.0 * tokens * H * cfg.vocab_size
+    # attention score einsum: 2*B*nh*S*S*hd per layer
+    assert by_leaf["bhqd,bhkd->bhqk"] == \
+        2.0 * B * cfg.num_heads * S * S * cfg.head_dim * L
