@@ -147,3 +147,71 @@ def quantized_allreduce(x: jnp.ndarray,
                            out_specs=(P(), P(axis)),
                            axis_names={axis}, check_vma=False)
     return jax.jit(mapped)(x, error)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO++-style quantized weight gather (qwZ) / gradient reduce-scatter (qgZ)
+# ---------------------------------------------------------------------------
+
+def _sym_quant(x: jnp.ndarray, qmax: float):
+    """Per-tensor symmetric int8 quant: (int8 values, f32 scale)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    scale = jnp.where(absmax == 0, 1.0, absmax / qmax)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -qmax, qmax)
+    return q.astype(jnp.int8), scale
+
+
+def make_quantized_gather(mesh, axis: str, dim: int, bits: int = 8):
+    """ZeRO++-style quantized weight gather (qwZ).
+
+    Returns f(x) where x is sharded on ``dim`` over mesh axis ``axis``:
+    forward all-gathers int8 shards + per-shard scales and dequantizes — the
+    wire carries 1/4 the bf16 gather bytes (ZeRO++'s quantized weight
+    communication). Backward is the exact zero-communication slice back to
+    the shard: under SPMD the cotangent reaching this seam is already
+    globally reduced, so the gradient-side quantization (qgZ) lives in the
+    explicit grad-sync collectives above (``quantized_allreduce``), not
+    here. Intended for DCN-bound meshes where gather bandwidth dominates;
+    over fast ICI prefer the implicit XLA gathers.
+    """
+    qmax = float(2 ** (bits - 1) - 1)
+
+    @jax.custom_vjp
+    def qgather(x):
+        return _fwd(x)[0]
+
+    def _fwd(x):
+        def inner(xs):
+            q, scale = _sym_quant(xs, qmax)
+            qg = jax.lax.all_gather(q, axis)              # [k, ...shard]
+            sg = jax.lax.all_gather(scale, axis)          # [k]
+            deq = qg.astype(jnp.float32) * \
+                sg.reshape((-1,) + (1,) * xs.ndim)
+            full = jnp.concatenate(list(deq), axis=dim)
+            return full.astype(xs.dtype)
+
+        spec = [None] * x.ndim
+        spec[dim] = axis
+        mapped = jax.shard_map(inner, mesh=mesh, in_specs=P(*spec),
+                               out_specs=P(), axis_names={axis},
+                               check_vma=False)
+        return mapped(x), None
+
+    def _bwd(_, g):
+        def inner(gs):
+            # the cotangent is already globally reduced at this seam: the
+            # shard's gradient is exactly its slice of it
+            k = jax.lax.axis_size(axis)
+            me = jax.lax.axis_index(axis)
+            size = gs.shape[dim] // k
+            return jax.lax.dynamic_slice_in_dim(gs, me * size, size, axis=dim)
+
+        spec = [None] * g.ndim
+        spec[dim] = axis
+        mapped = jax.shard_map(inner, mesh=mesh, in_specs=P(),
+                               out_specs=P(*spec), axis_names={axis},
+                               check_vma=False)
+        return (mapped(g),)
+
+    qgather.defvjp(_fwd, _bwd)
+    return qgather

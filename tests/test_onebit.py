@@ -138,3 +138,39 @@ def test_onebit_lamb_numeric_dp1():
             diverged = True
     assert diverged, "onebit_lamb behaved identically to plain lamb"
     assert np.all(np.isfinite(np.asarray(p_ob["w"])))
+
+
+def test_quantized_gather_fwd_bwd_parity():
+    """ZeRO++-style qwZ/qgZ: the int8 quantized weight gather reconstructs
+    the full tensor within int8 tolerance, and its custom-vjp backward (int8
+    all_to_all reduce-scatter) matches the exact gather's gradient."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.runtime.comm.compressed import make_quantized_gather
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("data",))
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((8, 16)).astype(np.float32)
+    w_sh = jax.device_put(jnp.asarray(w), NamedSharding(mesh, P("data", None)))
+
+    qg = make_quantized_gather(mesh, "data", dim=0)
+    # forward: int8-accurate reconstruction (per-shard scale, 127 levels)
+    full = jax.jit(qg)(w_sh)
+    assert full.shape == w.shape
+    per_shard_tol = np.abs(w).reshape(4, 2, 16).max(axis=(1, 2)) / 127.0
+    err = np.abs(np.asarray(full) - w).reshape(4, -1).max(axis=1)
+    assert (err <= per_shard_tol * 1.01).all()
+
+    # backward: STE through the quantization — d/dw sum(full * c) is exactly
+    # each shard's slice of c (the cotangent is already globally reduced at
+    # this seam; gradient-side quantization lives in quantized_allreduce)
+    c = rng.standard_normal((8, 16)).astype(np.float32)
+    g = jax.jit(jax.grad(lambda x: jnp.sum(qg(x) * jnp.asarray(c))))(w_sh)
+    np.testing.assert_allclose(np.asarray(g), c, rtol=0, atol=1e-6)
+
+    # wire audit: the gather in the compiled forward moves int8, not f32
+    txt = jax.jit(qg).lower(w_sh).compile().as_text()
+    assert "all-gather" in txt and "s8" in txt
