@@ -120,9 +120,7 @@ def quantized_allreduce(x: jnp.ndarray,
         x, err = x[0], err[0]
         flat = x.reshape(-1).astype(jnp.float32) + err
         chunks, pad = _chunk(flat, n)
-        absmax = jnp.max(jnp.abs(chunks), axis=1, keepdims=True)
-        scale = jnp.where(absmax == 0, 1.0, absmax / qmax)
-        q = jnp.clip(jnp.round(chunks / scale), -qmax, qmax)
+        q, scale = _sym_quant(chunks, qmax, axis=1)
         deq = (q * scale).reshape(-1)[:flat.size]
         new_err = flat - deq
 
@@ -132,9 +130,7 @@ def quantized_allreduce(x: jnp.ndarray,
         my = jax.lax.axis_index(axis)
         served = jnp.mean(q_recv.astype(jnp.float32) *
                           scales_all[:, my][:, None], axis=0)
-        s_absmax = jnp.max(jnp.abs(served))
-        s_scale = jnp.where(s_absmax == 0, 1.0, s_absmax / qmax)
-        s_q = jnp.clip(jnp.round(served / s_scale), -qmax, qmax)
+        s_q, s_scale = _sym_quant(served, qmax)
 
         out_q = jax.lax.all_gather(s_q.astype(jnp.int8), axis, tiled=True)
         out_scales = jax.lax.all_gather(s_scale, axis)
@@ -153,12 +149,14 @@ def quantized_allreduce(x: jnp.ndarray,
 # ZeRO++-style quantized weight gather (qwZ) / gradient reduce-scatter (qgZ)
 # ---------------------------------------------------------------------------
 
-def _sym_quant(x: jnp.ndarray, qmax: float):
-    """Per-tensor symmetric int8 quant: (int8 values, f32 scale)."""
-    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+def _sym_quant(x: jnp.ndarray, qmax: float, axis=None):
+    """Symmetric quant: (clipped-rounded f32 values, f32 scale). axis=None
+    scales per-tensor; an int axis scales per-slice (keepdims)."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=axis, keepdims=axis is not None)
     scale = jnp.where(absmax == 0, 1.0, absmax / qmax)
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -qmax, qmax)
-    return q.astype(jnp.int8), scale
+    q = jnp.clip(jnp.round(xf / scale), -qmax, qmax)
+    return q, scale
 
 
 def make_quantized_gather(mesh, axis: str, dim: int, bits: int = 8):
@@ -174,6 +172,9 @@ def make_quantized_gather(mesh, axis: str, dim: int, bits: int = 8):
     here. Intended for DCN-bound meshes where gather bandwidth dominates;
     over fast ICI prefer the implicit XLA gathers.
     """
+    if not 2 <= bits <= 8:
+        raise ValueError(f"bits={bits}: the wire dtype is int8, so only "
+                         "2..8-bit quantization is supported")
     qmax = float(2 ** (bits - 1) - 1)
 
     @jax.custom_vjp
@@ -183,6 +184,7 @@ def make_quantized_gather(mesh, axis: str, dim: int, bits: int = 8):
     def _fwd(x):
         def inner(xs):
             q, scale = _sym_quant(xs, qmax)
+            q = q.astype(jnp.int8)
             qg = jax.lax.all_gather(q, axis)              # [k, ...shard]
             sg = jax.lax.all_gather(scale, axis)          # [k]
             deq = qg.astype(jnp.float32) * \

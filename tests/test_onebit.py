@@ -141,9 +141,9 @@ def test_onebit_lamb_numeric_dp1():
 
 
 def test_quantized_gather_fwd_bwd_parity():
-    """ZeRO++-style qwZ/qgZ: the int8 quantized weight gather reconstructs
-    the full tensor within int8 tolerance, and its custom-vjp backward (int8
-    all_to_all reduce-scatter) matches the exact gather's gradient."""
+    """ZeRO++-style qwZ: the int8 quantized weight gather reconstructs the
+    full tensor within int8 tolerance; its custom-vjp backward is the exact
+    zero-communication shard slice (STE through the quantization)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
