@@ -142,7 +142,8 @@ def test_remat_policies_agree():
     ids = rng.integers(0, 256, size=(2, 32))
     batch = {"input_ids": jnp.asarray(ids)}
     results = []
-    for remat, policy in [(False, "dots"), (True, "dots"), (True, "full")]:
+    for remat, policy in [(False, "dots"), (True, "dots"), (True, "full"),
+                          (True, "attn")]:
         m, _ = build_model("gpt2-tiny", vocab_size=256, max_seq_len=32,
                            dtype=jnp.float32, attention_impl="reference",
                            remat=remat, remat_policy=policy)
