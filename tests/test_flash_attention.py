@@ -12,6 +12,14 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+@pytest.fixture(autouse=True)
+def _precise_matmuls():
+    """Kernel-parity tolerances assume fp32 math; on real TPUs jnp matmuls
+    default to bf16 internally, so pin the precision for these tests."""
+    with jax.default_matmul_precision("highest"):
+        yield
+
+
 from deepspeed_tpu.ops.attention import mha_reference
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 

@@ -4,6 +4,14 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+@pytest.fixture(autouse=True)
+def _precise_matmuls():
+    """Kernel-parity tolerances assume fp32 math; on real TPUs jnp matmuls
+    default to bf16 internally, so pin the precision for these tests."""
+    with jax.default_matmul_precision("highest"):
+        yield
+
+
 from deepspeed_tpu.ops.attention import mha_reference
 from deepspeed_tpu.ops.sparse_attention import (
     BigBirdSparsityConfig, BSLongformerSparsityConfig, FixedSparsityConfig,
@@ -68,12 +76,12 @@ def test_sparse_attention_matches_masked_reference():
     out = sparse_attention(q, k, v, cfg)
     mask = layout_to_dense_mask(cfg.make_layout(64), 16)[None]
     ref = mha_reference(q, k, v, causal=False, mask=mask)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6, atol=1e-7)
     # dense config reproduces full attention
     dense = build_sparsity_config("dense", num_heads=2, block=16)
     out_d = sparse_attention(q, k, v, dense)
     full = mha_reference(q, k, v, causal=False)
-    np.testing.assert_allclose(np.asarray(out_d), np.asarray(full), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(full), rtol=1e-6, atol=1e-7)
 
 
 # -- Pallas layout-skip kernel parity (interpret mode on CPU) -----------------
