@@ -108,6 +108,12 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     ignore_unused_parameters: bool = True
     legacy_stage1: bool = False
     round_robin_gradients: bool = False
+    # ZeRO++ qwZ: stage-3 param gathers carry int8 shards + scales (1/4 the
+    # bf16 gather bytes). Trades the per-layer streaming gathers for one
+    # whole-tree quantized gather per microbatch — right when gather
+    # bandwidth (DCN) is the bottleneck, wrong when HBM capacity is (the
+    # gathered bf16 weights are all resident at once)
+    zero_quantized_weights: bool = False
 
     @model_validator(mode="after")
     def _migrate_deprecated(self):

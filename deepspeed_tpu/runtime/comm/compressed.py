@@ -159,23 +159,46 @@ def _sym_quant(x: jnp.ndarray, qmax: float, axis=None):
     return q, scale
 
 
-def make_quantized_gather(mesh, axis: str, dim: int, bits: int = 8):
+def make_quantized_gather(mesh, axis, dim: int, bits: int = 8,
+                          spec: "P" = None):
     """ZeRO++-style quantized weight gather (qwZ).
 
-    Returns f(x) where x is sharded on ``dim`` over mesh axis ``axis``:
-    forward all-gathers int8 shards + per-shard scales and dequantizes — the
-    wire carries 1/4 the bf16 gather bytes (ZeRO++'s quantized weight
+    Returns f(x) where x is sharded on ``dim`` over mesh axis ``axis`` (a
+    name or tuple of names, e.g. the composed ZeRO axes): forward
+    all-gathers int8 shards + per-shard scales and dequantizes — the wire
+    carries 1/4 the bf16 gather bytes (ZeRO++'s quantized weight
     communication). Backward is the exact zero-communication slice back to
     the shard: under SPMD the cotangent reaching this seam is already
     globally reduced, so the gradient-side quantization (qgZ) lives in the
     explicit grad-sync collectives above (``quantized_allreduce``), not
     here. Intended for DCN-bound meshes where gather bandwidth dominates;
     over fast ICI prefer the implicit XLA gathers.
+
+    ``spec``: the leaf's full PartitionSpec (to preserve TP axes on other
+    dims); defaults to sharding only ``dim``.
     """
     if not 2 <= bits <= 8:
         raise ValueError(f"bits={bits}: the wire dtype is int8, so only "
                          "2..8-bit quantization is supported")
     qmax = float(2 ** (bits - 1) - 1)
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+
+    def _specs(ndim):
+        base = list(spec) if spec is not None else [None] * ndim
+        base = base[:ndim] + [None] * (ndim - len(base))
+        in_spec = list(base)
+        in_spec[dim] = axis if isinstance(axis, str) else tuple(axis)
+        out_spec = list(base)
+        out_spec[dim] = None
+        # every axis the specs mention must be manual in the shard_map —
+        # including TP axes on other dims, over which the inner fn simply
+        # operates shard-locally (no collective touches them)
+        manual = set(axes)
+        for entry in base:
+            if entry is None:
+                continue
+            manual |= {entry} if isinstance(entry, str) else set(entry)
+        return P(*in_spec), P(*out_spec), manual
 
     @jax.custom_vjp
     def qgather(x):
@@ -185,17 +208,16 @@ def make_quantized_gather(mesh, axis: str, dim: int, bits: int = 8):
         def inner(xs):
             q, scale = _sym_quant(xs, qmax)
             q = q.astype(jnp.int8)
-            qg = jax.lax.all_gather(q, axis)              # [k, ...shard]
-            sg = jax.lax.all_gather(scale, axis)          # [k]
+            qg = jax.lax.all_gather(q, axes)              # [k, ...shard]
+            sg = jax.lax.all_gather(scale, axes)          # [k]
             deq = qg.astype(jnp.float32) * \
                 sg.reshape((-1,) + (1,) * xs.ndim)
             full = jnp.concatenate(list(deq), axis=dim)
             return full.astype(xs.dtype)
 
-        spec = [None] * x.ndim
-        spec[dim] = axis
-        mapped = jax.shard_map(inner, mesh=mesh, in_specs=P(*spec),
-                               out_specs=P(), axis_names={axis},
+        in_spec, out_spec, manual = _specs(x.ndim)
+        mapped = jax.shard_map(inner, mesh=mesh, in_specs=in_spec,
+                               out_specs=out_spec, axis_names=manual,
                                check_vma=False)
         return mapped(x), None
 
@@ -203,15 +225,19 @@ def make_quantized_gather(mesh, axis: str, dim: int, bits: int = 8):
         def inner(gs):
             # the cotangent is already globally reduced at this seam: the
             # shard's gradient is exactly its slice of it
-            k = jax.lax.axis_size(axis)
-            me = jax.lax.axis_index(axis)
+            k = 1
+            for a in axes:
+                k *= jax.lax.axis_size(a)
             size = gs.shape[dim] // k
-            return jax.lax.dynamic_slice_in_dim(gs, me * size, size, axis=dim)
+            # axis_index over the tuple = row-major flat rank, matching the
+            # all_gather concat order
+            idx = jax.lax.axis_index(axes)
+            return jax.lax.dynamic_slice_in_dim(gs, idx * size, size,
+                                                axis=dim)
 
-        spec = [None] * g.ndim
-        spec[dim] = axis
-        mapped = jax.shard_map(inner, mesh=mesh, in_specs=P(),
-                               out_specs=P(*spec), axis_names={axis},
+        in_spec, out_spec, manual = _specs(g.ndim)
+        mapped = jax.shard_map(inner, mesh=mesh, in_specs=out_spec,
+                               out_specs=in_spec, axis_names=manual,
                                check_vma=False)
         return (mapped(g),)
 
