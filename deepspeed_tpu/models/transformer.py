@@ -293,22 +293,28 @@ class Block(nn.Module):
             pos = positions if positions is not None else jnp.arange(S)
             bias = alibi_bias(nh, pos, pos)
         mask = attn_mask
+        win = 0
         if window is not None:
             # local sliding window (GPT-Neo): q attends k in (q-window, q].
-            # NOTE: mask/bias currently route attention() to the dense
-            # reference path (quadratic); long-seq window/alibi layers should
-            # move onto ops/pallas/block_sparse_attention (the sliding-window
-            # layout) — tracked as a perf follow-up, numerics are exact here.
-            q_pos = jnp.arange(S)[:, None]
-            k_pos = jnp.arange(S)[None, :]
-            wmask = (q_pos - k_pos < window) | (window <= 0)
-            mask = wmask[None, None] if mask is None else mask & wmask[None, None]
+            # attention() routes this to the block-skip sliding-window kernel
+            # on TPU (compute scales with the window); with a user mask or
+            # under tracing where `window` is dynamic, it composes into the
+            # dense mask (exact either way)
+            if isinstance(window, (int, np.integer)):
+                win = max(int(window), 0)          # <=0 means global
+            else:
+                q_pos = jnp.arange(S)[:, None]
+                k_pos = jnp.arange(S)[None, :]
+                wmask = (q_pos - k_pos < window) | (window <= 0)
+                mask = (wmask[None, None] if mask is None
+                        else mask & wmask[None, None])
         drop_rng = (self.make_rng("dropout")
                     if train and cfg.dropout > 0.0 else None)
         out = attention(q, k, v, causal=cfg.causal, mask=mask, bias=bias,
                         sm_scale=cfg.attn_scale,
                         dropout_rate=cfg.dropout if train else 0.0,
-                        dropout_rng=drop_rng, impl=cfg.attention_impl)
+                        dropout_rng=drop_rng, impl=cfg.attention_impl,
+                        window=win)
         # tag so the "dots" remat policy keeps it: the Pallas kernel output is
         # not a dot_general, and recomputing flash fwd in bwd costs ~2ms/layer
         from jax.ad_checkpoint import checkpoint_name
@@ -436,7 +442,9 @@ class Transformer(nn.Module):
             if cfg.remat_policy not in policies:
                 raise ValueError(f"unknown remat_policy '{cfg.remat_policy}'; "
                                  f"have {sorted(policies)}")
-            block = nn.remat(Block, static_argnums=(3,),
+            # train AND window are static: a traced window would defeat the
+            # sliding-window kernel routing in the unrolled path
+            block = nn.remat(Block, static_argnums=(3, 4),
                              policy=policies[cfg.remat_policy])
         windows = (jnp.asarray(cfg.layer_windows, jnp.int32)
                    if cfg.layer_windows is not None else None)
@@ -493,7 +501,10 @@ class Transformer(nn.Module):
                     "window would apply to compacted subset indices, voiding "
                     "the true token-distance constraint")
             for i in range(cfg.num_layers):
-                w = windows[i] if windows is not None else None
+                # static python ints here (unlike the scanned path) so
+                # attention() can route to the sliding-window kernel
+                w = (int(cfg.layer_windows[i]) or None) \
+                    if cfg.layer_windows is not None else None
                 blk = block(cfg, name=f"blocks_{i}")
                 if pld_on:
                     x_in = x

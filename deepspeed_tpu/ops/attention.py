@@ -63,6 +63,30 @@ def mha_reference(q: jnp.ndarray,
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
 
 
+def sliding_window_attention(q, k, v, window: int, *,
+                             sm_scale: Optional[float] = None,
+                             interpret: bool = False) -> jnp.ndarray:
+    """Causal sliding-window attention on the block-skip kernel: the layout
+    visits only blocks intersecting the window (compute scales with window,
+    not seq) and the kernel applies the EXACT per-token window in-block —
+    same numerics as the dense (q_pos - k_pos < window) mask. Raises when
+    shapes can't tile; callers fall back to the dense-mask path."""
+    from .pallas.block_sparse_attention import block_sparse_flash_attention
+    from .sparse_attention import LocalSlidingWindowSparsityConfig
+    B, H, S, D = q.shape
+    fine = 64 if S % 64 == 0 else 16
+    w_blocks = -(-(window - 1) // fine) + 1 if window > 1 else 1
+    cfg = LocalSlidingWindowSparsityConfig(
+        num_heads=H, block=fine, num_sliding_window_blocks=w_blocks,
+        attention="unidirectional")
+    layout = cfg.make_layout(S)
+    # the exact pattern is fully defined by the causal + window masks, so
+    # the per-program fine-layout mask work is skipped (layout_exact=False)
+    return block_sparse_flash_attention(
+        q, k, v, layout, fine, causal=True, sm_scale=sm_scale,
+        window=window, layout_exact=False, interpret=interpret)
+
+
 def attention(q: jnp.ndarray,
               k: jnp.ndarray,
               v: jnp.ndarray,
@@ -75,9 +99,31 @@ def attention(q: jnp.ndarray,
               dropout_rng: Optional[jax.Array] = None,
               impl: str = "auto",
               block_q: int = 1024,
-              block_k: int = 1024) -> jnp.ndarray:
-    """Dispatching attention entry point. Shapes: [batch, heads, seq, head_dim]."""
+              block_k: int = 1024,
+              window: int = 0) -> jnp.ndarray:
+    """Dispatching attention entry point. Shapes: [batch, heads, seq, head_dim].
+
+    ``window`` > 0 (with causal=True, no mask/bias/dropout) routes to the
+    block-skip sliding-window kernel on TPU. The window must be a STATIC
+    python int for the kernel route — model paths that trace it (the
+    scanned-layers transformer, whose per-layer window is a scan element)
+    compose it into the dense mask instead; windows <= 0 mean global."""
     needs_reference = bias is not None or mask is not None or dropout_rate > 0.0
+    window = 0 if window is None or window <= 0 else window
+    if window and causal and not needs_reference and \
+            jax.default_backend() == "tpu" and impl in ("auto", "flash"):
+        try:
+            return sliding_window_attention(q, k, v, window,
+                                            sm_scale=sm_scale)
+        except ValueError:
+            pass        # shapes don't tile — dense mask below
+    if window:
+        S = q.shape[-2]
+        q_pos = jnp.arange(S)[:, None]
+        k_pos = jnp.arange(S)[None, :]
+        wmask = (q_pos - k_pos < window)[None, None]
+        mask = wmask if mask is None else mask & wmask
+        needs_reference = True
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         impl = "flash" if (on_tpu and not needs_reference) else "reference"

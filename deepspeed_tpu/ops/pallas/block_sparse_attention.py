@@ -45,6 +45,14 @@ from .flash_attention import NEG_INF, _causal_block_mask
 __all__ = ["block_sparse_flash_attention"]
 
 
+def _window_block_mask(s, iq, kb, block_q, block_k, window):
+    """Exact per-token sliding window: keep logits with q_pos - k_pos <
+    window (the causal side is _causal_block_mask's job)."""
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos - k_pos < window, s, NEG_INF)
+
+
 def _layout_mask(sub8, s, kb, fine, block_q, block_k):
     """Apply the fine layout to logits s [block_q, block_k]; kb is the
     (dynamic) k-block index, sub8 the q side's [8, nf] fine rows."""
@@ -60,7 +68,8 @@ def _layout_mask(sub8, s, kb, fine, block_q, block_k):
 
 def _fwd_kernel(cnt_ref, idx_ref, lay_ref, q_ref, k_ref, v_ref, o_ref,
                 lse_ref, acc, m_scr, l_scr,
-                *, H, nq, maxk, sm_scale, causal, block_q, block_k, fine):
+                *, H, nq, maxk, sm_scale, causal, block_q, block_k, fine,
+                window, layout_exact):
     b, iq, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     h = b % H
     row = h * nq + iq
@@ -80,9 +89,12 @@ def _fwd_kernel(cnt_ref, idx_ref, lay_ref, q_ref, k_ref, v_ref, o_ref,
         q, k, v = q_ref[0], k_ref[0], v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        s = _layout_mask(sub8, s, kb, fine, block_q, block_k)
+        if layout_exact:
+            s = _layout_mask(sub8, s, kb, fine, block_q, block_k)
         if causal:
             s = _causal_block_mask(s, iq, kb, block_q, block_k, 0)
+        if window:
+            s = _window_block_mask(s, iq, kb, block_q, block_k, window)
         m_prev = m_scr[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         # rows with nothing active so far keep m = NEG_INF; exp underflows to 0
@@ -103,7 +115,8 @@ def _fwd_kernel(cnt_ref, idx_ref, lay_ref, q_ref, k_ref, v_ref, o_ref,
 
 def _bwd_dq_kernel(cnt_ref, idx_ref, lay_ref, q_ref, k_ref, v_ref, do_ref,
                    lse_ref, delta_ref, dq_ref, dq_acc,
-                   *, H, nq, maxk, sm_scale, causal, block_q, block_k, fine):
+                   *, H, nq, maxk, sm_scale, causal, block_q, block_k, fine,
+                   window, layout_exact):
     b, iq, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     row = (b % H) * nq + iq
 
@@ -122,9 +135,12 @@ def _bwd_dq_kernel(cnt_ref, idx_ref, lay_ref, q_ref, k_ref, v_ref, do_ref,
         delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        s = _layout_mask(sub8, s, kb, fine, block_q, block_k)
+        if layout_exact:
+            s = _layout_mask(sub8, s, kb, fine, block_q, block_k)
         if causal:
             s = _causal_block_mask(s, iq, kb, block_q, block_k, 0)
+        if window:
+            s = _window_block_mask(s, iq, kb, block_q, block_k, window)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -139,7 +155,8 @@ def _bwd_dq_kernel(cnt_ref, idx_ref, lay_ref, q_ref, k_ref, v_ref, do_ref,
 
 def _bwd_dkv_kernel(cnt_ref, idx_ref, lay_ref, q_ref, k_ref, v_ref, do_ref,
                     lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, H, nk, maxq, sm_scale, causal, block_q, block_k, fine):
+                    *, H, nk, maxq, sm_scale, causal, block_q, block_k, fine,
+                    window, layout_exact):
     b, ik, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     row = (b % H) * nk + ik
 
@@ -159,9 +176,12 @@ def _bwd_dkv_kernel(cnt_ref, idx_ref, lay_ref, q_ref, k_ref, v_ref, do_ref,
         delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        s = _layout_mask(sub8, s, ik, fine, block_q, block_k)
+        if layout_exact:
+            s = _layout_mask(sub8, s, ik, fine, block_q, block_k)
         if causal:
             s = _causal_block_mask(s, qb, ik, block_q, block_k, 0)
+        if window:
+            s = _window_block_mask(s, qb, ik, block_q, block_k, window)
         p = jnp.exp(s - lse)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -216,13 +236,14 @@ def _active_lists(layout: np.ndarray, fine: int, block_q: int, block_k: int
 
 
 def _fwd(q3, k3, v3, lay8, cnt, idx, maxk, H, causal, sm_scale, block_q,
-         block_k, fine, interpret):
+         block_k, fine, window, layout_exact, interpret):
     BH, S, D = q3.shape
     nq = S // block_q
     nf = lay8.shape[2]
     kernel = functools.partial(
         _fwd_kernel, H=H, nq=nq, maxk=maxk, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, fine=fine)
+        block_q=block_q, block_k=block_k, fine=fine, window=window,
+        layout_exact=layout_exact)
 
     def kv_index(b, i, j, cnt_ref, idx_ref):
         return (b, idx_ref[((b % H) * nq + i) * maxk + j], 0)
@@ -259,7 +280,7 @@ def _fwd(q3, k3, v3, lay8, cnt, idx, maxk, H, causal, sm_scale, block_q,
 
 
 def _bwd(q3, k3, v3, o3, do3, lse, lay8, sched, H, causal, sm_scale, block_q,
-         block_k, fine, interpret):
+         block_k, fine, window, layout_exact, interpret):
     BH, S, D = q3.shape
     nq, nk = S // block_q, S // block_k
     nf = lay8.shape[2]
@@ -289,7 +310,8 @@ def _bwd(q3, k3, v3, o3, do3, lse, lay8, sched, H, causal, sm_scale, block_q,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, H=H, nq=nq, maxk=maxk,
                           sm_scale=sm_scale, causal=causal, block_q=block_q,
-                          block_k=block_k, fine=fine),
+                          block_k=block_k, fine=fine, window=window,
+                          layout_exact=layout_exact),
         grid_spec=grid_dq,
         out_shape=[jax.ShapeDtypeStruct((BH, S, D), q3.dtype)],
         interpret=interpret,
@@ -328,7 +350,8 @@ def _bwd(q3, k3, v3, o3, do3, lse, lay8, sched, H, causal, sm_scale, block_q,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, H=H, nk=nk, maxq=maxq,
                           sm_scale=sm_scale, causal=causal, block_q=block_q,
-                          block_k=block_k, fine=fine),
+                          block_k=block_k, fine=fine, window=window,
+                          layout_exact=layout_exact),
         grid_spec=grid_dkv,
         out_shape=[jax.ShapeDtypeStruct((BH, S, D), k3.dtype),
                    jax.ShapeDtypeStruct((BH, S, D), v3.dtype)],
@@ -337,16 +360,17 @@ def _bwd(q3, k3, v3, o3, do3, lse, lay8, sched, H, causal, sm_scale, block_q,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12, 13))
 def _bs_flash(q, k, v, prefetch, sched_meta, H, causal, sm_scale, block_q,
-              block_k, fine, interpret):
+              block_k, fine, window, layout_exact, interpret):
     out, _ = _bs_fwd(q, k, v, prefetch, sched_meta, H, causal, sm_scale,
-                     block_q, block_k, fine, interpret)
+                     block_q, block_k, fine, window, layout_exact, interpret)
     return out
 
 
 def _bs_fwd(q, k, v, prefetch, sched_meta, H, causal, sm_scale, block_q,
-            block_k, fine, interpret):
+            block_k, fine, window, layout_exact, interpret):
     maxk, maxq = sched_meta
     lay8, cnt, idx, cnt_t, idx_t = prefetch
     B, Hh, S, D = q.shape
@@ -354,20 +378,21 @@ def _bs_fwd(q, k, v, prefetch, sched_meta, H, causal, sm_scale, block_q,
     k3 = k.reshape(B * Hh, S, D)
     v3 = v.reshape(B * Hh, S, D)
     o3, lse = _fwd(q3, k3, v3, lay8, cnt, idx, maxk, Hh, causal, sm_scale,
-                   block_q, block_k, fine, interpret)
+                   block_q, block_k, fine, window, layout_exact, interpret)
     return o3.reshape(B, Hh, S, D), (q3, k3, v3, o3, lse, prefetch,
                                      (B, Hh, S, D))
 
 
-def _bs_bwd(sched_meta, H, causal, sm_scale, block_q, block_k, fine,
-            interpret, res, g):
+def _bs_bwd(sched_meta, H, causal, sm_scale, block_q, block_k, fine, window,
+            layout_exact, interpret, res, g):
     q3, k3, v3, o3, lse, prefetch, (B, Hh, S, D) = res
     maxk, maxq = sched_meta
     lay8, cnt, idx, cnt_t, idx_t = prefetch
     do3 = g.reshape(B * Hh, S, D)
     sched = (cnt, idx, maxk, cnt_t, idx_t, maxq)
     dq, dk, dv = _bwd(q3, k3, v3, o3, do3, lse, lay8, sched, Hh, causal,
-                      sm_scale, block_q, block_k, fine, interpret)
+                      sm_scale, block_q, block_k, fine, window, layout_exact,
+                      interpret)
     return (dq.reshape(B, Hh, S, D), dk.reshape(B, Hh, S, D),
             dv.reshape(B, Hh, S, D), (None,) * 5)
 
@@ -385,6 +410,8 @@ def block_sparse_flash_attention(q: jnp.ndarray,
                                  sm_scale: Optional[float] = None,
                                  block_q: int = 256,
                                  block_k: int = 256,
+                                 window: int = 0,
+                                 layout_exact: bool = True,
                                  interpret: bool = False) -> jnp.ndarray:
     """Layout-skipping attention. q,k,v: [B, H, S, D]; layout [H, nq, nk]
     bool at ``fine_block`` granularity (SparsityConfig.make_layout output).
@@ -423,4 +450,5 @@ def block_sparse_flash_attention(q: jnp.ndarray,
     prefetch = (lay8, jnp.asarray(cnt), jnp.asarray(idx),
                 jnp.asarray(cnt_t), jnp.asarray(idx_t))
     return _bs_flash(q, k, v, prefetch, (maxk, maxq), H, causal, sm_scale,
-                     block_q, block_k, fine_block, interpret)
+                     block_q, block_k, fine_block, window, layout_exact,
+                     interpret)

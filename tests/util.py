@@ -44,3 +44,14 @@ def tree_allclose(a, b, rtol=1e-5, atol=1e-6):
     ok = jax.tree.map(
         lambda x, y: np.allclose(np.asarray(x), np.asarray(y), rtol=rtol, atol=atol), a, b)
     return all(jax.tree.leaves(ok))
+
+
+def require_devices(n: int):
+    """Skip when the active platform exposes fewer than n devices (the
+    reference's requires_cuda_env pattern, tests/unit/common.py:78 — here
+    the axis is device count: DSTPU_TEST_PLATFORM=tpu on a single chip
+    cannot host the virtual multi-chip meshes the CPU suite uses)."""
+    import jax
+    import pytest
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs >= {n} devices; platform has {len(jax.devices())}")
