@@ -23,7 +23,7 @@ from deepspeed_tpu.checkpoint import (AsyncCheckpointEngine,
 from deepspeed_tpu.runtime.checkpointing import (read_flat_npz, save_tree,
                                                  load_tree, write_flat_npz)
 
-from util import SimpleModel, random_batch
+from util import SimpleModel, random_batch, require_devices
 
 
 def test_bf16_preserved_bit_exact(tmp_path):
@@ -127,6 +127,7 @@ def _lm_batch(i):
 
 
 def test_cross_topology_roundtrip(tmp_path):
+    require_devices(8)
     """Save under pure dp=8, restore under tp=2 x sp=2 x dp=2: the loaded
     model must produce the same losses stepping forward."""
     e_dp = _gpt_engine({})                              # data=8

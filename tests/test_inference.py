@@ -5,6 +5,18 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+
+@pytest.fixture(autouse=True)
+def _precise_matmuls():
+    """Parity tolerances assume fp32 math; on real TPUs jnp matmuls default
+    to bf16 internally, so pin the precision for these tests."""
+    import jax as _jax
+    with _jax.default_matmul_precision("highest"):
+        yield
+
+
+from util import require_devices
+
 import deepspeed_tpu as ds
 from deepspeed_tpu.models import build_model
 from deepspeed_tpu.models.generation import (forward_with_cache, generate,
@@ -121,6 +133,7 @@ def test_hf_gpt2_import_parity():
 
 
 def test_tp2_generate_with_resharded_checkpoint(tmp_path):
+    require_devices(2)
     """TP-degree resharding at load (reference: state_dict_factory.py:214):
     a checkpoint written topology-free loads into a tp=2 engine and greedy
     generation matches the tp=1 engine token for token."""

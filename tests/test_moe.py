@@ -4,6 +4,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+from util import require_devices
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import deepspeed_tpu as ds
@@ -74,6 +76,7 @@ def test_moe_layer_forward_and_params():
 
 
 def test_expert_parallel_apply_matches_local():
+    require_devices(2)
     """Explicit a2a path == plain vmap over experts (numerical oracle)."""
     from deepspeed_tpu.parallel.mesh import MeshManager
     mm = MeshManager(ep_size=4)   # expert axis = 4, data = 2
@@ -95,6 +98,7 @@ def test_expert_parallel_apply_matches_local():
 # -- transformer integration --------------------------------------------------
 
 def test_moe_transformer_trains():
+    require_devices(2)
     model, cfg = build_model("gpt2-tiny", hidden_size=64, num_layers=2,
                              num_heads=4, vocab_size=256, max_seq_len=64,
                              moe_experts=4, moe_capacity_factor=2.0,

@@ -12,6 +12,16 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+
+@pytest.fixture(autouse=True)
+def _precise_matmuls():
+    """Parity tolerances assume fp32 math; on real TPUs jnp matmuls default
+    to bf16 internally, so pin the precision for these tests."""
+    import jax as _jax
+    with _jax.default_matmul_precision("highest"):
+        yield
+
+
 import deepspeed_tpu as ds
 from deepspeed_tpu.ops.cpu import AsyncIOHandle, DeepSpeedCPUAdam
 from deepspeed_tpu.ops.optimizers import adamw as jax_adamw

@@ -12,6 +12,16 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from util import require_devices
+
+
+@pytest.fixture(autouse=True)
+def _multidevice():
+    """This module's features are inherently multi-device (virtual CPU mesh
+    in the default suite); skip on platforms with fewer devices."""
+    require_devices(8)
+
+
 import deepspeed_tpu as ds
 from deepspeed_tpu.runtime.onebit import hlo_collective_bytes
 

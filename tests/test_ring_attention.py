@@ -4,6 +4,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+from util import require_devices
+
+
+@pytest.fixture(autouse=True)
+def _multidevice():
+    """This module's features are inherently multi-device (virtual CPU mesh
+    in the default suite); skip on platforms with fewer devices."""
+    require_devices(4)
+
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import deepspeed_tpu as ds
