@@ -20,6 +20,7 @@ from .parallel.topology import (
     PipelineParallelGrid,
 )
 from .runtime.engine import DeepSpeedEngine
+from .runtime import act_checkpoint as checkpointing  # deepspeed.checkpointing parity
 from .runtime.lr_schedules import LRScheduler, build_schedule
 
 

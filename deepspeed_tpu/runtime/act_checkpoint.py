@@ -1,0 +1,209 @@
+"""Activation checkpointing for ARBITRARY user models.
+
+Reference parity: ``deepspeed/runtime/activation_checkpointing/checkpointing.py``
+(``checkpoint(function, *args)`` at :748 wraps any module; ``configure`` at :830
+reads the ``activation_checkpointing`` config section).  The reference
+implements this with a custom ``torch.autograd.Function`` that detaches inputs,
+stashes RNG states, and re-runs the forward in backward — ~400 LoC of manual
+bookkeeping.  On TPU the whole mechanism is one primitive: ``jax.checkpoint``
+(remat).  XLA re-runs the forward fragment during the backward pass and its
+scheduler frees recomputed values as soon as they are consumed.
+
+TPU-native mapping of the reference knobs:
+
+  reference knob                      TPU behavior
+  ----------------------------------  -------------------------------------
+  partition_activations               saved residuals are mesh-sharded by
+                                      construction under pjit — the partition
+                                      the reference implements by hand
+                                      (checkpointing.py:372) falls out of the
+                                      sharding propagation; the knob therefore
+                                      just enables checkpointing
+  cpu_checkpointing                   remat policy offloads dot outputs to
+                                      host memory when the backend supports
+                                      memories (checkpointing.py:485)
+  contiguous_memory_optimization      no-op: XLA arena allocation is
+                                      contiguous already (checkpointing.py:438)
+  number_checkpoints                  informational (JAX segments by the
+                                      wrapped function, not a global count)
+  synchronize_checkpoint_boundary     no-op: XLA inserts the needed
+                                      dependencies; there is no stream skew
+  profile                             logs remat policy at configure time
+
+``checkpoint()`` composes: call it around any sub-function inside a traced
+computation (per-layer, like the reference's Megatron usage) or let the engine
+wrap the whole ``apply_fn`` when the config section is enabled.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+
+from ..utils.logging import logger
+
+# module-level state, mirroring the reference's globals
+# (checkpointing.py:52-61)
+_CONFIGURED = False
+_PARTITION_ACTIVATIONS = False
+_CPU_CHECKPOINTING = False
+_CONTIGUOUS_CHECKPOINTING = False
+_NUM_CHECKPOINTS: Optional[int] = None
+_PROFILE = False
+_POLICY_NAME = "full"
+
+
+def _backend_platform() -> str:
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "<uninitialized>"
+
+
+def _host_offload_supported() -> bool:
+    """Host ("pinned_host") memory spaces exist on TPU; CPU backends reject
+    the offload policy at lowering time, so probe the platform."""
+    return _backend_platform() == "tpu"
+
+
+def make_remat_policy(name: str) -> Optional[Callable]:
+    """Map a policy name to a ``jax.checkpoint_policies`` entry.
+
+    ``full``    — save nothing, recompute everything (reference default
+                  behavior of ``checkpoint()``)
+    ``dots``    — save matmul outputs only (Megatron-style "selective"
+                  recompute: cheap elementwise ops are recomputed, the
+                  expensive MXU results are kept)
+    ``offload`` — like ``dots`` but the saved dot outputs live in host
+                  memory (the reference's ``cpu_checkpointing``)
+    ``none``    — save everything (checkpointing disabled)
+    """
+    cp = jax.checkpoint_policies
+    if name == "none":
+        return cp.everything_saveable
+    if name == "full":
+        return cp.nothing_saveable
+    if name == "dots":
+        return cp.dots_with_no_batch_dims_saveable
+    if name == "offload":
+        if _host_offload_supported():
+            return cp.offload_dot_with_no_batch_dims("device", "pinned_host")
+        logger.warning(
+            "cpu_checkpointing: backend %s has no host memory space — "
+            "falling back to selective (dots) recompute",
+            _backend_platform())
+        return cp.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown remat policy {name!r} "
+                     "(expected none|full|dots|offload)")
+
+
+def configure(mpu_=None,
+              deepspeed_config=None,
+              partition_activations: Optional[bool] = None,
+              contiguous_checkpointing: Optional[bool] = None,
+              num_checkpoints: Optional[int] = None,
+              checkpoint_in_cpu: Optional[bool] = None,
+              synchronize: Optional[bool] = None,
+              profile: Optional[bool] = None) -> None:
+    """Configure the module-level checkpointing behavior.
+
+    Mirrors the reference signature (checkpointing.py:830).  ``deepspeed_config``
+    may be a path / dict / DeepSpeedConfig; explicit kwargs override it.
+    ``mpu_`` is accepted for API parity and unused: activation partitioning is
+    a sharding-propagation fact on TPU, not an mpu concern.
+    """
+    global _CONFIGURED, _PARTITION_ACTIVATIONS, _CPU_CHECKPOINTING
+    global _CONTIGUOUS_CHECKPOINTING, _NUM_CHECKPOINTS, _PROFILE, _POLICY_NAME
+
+    if deepspeed_config is not None:
+        from ..config import load_config
+        sect = load_config(deepspeed_config).activation_checkpointing
+        _PARTITION_ACTIVATIONS = sect.partition_activations
+        _CPU_CHECKPOINTING = sect.cpu_checkpointing
+        _CONTIGUOUS_CHECKPOINTING = sect.contiguous_memory_optimization
+        _NUM_CHECKPOINTS = sect.number_checkpoints
+        _PROFILE = sect.profile
+    if partition_activations is not None:
+        _PARTITION_ACTIVATIONS = partition_activations
+    if contiguous_checkpointing is not None:
+        _CONTIGUOUS_CHECKPOINTING = contiguous_checkpointing
+    if num_checkpoints is not None:
+        _NUM_CHECKPOINTS = num_checkpoints
+    if checkpoint_in_cpu is not None:
+        _CPU_CHECKPOINTING = checkpoint_in_cpu
+    if profile is not None:
+        _PROFILE = profile
+
+    _POLICY_NAME = "offload" if _CPU_CHECKPOINTING else "full"
+    _CONFIGURED = True
+    if _PROFILE:
+        logger.info("activation checkpointing configured: policy=%s "
+                    "partition_activations=%s (sharded by construction) "
+                    "num_checkpoints=%s", _POLICY_NAME,
+                    _PARTITION_ACTIVATIONS, _NUM_CHECKPOINTS)
+
+
+def is_configured() -> bool:
+    return _CONFIGURED
+
+
+def reset() -> None:
+    """Reference parity (checkpointing.py:773). The reference frees its
+    contiguous activation buffers here; XLA owns allocation, so this only
+    resets the module state."""
+    global _CONFIGURED, _PARTITION_ACTIVATIONS, _CPU_CHECKPOINTING
+    global _CONTIGUOUS_CHECKPOINTING, _NUM_CHECKPOINTS, _POLICY_NAME, _PROFILE
+    _CONFIGURED = False
+    _PARTITION_ACTIVATIONS = False
+    _CPU_CHECKPOINTING = False
+    _CONTIGUOUS_CHECKPOINTING = False
+    _NUM_CHECKPOINTS = None
+    _POLICY_NAME = "full"
+    _PROFILE = False
+
+
+def partition_activations_in_checkpoint(partition_activation: bool) -> None:
+    """Reference parity (checkpointing.py:760)."""
+    global _PARTITION_ACTIVATIONS
+    _PARTITION_ACTIVATIONS = partition_activation
+
+
+def set_num_layers(nlayers: int) -> None:
+    """Reference parity (checkpointing.py:768)."""
+    global _NUM_CHECKPOINTS
+    _NUM_CHECKPOINTS = nlayers
+
+
+def checkpoint(function: Callable, *args: Any) -> Any:
+    """Checkpoint a model segment: ``deepspeed.checkpointing.checkpoint``
+    (reference :748).  Call inside a traced/jitted computation around any
+    sub-function (a transformer layer, a block, the whole model); during the
+    backward pass XLA recomputes the segment instead of keeping its residuals.
+
+    Unlike the torch version there is no RNG stashing to do — JAX rng is
+    explicit and replays identically on recompute.
+    """
+    return remat(function)(*args)
+
+
+def remat(function: Callable, policy_name: Optional[str] = None,
+          static_argnums=()) -> Callable:
+    """Return a rematerialized version of ``function`` under the configured
+    (or given) policy.  ``jax.checkpoint`` is idempotent-safe to apply at
+    trace time and a no-op outside differentiation."""
+    name = policy_name or _POLICY_NAME
+    pol = make_remat_policy(name)
+    if pol is jax.checkpoint_policies.everything_saveable:
+        return function
+    return jax.checkpoint(function, policy=pol, static_argnums=static_argnums)
+
+
+def checkpointable(function: Callable) -> Callable:
+    """Decorator form of :func:`checkpoint`.  The policy is resolved at call
+    time, so a later :func:`configure` applies to already-decorated fns."""
+    @functools.wraps(function)
+    def wrapped(*args):
+        return remat(function)(*args)
+    return wrapped
