@@ -225,7 +225,19 @@ class DeepSpeedEngine:
         # optimizer state + fp32 master live off-device (host RAM or NVMe);
         # the device round-trips grads out / compute-dtype params in.
         off = self.config.zero_optimization.offload_optimizer
+        # offload_param: TRANSIENT device params (reference: ZeRO-3 param
+        # offload keeps weights host-side and pages them in per use,
+        # partition_parameters.py) — HBM holds the weights only while a
+        # compiled step runs; they re-materialize from the host (cpu) or
+        # NVMe (ZeRO-Infinity param tier, partitioned_param_swapper.py:35)
+        # master maintained by the host optimizer.
+        off_p = self.config.zero_optimization.offload_param
         self.offload = None
+        if off_p is not None and off_p.device in ("cpu", "nvme") \
+                and (off is None or off.device not in ("cpu", "nvme")):
+            raise ValueError(
+                "offload_param needs offload_optimizer (the host-resident "
+                "master the transient params re-materialize from)")
         if off is not None and off.device in ("cpu", "nvme"):
             if optimizer is not None:
                 raise ValueError(
@@ -241,26 +253,16 @@ class DeepSpeedEngine:
                 self.param_shardings, self.compute_dtype,
                 device=off.device, nvme_path=off.nvme_path,
                 buffer_count=off.buffer_count,
-                aio_config=self.config.aio.model_dump())
-        # offload_param: TRANSIENT device params (reference: ZeRO-3 param
-        # offload keeps weights host-side and pages them in per use,
-        # partition_parameters.py) — HBM holds the weights only while a
-        # compiled step runs; they re-materialize from the host master
-        off_p = self.config.zero_optimization.offload_param
-        if off_p is not None and off_p.device == "nvme":
-            raise NotImplementedError(
-                "offload_param device='nvme' is not routed yet — params "
-                "re-materialize from the host-RAM masters (device='cpu'); "
-                "NVMe currently backs optimizer STATE via "
-                "offload_optimizer={'device': 'nvme'}")
+                aio_config=self.config.aio.model_dump(),
+                param_device=("nvme" if off_p is not None
+                              and off_p.device == "nvme" else "ram"),
+                param_nvme_path=(off_p.nvme_path if off_p is not None
+                                 else None),
+                param_buffer_count=(off_p.buffer_count if off_p is not None
+                                    else 5))
         self._transient_params = bool(
             self.offload is not None and off_p is not None
-            and off_p.device == "cpu")
-        if off_p is not None and off_p.device == "cpu" \
-                and self.offload is None:
-            raise ValueError(
-                "offload_param needs offload_optimizer (the host-resident "
-                "master the transient params re-materialize from)")
+            and off_p.device in ("cpu", "nvme"))
 
         # 1-bit explicit-collective mode --------------------------------------
         # onebit optimizers only save wire bytes if the grad sync is explicit:

@@ -74,6 +74,36 @@ class SwappedTensorPool:
         return view.reshape(self.shapes[i]).copy()
 
 
+def pipeline_pools(pools: Dict[str, "SwappedTensorPool"], n_leaves: int,
+                   compute_fn, write_back: bool = True) -> None:
+    """Shared read-ahead / compute / write-behind pipeline over named pools.
+
+    For each leaf j: views = {name: read(j)}; ``compute_fn(j, views)`` mutates
+    the buffer views in place; write-back of j overlaps compute of j+1, and
+    the read of j+1 is submitted before compute of j (reference:
+    pipelined_optimizer_swapper.py:279).
+    """
+    if n_leaves == 0:
+        return
+
+    def read(j):
+        return {k: p.read_async(j) for k, p in pools.items()}
+
+    views = read(0)
+    for j in range(n_leaves):
+        for p in pools.values():
+            p.wait()               # reads for j (and writes for j-1) done
+        cur = views
+        if j + 1 < n_leaves:
+            views = read(j + 1)
+        compute_fn(j, cur)
+        if write_back:
+            for k, p in pools.items():
+                p.write_async(j, cur[k])
+    for p in pools.values():
+        p.wait()
+
+
 class OptimizerStateSwapper:
     """NVMe-resident optimizer state, streamed leaf-by-leaf through a
     double-buffered read -> compute -> write-back pipeline.
@@ -99,33 +129,21 @@ class OptimizerStateSwapper:
         """For each leaf j: state = read(j); compute_fn(j, state) mutates the
         buffers in place; write-back(j). Reads of leaf j+1 and write-backs of
         leaf j overlap compute of leaf j via the shared aio thread pool."""
-        if self.n_leaves == 0:
-            return
-        views = {s: self.pools[s].read_async(0) for s in self.slot_names}
-        for j in range(self.n_leaves):
-            for s in self.slot_names:
-                self.pools[s].wait()   # reads for j (and writes for j-1) done
-            cur = views
-            if j + 1 < self.n_leaves:
-                views = {s: self.pools[s].read_async(j + 1)
-                         for s in self.slot_names}
-            compute_fn(j, cur)
-            for s in self.slot_names:
-                self.pools[s].write_async(j, cur[s])
-        for s in self.slot_names:
-            self.pools[s].wait()
+        pipeline_pools(self.pools, self.n_leaves, compute_fn)
 
     def read_leaf(self, j: int) -> Dict[str, np.ndarray]:
         return {s: self.pools[s].read_sync(j) for s in self.slot_names}
 
 
 class PartitionedParamSwapper:
-    """fp32 parameter partitions on NVMe (offload_param device=nvme).
+    """fp32 parameter partitions on NVMe — whole-set swap facade.
 
     reference: partitioned_param_swapper.py:35 AsyncPartitionedParameterSwapper
-    — here a thin facade over SwappedTensorPool keyed by leaf index, consumed
-    by the engine's transient-param mode (params are materialized on device
-    only for the duration of a step).
+    — a thin facade over SwappedTensorPool keyed by leaf index for paging a
+    full param set out/in at once.  The engine's offload_param=nvme tier
+    streams leaves through ``HostOffloadOptimizer``'s per-leaf pipeline
+    instead (zero/offload.py); this facade currently has no engine consumer
+    and is kept as the public whole-set API (+ its tests).
     """
 
     def __init__(self, nvme_path: str, leaf_shapes: Sequence[Tuple[int, ...]],

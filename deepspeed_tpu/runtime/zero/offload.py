@@ -35,7 +35,23 @@ import numpy as np
 from ...ops.cpu.adam import DeepSpeedCPUAdam, DeepSpeedCPUAdagrad
 from ...ops.cpu.aio import AsyncIOHandle
 from ...utils.logging import log_dist
-from ..swap_tensor import OptimizerStateSwapper
+from ..swap_tensor import OptimizerStateSwapper, pipeline_pools
+
+# live NVMe roots in this process: a second engine pointed at the same
+# nvme_path must not silently clobber the first one's swap files.  Claims are
+# released when the owner is garbage-collected, so engine re-initialization
+# loops (sweeps, notebooks) reuse slot 0 instead of growing -1, -2, ... dirs.
+_CLAIMED_ROOTS: Dict[str, set] = {}
+
+
+def _claim_root(root: str, owner: Any) -> str:
+    import weakref
+    key = os.path.realpath(root)
+    used = _CLAIMED_ROOTS.setdefault(key, set())
+    n = next(i for i in range(len(used) + 1) if i not in used)
+    used.add(n)
+    weakref.finalize(owner, used.discard, n)
+    return root if n == 0 else f"{root}-{n}"
 
 try:
     import ml_dtypes
@@ -76,22 +92,67 @@ class HostOffloadOptimizer:
                  device: str = "cpu",
                  nvme_path: Optional[str] = None,
                  buffer_count: int = 4,
-                 aio_config: Optional[Dict] = None):
+                 aio_config: Optional[Dict] = None,
+                 param_device: str = "ram",
+                 param_nvme_path: Optional[str] = None,
+                 param_buffer_count: int = 5):
         self.cpu_opt = _build_cpu_optimizer(opt_type, opt_params)
         self.compute_dtype = compute_dtype
         self.device = device
         leaves, self.treedef = jax.tree.flatten(params_f32)
         self.shard_leaves = self.treedef.flatten_up_to(param_shardings)
-        # host-resident fp32 master copy (reference: single_partition_of_fp32_groups
-        # pinned host tensors, stage_1_and_2.py:507)
-        self.master: List[np.ndarray] = [
-            np.ascontiguousarray(np.asarray(p, np.float32)) for p in leaves]
-        self.shapes = [m.shape for m in self.master]
-        # staging holds a bf16 mirror of master at all times (the step kernel
-        # overwrites it in-pass), so current_params_device is valid pre-step
-        self._bf16_staging = [
-            m.astype(_BF16) if _BF16 is not None else None
-            for m in self.master]
+        self.shapes = [tuple(np.shape(p)) for p in leaves]
+        self.n_leaves = len(leaves)
+
+        aio_config = aio_config or {}
+
+        def _make_aio():
+            return AsyncIOHandle(
+                block_size=aio_config.get("block_size", 1 << 20),
+                queue_depth=aio_config.get("queue_depth", 8),
+                thread_count=aio_config.get("thread_count", 4))
+
+        # fp32 master copy: host RAM (reference:
+        # single_partition_of_fp32_groups pinned host tensors,
+        # stage_1_and_2.py:507), or the ZeRO-Infinity NVMe param tier
+        # (partitioned_param_swapper.py:35) — masters live one-file-per-leaf
+        # and stream through the step's double-buffered pipeline, so
+        # steady-state host RAM is O(buffers), not O(model).
+        from ..swap_tensor import SwappedTensorPool
+        self.param_pool: Optional[SwappedTensorPool] = None
+        self.master: Optional[List[np.ndarray]] = None
+        if param_device == "nvme":
+            root = param_nvme_path or nvme_path
+            if not root:
+                raise ValueError("offload_param device=nvme needs nvme_path")
+            self.param_pool = SwappedTensorPool(
+                _claim_root(os.path.join(root, "zero_offload_params"), self),
+                [f"leaf{j}" for j in range(self.n_leaves)],
+                self.shapes, np.float32, aio=_make_aio(),
+                buffer_count=max(param_buffer_count, 3),
+                initialize_zero=False)
+            # chunked seeding: the aio handle holds a ref to each staged
+            # copy until wait(), so an unbounded burst would pin ~model-size
+            # host RAM — the thing this tier exists to avoid
+            for j, p in enumerate(leaves):
+                self.param_pool.write_async(
+                    j, np.ascontiguousarray(np.asarray(p, np.float32)))
+                if (j + 1) % 8 == 0:
+                    self.param_pool.wait()
+            self.param_pool.wait()
+            # no RAM mirror of any kind in the NVMe-param tier
+            self._bf16_staging = [None] * self.n_leaves
+            log_dist(f"ZeRO-Infinity: fp32 master params on NVMe at {root} "
+                     f"({self.n_leaves} partitions)", ranks=[0])
+        else:
+            self.master = [np.ascontiguousarray(np.asarray(p, np.float32))
+                           for p in leaves]
+            # staging holds a bf16 mirror of master at all times (the step
+            # kernel overwrites it in-pass), so current_params_device is
+            # valid pre-step
+            self._bf16_staging = [
+                m.astype(_BF16) if _BF16 is not None else None
+                for m in self.master]
 
         self.swapper: Optional[OptimizerStateSwapper] = None
         self.state: Optional[List[Dict[str, np.ndarray]]] = None
@@ -100,25 +161,26 @@ class HostOffloadOptimizer:
         if device == "nvme":
             if not nvme_path:
                 raise ValueError("offload_optimizer device=nvme needs nvme_path")
-            aio_config = aio_config or {}
-            aio = AsyncIOHandle(
-                block_size=aio_config.get("block_size", 1 << 20),
-                queue_depth=aio_config.get("queue_depth", 8),
-                thread_count=aio_config.get("thread_count", 4))
             self.swapper = OptimizerStateSwapper(
-                os.path.join(nvme_path, "zero_offload_opt"), slot_names,
-                self.shapes, aio=aio, buffer_count=buffer_count)
+                _claim_root(os.path.join(nvme_path, "zero_offload_opt"), self),
+                slot_names,
+                self.shapes, aio=_make_aio(), buffer_count=buffer_count)
             log_dist(f"ZeRO-Offload: optimizer state on NVMe at {nvme_path} "
-                     f"({len(self.master)} partitions x {slot_names})", ranks=[0])
+                     f"({self.n_leaves} partitions x {slot_names})", ranks=[0])
         else:
-            self.state = [self.cpu_opt.init_state(m) for m in self.master]
+            self.state = [self.cpu_opt.init_state(
+                np.zeros(int(np.prod(s)), np.float32).reshape(s))
+                for s in self.shapes]
             log_dist(f"ZeRO-Offload: optimizer state in host RAM "
-                     f"({len(self.master)} partitions x {slot_names})", ranks=[0])
+                     f"({self.n_leaves} partitions x {slot_names})", ranks=[0])
 
     # -- helpers ---------------------------------------------------------------
 
     def _put_param(self, j: int) -> jax.Array:
-        """Updated master -> device, in compute dtype, on the param sharding."""
+        """RAM master -> device, in compute dtype, on the param sharding.
+        (NVMe-master materialization goes through the pipelined
+        current_params_device/apply paths, never through here.)"""
+        assert self.param_pool is None
         sharding = self.shard_leaves[j]
         if self.compute_dtype == jax.numpy.bfloat16 and self._bf16_staging[j] is not None:
             return jax.device_put(self._bf16_staging[j], sharding)
@@ -126,10 +188,29 @@ class HostOffloadOptimizer:
         host = self.master[j] if dt == np.float32 else self.master[j].astype(dt)
         return jax.device_put(host, sharding)
 
+    def _put_from_host(self, j: int, host: np.ndarray) -> jax.Array:
+        """device_put a master leaf from a (possibly reused) host buffer:
+        always hand device_put an owning copy — on CPU backends device_put
+        can alias numpy memory, and the pool buffer is about to be reused."""
+        arr = np.asarray(host).reshape(self.shapes[j])
+        if self.compute_dtype == jax.numpy.bfloat16 and _BF16 is not None:
+            arr = arr.astype(_BF16)          # astype copies
+        elif np.dtype(self.compute_dtype) != arr.dtype:
+            arr = arr.astype(np.dtype(self.compute_dtype))
+        else:
+            arr = arr.copy()
+        return jax.device_put(arr, self.shard_leaves[j])
+
     def _bf16_out(self, j: int) -> Optional[np.ndarray]:
         if self.compute_dtype == jax.numpy.bfloat16:
             return self._bf16_staging[j]
         return None
+
+    def _master_host(self, j: int) -> np.ndarray:
+        """The fp32 master leaf as a host array (owning copy for pool reads)."""
+        if self.param_pool is not None:
+            return self.param_pool.read_sync(j).reshape(self.shapes[j])
+        return self.master[j]
 
     # -- the step ----------------------------------------------------------------
 
@@ -150,21 +231,10 @@ class HostOffloadOptimizer:
             if hasattr(g, "copy_to_host_async"):
                 g.copy_to_host_async()
 
-        new_leaves: List[Optional[jax.Array]] = [None] * len(self.master)
+        new_leaves: List[Optional[jax.Array]] = [None] * self.n_leaves
 
-        if self.swapper is not None:
-            def compute(j, state_views):
-                g = np.asarray(grad_leaves[j])
-                state = {s: v.reshape(-1) for s, v in state_views.items()}
-                self.cpu_opt.step(step_1based, self.master[j], g, state,
-                                  lr=lr, grad_scale=grad_scale,
-                                  bf16_out=self._bf16_out(j))
-                if materialize:
-                    new_leaves[j] = self._put_param(j)
-
-            self.swapper.pipeline(compute)
-        else:
-            for j in range(len(self.master)):
+        if self.swapper is None and self.param_pool is None:
+            for j in range(self.n_leaves):
                 g = np.asarray(grad_leaves[j])
                 self.cpu_opt.step(step_1based, self.master[j], g,
                                   self.state[j], lr=lr, grad_scale=grad_scale,
@@ -172,6 +242,38 @@ class HostOffloadOptimizer:
                 # async H2D: returns immediately, transfer overlaps next leaf
                 if materialize:
                     new_leaves[j] = self._put_param(j)
+            if not materialize:
+                return None
+            return self.treedef.unflatten(new_leaves)
+
+        # NVMe pipeline: per leaf j, read master and/or state (read of j+1
+        # prefetched before compute of j), step in-place in the buffers,
+        # write back behind compute (reference:
+        # pipelined_optimizer_swapper.py:279 read-ahead/write-behind).
+        pools = {}
+        if self.swapper is not None:
+            pools.update(self.swapper.pools)
+        if self.param_pool is not None:
+            assert "master" not in pools
+            pools["master"] = self.param_pool
+
+        def compute(j, views):
+            master = (views["master"] if self.param_pool is not None
+                      else self.master[j])
+            state = ({s: views[s].reshape(-1) for s in self.slot_names}
+                     if self.swapper is not None else self.state[j])
+            g = np.asarray(grad_leaves[j])
+            self.cpu_opt.step(step_1based, master, g, state,
+                              lr=lr, grad_scale=grad_scale,
+                              bf16_out=self._bf16_out(j))
+            if materialize:
+                # _put_from_host copies out of the pool buffer, so the
+                # in-flight write-back and later buffer reuse are safe
+                new_leaves[j] = (self._put_from_host(j, master)
+                                 if self.param_pool is not None
+                                 else self._put_param(j))
+
+        pipeline_pools(pools, self.n_leaves, compute)
 
         if not materialize:
             return None
@@ -181,26 +283,35 @@ class HostOffloadOptimizer:
 
     def state_dict(self) -> Dict[str, Any]:
         if self.swapper is not None:
-            state = [self.swapper.read_leaf(j) for j in range(len(self.master))]
+            state = [self.swapper.read_leaf(j) for j in range(self.n_leaves)]
             state = [{s: v.reshape(self.shapes[j]) for s, v in st.items()}
                      for j, st in enumerate(state)]
         else:
             state = self.state
-        return {"master": self.treedef.unflatten(self.master),
+        master = [self._master_host(j) for j in range(self.n_leaves)]
+        return {"master": self.treedef.unflatten(master),
                 "state": {s: self.treedef.unflatten([st[s].reshape(self.shapes[j])
                                                      for j, st in enumerate(state)])
                           for s in self.slot_names}}
 
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
-        self.master = [np.ascontiguousarray(np.asarray(m, np.float32))
-                       for m in self.treedef.flatten_up_to(sd["master"])]
-        self._bf16_staging = [
-            m.astype(_BF16) if _BF16 is not None else None
-            for m in self.master]
+        master = [np.ascontiguousarray(np.asarray(m, np.float32))
+                  for m in self.treedef.flatten_up_to(sd["master"])]
+        if self.param_pool is not None:
+            for j, m in enumerate(master):
+                self.param_pool.write_async(j, m)
+                if (j + 1) % 8 == 0:
+                    self.param_pool.wait()
+            self.param_pool.wait()
+        else:
+            self.master = master
+            self._bf16_staging = [
+                m.astype(_BF16) if _BF16 is not None else None
+                for m in self.master]
         per_slot = {s: self.treedef.flatten_up_to(sd["state"][s])
                     for s in self.slot_names}
         state = [{s: np.asarray(per_slot[s][j], np.float32)
-                  for s in self.slot_names} for j in range(len(self.master))]
+                  for s in self.slot_names} for j in range(self.n_leaves)]
         if self.swapper is not None:
             for j, st in enumerate(state):
                 for s in self.slot_names:
@@ -211,16 +322,33 @@ class HostOffloadOptimizer:
             self.state = state
 
     def current_params_device(self) -> PyTree:
+        if self.param_pool is not None:
+            # transient re-materialization runs every step: pipeline the
+            # NVMe reads (prefetch j+1 while device_put'ing j)
+            leaves: List[Optional[jax.Array]] = [None] * self.n_leaves
+
+            def compute(j, views):
+                leaves[j] = self._put_from_host(j, views["master"])
+
+            pipeline_pools({"master": self.param_pool}, self.n_leaves,
+                           compute, write_back=False)
+            return self.treedef.unflatten(leaves)
         return self.treedef.unflatten(
-            [self._put_param(j) for j in range(len(self.master))])
+            [self._put_param(j) for j in range(self.n_leaves)])
 
     def host_params(self) -> PyTree:
         """Compute-dtype params as HOST arrays (checkpoint/export paths in
         transient mode — no device round trip; the bf16 mirror is already
         maintained by the step kernel)."""
         leaves = []
-        for j in range(len(self.master)):
-            if (self.compute_dtype == jax.numpy.bfloat16
+        for j in range(self.n_leaves):
+            if self.param_pool is not None:
+                m = self._master_host(j)
+                leaves.append(m.astype(_BF16)
+                              if (self.compute_dtype == jax.numpy.bfloat16
+                                  and _BF16 is not None)
+                              else m.astype(np.dtype(self.compute_dtype)))
+            elif (self.compute_dtype == jax.numpy.bfloat16
                     and self._bf16_staging[j] is not None):
                 leaves.append(self._bf16_staging[j])
             else:

@@ -320,3 +320,104 @@ def test_on_device_init():
     with zero.OnDevice(enabled=False) as od:
         real = od.init(model.init, jax.random.PRNGKey(0), x)
     assert real["params"]["kernel"].dtype == jnp.float32
+
+
+def test_offload_param_nvme_tier(tmp_path):
+    """ZeRO-Infinity param tier: offload_param device=nvme puts the fp32
+    masters on disk (no host-RAM master list), streams them through the step
+    pipeline, and matches the cpu-offload run step for step (reference:
+    partitioned_param_swapper.py:35, wired at stage3.py:481)."""
+    def make(param_device, subdir="pnvme"):
+        config = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "gradient_clipping": 1.0,
+            "bf16": {"enabled": True},
+            "zero_optimization": {
+                "stage": 1,
+                "offload_optimizer": {"device": "cpu"},
+                "offload_param": {"device": param_device,
+                                  "nvme_path": str(tmp_path / subdir)}},
+            "seed": 42,
+        }
+        engine, *_ = ds.initialize(model=SimpleModel(), config=config,
+                                   example_batch=random_batch(8))
+        return engine
+
+    e_nvme = make("nvme")
+    assert e_nvme._transient_params
+    assert e_nvme.offload.master is None            # no RAM master list
+    assert e_nvme.offload.param_pool is not None
+    proot = tmp_path / "pnvme" / "zero_offload_params"
+    assert any(f.suffix == ".swp" for f in proot.iterdir())
+
+    e_cpu = make("cpu")
+    l_nvme = [float(e_nvme.train_batch(random_batch(8, seed=i))["loss"])
+              for i in range(10)]
+    l_cpu = [float(e_cpu.train_batch(random_batch(8, seed=i))["loss"])
+             for i in range(10)]
+    np.testing.assert_allclose(l_nvme, l_cpu, rtol=1e-5)
+
+    # eval materializes transiently from NVMe
+    assert np.isfinite(float(e_nvme.eval_batch(random_batch(8))))
+
+    # checkpoint round-trips through the NVMe masters — a distinct nvme_path
+    # so e2 cannot accidentally read e_nvme's swap files
+    e_nvme.save_checkpoint(str(tmp_path / "ck"))
+    e2 = make("nvme", subdir="pnvme2")
+    e2.load_checkpoint(str(tmp_path / "ck"))
+    b = random_batch(8, seed=77)
+    np.testing.assert_allclose(float(e_nvme.eval_batch(b)),
+                               float(e2.eval_batch(b)), rtol=1e-5)
+
+
+def test_offload_param_nvme_and_opt_nvme(tmp_path):
+    """Params AND optimizer state both on NVMe — the full ZeRO-Infinity
+    storage tier; host RAM holds only the streaming buffers."""
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 1,
+            "offload_optimizer": {"device": "nvme",
+                                  "nvme_path": str(tmp_path / "nv")},
+            "offload_param": {"device": "nvme"}},   # nvme_path falls back
+        "seed": 42,
+    }
+    engine, *_ = ds.initialize(model=SimpleModel(), config=config,
+                               example_batch=random_batch(8))
+    assert engine.offload.master is None and engine.offload.state is None
+    losses = [float(engine.train_batch(random_batch(8, seed=i))["loss"])
+              for i in range(20)]
+    assert np.mean(losses[-6:]) < np.mean(losses[:3])
+    assert (tmp_path / "nv" / "zero_offload_params").is_dir()
+    assert (tmp_path / "nv" / "zero_offload_opt" / "exp_avg").is_dir()
+
+
+def test_nvme_root_collision_namespacing(tmp_path):
+    """Two live engines pointed at the same nvme_path must not clobber each
+    other's swap files: the second instance claims a suffixed directory."""
+    def make():
+        config = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {
+                "stage": 1,
+                "offload_optimizer": {"device": "cpu"},
+                "offload_param": {"device": "nvme",
+                                  "nvme_path": str(tmp_path / "shared")}},
+            "seed": 42,
+        }
+        engine, *_ = ds.initialize(model=SimpleModel(), config=config,
+                                   example_batch=random_batch(8))
+        return engine
+
+    e1 = make()
+    before = [np.array(m) for m in
+              (e1.offload._master_host(j) for j in range(e1.offload.n_leaves))]
+    e2 = make()                       # same nvme_path: must not overwrite e1
+    assert e1.offload.param_pool.root != e2.offload.param_pool.root
+    after = [e1.offload._master_host(j) for j in range(e1.offload.n_leaves)]
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(a, b)
