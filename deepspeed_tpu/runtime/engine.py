@@ -280,14 +280,12 @@ class DeepSpeedEngine:
                     raise ValueError(
                         f"1-bit optimizers support pure data parallelism; "
                         f"mesh axis '{ax}' has size {self.mesh_mgr.shape[ax]}")
-            if stage != 0:
-                raise ValueError("1-bit optimizers are incompatible with "
-                                 "ZeRO (reference: onebit docs); set stage 0")
-            if self.loss_scaler.enabled:
+            if stage > 1:
                 raise ValueError(
-                    "1-bit optimizers run without fp16 loss scaling (the "
-                    "compressed exchange has no overflow-skip and the runner "
-                    "computes unscaled grads) — use bf16 or fp32")
+                    "1-bit optimizers compose with ZeRO stage 0 or 1 "
+                    "(optimizer-state sharding); stages >= 2 shard GRADS, "
+                    "which defeats the stacked per-rank layout the "
+                    "compressed momentum exchange is built on")
             if self.compression_spec is not None:
                 raise ValueError(
                     "compression_training is not threaded through the 1-bit "
@@ -299,7 +297,9 @@ class DeepSpeedEngine:
                 self.apply_fn, self.loss_fn,
                 self.config.gradient_accumulation_steps,
                 compute_dtype=self.compute_dtype,
-                grad_clip=self.config.gradient_clipping)
+                grad_clip=self.config.gradient_clipping,
+                loss_scaler=self.loss_scaler,
+                zero_stage=stage)
 
         # device placement of state -----------------------------------------
         # fp32 training: params ARE the master copy — TrainState.master is kept
@@ -795,15 +795,22 @@ class DeepSpeedEngine:
                 lr = float(jax.device_get(self.lr_fn(self.state.step)))
             else:
                 lr = float(jax.device_get(self._current_lr()))
-            new_p, new_s, loss, norm = self.onebit.step(
+            new_p, new_s, loss, norm, overflow, new_scale = self.onebit.step(
                 self.state.params, self.state.opt_state["onebit"], micros,
-                self.next_rng(), lr, self.global_steps)
+                self.next_rng(), lr, self.global_steps,
+                scale_state=self.state.scale)
+            overflowed = bool(jax.device_get(overflow))
+            # overflow does not advance the optimizer step (matches the fused
+            # path's step + 1 - overflow convention)
             self.state = self.state.replace(
-                step=self.state.step + 1, params=new_p,
-                opt_state={"onebit": new_s})
+                step=self.state.step + 1 - int(overflowed), params=new_p,
+                opt_state={"onebit": new_s}, scale=new_scale,
+                skipped_steps=self.state.skipped_steps + int(overflowed))
+            if overflowed:
+                self.skipped_steps += 1
             metrics = {"loss": loss, "lr": lr, "grad_norm": norm,
-                       "overflow": False,
-                       "loss_scale": float(self.loss_scaler.initial_scale)}
+                       "overflow": overflowed,
+                       "loss_scale": float(jax.device_get(new_scale.scale))}
         elif self.offload is not None:
             grads_sum, loss, raw_norm, overflow = self._grads_step(
                 self._params_device(), self.state.scale, micros,

@@ -21,6 +21,14 @@ class LossScaleState(NamedTuple):
     good_steps: jnp.ndarray     # i32 scalar — consecutive overflow-free steps
     hysteresis: jnp.ndarray     # i32 scalar — remaining tolerated overflows
 
+    @classmethod
+    def identity(cls) -> "LossScaleState":
+        """Scale 1.0 — the no-scaling placeholder threaded through steps
+        whose scaler is disabled."""
+        return cls(scale=jnp.asarray(1.0, jnp.float32),
+                   good_steps=jnp.asarray(0, jnp.int32),
+                   hysteresis=jnp.asarray(1, jnp.int32))
+
 
 class LossScaler:
     """Unified static/dynamic scaler. static = dynamic with growth disabled."""

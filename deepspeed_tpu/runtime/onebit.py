@@ -17,9 +17,15 @@ programs switched host-side at freeze_step (a static branch — no dead
 collectives in either HLO, which also makes the wire-byte accounting in
 tests/test_onebit.py auditable from the compiled module).
 
-Restrictions (same envelope as the reference optimizer, which is incompatible
-with ZeRO>0 and fp16 dynamic loss scaling): pure DP mesh, ZeRO stage 0,
-static or no loss scale.
+Composition envelope: pure DP mesh.  fp16 loss scaling composes (the
+reference default — onebit/adam.py:11 runs under FP16_Optimizer): the scale
+rides into the local grad stage, overflow is detected on the global norm and
+the whole update (including the compressed exchange) is skipped under
+``lax.cond`` while the scale state adjusts.  ZeRO stage 1 composes: the
+optimizer state (m/v and friends) is sharded leaf-dim-0 across the DP axis —
+XLA turns the momentum update into reduce-scatter + sharded math + param
+all-gather, the standard ZeRO-1 wire pattern.  ZeRO>=2 stays out: sharding
+GRADS would defeat the stacked-per-rank layout the compressed exchange needs.
 """
 
 from __future__ import annotations
@@ -49,7 +55,9 @@ class OneBitRunner:
                  loss_fn: Callable,
                  gas: int,
                  compute_dtype=jnp.float32,
-                 grad_clip: float = 0.0):
+                 grad_clip: float = 0.0,
+                 loss_scaler=None,
+                 zero_stage: int = 0):
         self.kind = kind
         self.mesh = mesh
         self.axis = axis
@@ -59,6 +67,8 @@ class OneBitRunner:
         self.loss_fn = loss_fn
         self.compute_dtype = compute_dtype
         self.grad_clip = grad_clip
+        self.loss_scaler = loss_scaler          # LossScaler or None
+        self.zero_stage = int(zero_stage)
 
         h = dict(hyper or {})
         self.lr = float(h.pop("lr", 1e-3))
@@ -79,13 +89,22 @@ class OneBitRunner:
 
     # -- state ---------------------------------------------------------------
 
+    def _mv_sharding(self, p) -> NamedSharding:
+        """ZeRO-1: shard optimizer-state leaves dim-0 across DP where the
+        size divides (reference granularity: partition what fits evenly,
+        replicate the rest); stage 0 replicates everything."""
+        if self.zero_stage >= 1 and np.ndim(p) >= 1 \
+                and p.shape[0] % self.n == 0:
+            return NamedSharding(self.mesh, P(self.axis))
+        return NamedSharding(self.mesh, P())
+
     def init_state(self, params_f32: PyTree) -> Dict[str, PyTree]:
-        zeros = lambda: jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params_f32)
         rep = NamedSharding(self.mesh, P())
         sh = NamedSharding(self.mesh, P(self.axis))
-        state = {"m": jax.device_put(zeros(), rep),
-                 "v": jax.device_put(zeros(), rep)}
+        mv = lambda: jax.tree.map(
+            lambda p: jax.device_put(jnp.zeros(p.shape, jnp.float32),
+                                     self._mv_sharding(p)), params_f32)
+        state = {"m": mv(), "v": mv()}
         state["w_err"] = jax.tree.map(
             lambda p: jax.device_put(jnp.zeros((self.n, p.size), jnp.float32), sh),
             params_f32)
@@ -94,7 +113,7 @@ class OneBitRunner:
                 jnp.zeros((self.n, chunk_elems(p.size, self.n)), jnp.float32), sh),
             params_f32)
         if self.kind == "lamb":
-            state["v_fresh"] = jax.device_put(zeros(), rep)
+            state["v_fresh"] = mv()
             scalar = lambda val: jax.tree.map(
                 lambda p: jnp.asarray(val, jnp.float32), params_f32)
             state["coeff_freeze"] = jax.device_put(scalar(0.0), rep)
@@ -103,12 +122,16 @@ class OneBitRunner:
 
     # -- the per-rank grad stage ---------------------------------------------
 
-    def _local_grads(self, params, micros, rng):
+    def _local_grads(self, params, micros, rng, scale):
         """shard_map over the DP axis: grads stacked [n, ...] (dim0 sharded),
-        NO cross-rank reduction — the whole point of the explicit mode."""
+        NO cross-rank reduction — the whole point of the explicit mode.
+        ``scale`` is the fp16 loss scale (1.0 when scaling is off): the loss
+        is scaled inside the backward and the stacked grads come out
+        UNSCALED (divided back out with the gas normalization), so inf/nan
+        from a genuine fp16 overflow still propagates for detection."""
         gas = self.gas
 
-        def local(params, micros_l, rng):
+        def local(params, micros_l, rng, scale):
             r = jax.random.fold_in(rng, lax.axis_index(self.axis))
             rngs = jax.random.split(r, gas)
 
@@ -119,7 +142,9 @@ class OneBitRunner:
 
                 def lossf(p):
                     out = self.apply_fn(p, micro, rr, True)
-                    return self.loss_fn(out, micro)
+                    # scale in f32: casting the scale itself to fp16 turns
+                    # 2^16 into inf and every step would spuriously overflow
+                    return self.loss_fn(out, micro).astype(jnp.float32) * scale
 
                 l, g = jax.value_and_grad(lossf)(cparams)
                 return jax.tree.map(
@@ -128,27 +153,36 @@ class OneBitRunner:
             zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
                                 params)
             gsum, losses = lax.scan(body, zero, (micros_l, rngs))
-            g = jax.tree.map(lambda x: x[None] / gas, gsum)
+            g = jax.tree.map(lambda x: x[None] / (gas * scale), gsum)
             sq = sum(jnp.sum(jnp.square(x)) for x in jax.tree.leaves(g))
-            return g, jnp.mean(losses)[None], sq[None]
+            return g, (jnp.mean(losses) / scale)[None], sq[None]
 
         mapped = jax.shard_map(
             local, mesh=self.mesh,
-            in_specs=(P(), P(None, self.axis), P()),
+            in_specs=(P(), P(None, self.axis), P(), P()),
             out_specs=(P(self.axis), P(self.axis), P(self.axis)),
             axis_names={self.axis}, check_vma=False)
-        grads_st, loss_st, sq_st = mapped(params, micros, rng)
+        grads_st, loss_st, sq_st = mapped(params, micros, rng, scale)
         return grads_st, jnp.mean(loss_st), sq_st
 
     # -- update math ---------------------------------------------------------
 
+    def _mv_constrain(self, tree):
+        """Pin optimizer-state outputs to their ZeRO-1 shardings so donation
+        round-trips don't let XLA drift them to replicated."""
+        if self.zero_stage < 1:
+            return tree
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, self._mv_sharding(x)), tree)
+
     def _warm_update(self, params, state, grads_st, lr):
         b1, b2 = self.betas
         g_mean = jax.tree.map(lambda g: jnp.mean(g, 0), grads_st)  # psum here
-        new_m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
-                             state["m"], g_mean)
-        new_v = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g,
-                             state["v"], g_mean)
+        new_m = self._mv_constrain(jax.tree.map(
+            lambda m, g: b1 * m + (1 - b1) * g, state["m"], g_mean))
+        new_v = self._mv_constrain(jax.tree.map(
+            lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], g_mean))
         out = dict(state, m=new_m, v=new_v)
         if self.kind == "adam":
             # reference OnebitAdam applies NO bias correction (onebit/adam.py)
@@ -177,7 +211,14 @@ class OneBitRunner:
 
     def _frozen_update(self, params, state, grads_st, lr):
         """Compression stage: the ONLY cross-rank traffic per leaf is the
-        1-bit momentum exchange (+ f32 scales)."""
+        1-bit momentum exchange (+ f32 scales).
+
+        ZeRO-1 note: the error-feedback exchange needs the FULL momentum on
+        every rank (m_locals = b1*m + (1-b1)*g_local), so after the freeze m
+        lives replicated — one all-gather at the transition, none after.
+        The variance v (frozen, read-only here) and lamb's v_fresh keep
+        their ZeRO-1 shardings, so the state-memory saving persists on the
+        v-side leaves."""
         b1, b2 = self.betas
         flat_p, treedef = jax.tree.flatten(params)
         m_l = treedef.flatten_up_to(state["m"])
@@ -224,15 +265,20 @@ class OneBitRunner:
                    w_err=treedef.unflatten(new_we),
                    s_err=treedef.unflatten(new_se))
         if self.kind == "lamb":
-            out["v_fresh"] = treedef.unflatten(new_vf)
+            out["v_fresh"] = self._mv_constrain(treedef.unflatten(new_vf))
             out["last_factor"] = treedef.unflatten(new_lf)
         return treedef.unflatten(new_p), out
 
     # -- compiled steps -------------------------------------------------------
 
     def _build(self, frozen: bool):
-        def step(params, state, micros, rng, lr):
-            grads_st, loss, sq_st = self._local_grads(params, micros, rng)
+        scaling = self.loss_scaler is not None and self.loss_scaler.enabled
+
+        def step(params, state, micros, rng, lr, scale_state):
+            scale = (scale_state.scale if scaling
+                     else jnp.asarray(1.0, jnp.float32))
+            grads_st, loss, sq_st = self._local_grads(params, micros, rng,
+                                                      scale)
             # norm: in the compression stage, avoid the full f32 allreduce the
             # exact global norm would cost (it would dwarf the 1-bit savings)
             # — use sqrt(mean of per-rank ||g_local||^2), a scalar psum. The
@@ -246,16 +292,54 @@ class OneBitRunner:
             if self.grad_clip > 0:
                 coef = jnp.minimum(self.grad_clip / (norm + 1e-6), 1.0)
                 grads_st = jax.tree.map(lambda g: g * coef, grads_st)
-            if frozen:
-                new_p, new_s = self._frozen_update(params, state, grads_st, lr)
+
+            def do_update(args):
+                params, state, grads_st = args
+                if frozen:
+                    new_p, new_s = self._frozen_update(params, state,
+                                                       grads_st, lr)
+                else:
+                    new_p, new_s = self._warm_update(params, state,
+                                                     grads_st, lr)
+                # ZeRO-1 sharded m/v make the raw update come out sharded;
+                # params stay replicated (the all-gather IS the ZeRO-1 wire
+                # pattern)
+                if self.zero_stage >= 1:
+                    rep = NamedSharding(self.mesh, P())
+                    new_p = jax.lax.with_sharding_constraint(new_p, rep)
+                return new_p, new_s
+
+            if scaling:
+                # fp16 overflow: skip the WHOLE update (momentum, compressed
+                # exchange, params) and let the scaler state react — the
+                # reference's FP16_Optimizer skip path (onebit runs under it,
+                # onebit/adam.py:11)
+                overflow = ~jnp.isfinite(norm)
+                new_p, new_s = lax.cond(
+                    overflow, lambda a: (a[0], a[1]), do_update,
+                    (params, state, grads_st))
+                new_scale_state = self.loss_scaler.update(scale_state,
+                                                          overflow)
             else:
-                new_p, new_s = self._warm_update(params, state, grads_st, lr)
-            return new_p, new_s, loss, norm
+                overflow = jnp.asarray(False)
+                new_p, new_s = do_update((params, state, grads_st))
+                new_scale_state = scale_state
+            return new_p, new_s, loss, norm, overflow, new_scale_state
 
         return jax.jit(step, donate_argnums=(0, 1))
 
-    def step(self, params, state, micros, rng, lr, global_step: int
-             ) -> Tuple[PyTree, Dict, jnp.ndarray, jnp.ndarray]:
+    def step(self, params, state, micros, rng, lr, global_step: int,
+             scale_state=None
+             ) -> Tuple[PyTree, Dict, jnp.ndarray, jnp.ndarray,
+                        jnp.ndarray, Any]:
+        from .loss_scaler import LossScaleState
+        if scale_state is None:
+            # with an enabled scaler the caller must not silently train at
+            # scale 1.0 — start from the scaler's own initial state
+            scale_state = (self.loss_scaler.init()
+                           if self.loss_scaler is not None
+                           and self.loss_scaler.enabled
+                           else LossScaleState.identity())
         frozen = global_step >= self.freeze_step
         if frozen:
             if self._step_frozen is None:
@@ -266,7 +350,7 @@ class OneBitRunner:
                 self._step_warm = self._build(False)
             fn = self._step_warm
         return fn(params, state, micros, rng,
-                  jnp.asarray(lr, jnp.float32))
+                  jnp.asarray(lr, jnp.float32), scale_state)
 
     # -- auditability ---------------------------------------------------------
 
@@ -275,9 +359,13 @@ class OneBitRunner:
         """Total bytes moved by cross-replica collectives in one compiled
         step — parsed from the optimized HLO, so the 1/32 wire claim is a
         measured property, not a docstring."""
+        from .loss_scaler import LossScaleState
         fn = self._build(frozen)
-        lowered = jax.jit(lambda p, s, mi, r, lr: fn(p, s, mi, r, lr)).lower(
-            params, state, micros, rng, jnp.asarray(self.lr, jnp.float32))
+        scale_state = LossScaleState.identity()
+        lowered = jax.jit(
+            lambda p, s, mi, r, lr, ss: fn(p, s, mi, r, lr, ss)).lower(
+            params, state, micros, rng, jnp.asarray(self.lr, jnp.float32),
+            scale_state)
         txt = lowered.compile().as_text()
         return hlo_collective_bytes(txt)
 

@@ -106,9 +106,11 @@ def test_onebit_wire_bytes_compressed():
     runner = engine.onebit
 
     def bytes_for(frozen):
+        from deepspeed_tpu.runtime.loss_scaler import LossScaleState
         fn = runner._build(frozen)
         lowered = fn.lower(params, state, micros, rng,
-                           jnp.asarray(1e-2, jnp.float32))
+                           jnp.asarray(1e-2, jnp.float32),
+                           LossScaleState.identity())
         return hlo_collective_bytes(lowered.compile().as_text())
 
     warm = bytes_for(False)
@@ -220,3 +222,77 @@ def test_hierarchical_quantized_allreduce():
     server_step = np.abs(want).max() / 127.0
     np.testing.assert_allclose(np.asarray(out), want,
                                atol=2 * server_step + 1e-6)
+
+
+def test_onebit_fp16_loss_scaling_composes():
+    """onebit + fp16 dynamic loss scaling (the reference default envelope:
+    onebit/adam.py:11 runs under FP16_Optimizer): trains through the freeze
+    transition, and an overflow batch skips the step and halves the scale."""
+    cfg = _onebit_config("OneBitAdam", freeze_step=12, lr=2e-3)
+    cfg["fp16"] = {"enabled": True, "initial_scale_power": 8, "hysteresis": 1}
+    engine, *_ = ds.initialize(model=SimpleModel(),
+                               example_batch=random_batch(16), config=cfg)
+    assert engine.onebit is not None and engine.onebit.loss_scaler.enabled
+    losses = [float(engine.train_batch(random_batch(16, seed=i))["loss"])
+              for i in range(20)]
+    assert engine.onebit._step_frozen is not None   # compressed stage ran
+    assert np.mean(losses[-4:]) < losses[0]
+
+    # overflow: huge inputs blow up the fp16 backward
+    scale_before = float(jax.device_get(engine.state.scale.scale))
+    p_before = jax.tree.map(np.asarray, jax.device_get(engine.state.params))
+    bad = random_batch(16, seed=99)
+    bad["x"] = (bad["x"] * 1e30).astype(np.float32)
+    m = engine.train_batch(bad)
+    assert m["overflow"] is True
+    assert m["loss_scale"] <= scale_before / 2
+    p_after = jax.tree.map(np.asarray, jax.device_get(engine.state.params))
+    for a, b in zip(jax.tree.leaves(p_before), jax.tree.leaves(p_after)):
+        np.testing.assert_array_equal(a, b)   # step skipped: params untouched
+
+    # recovery: training continues after the skip
+    m2 = engine.train_batch(random_batch(16, seed=100))
+    assert np.isfinite(float(m2["loss"])) and m2["overflow"] is False
+
+
+def test_onebit_zero1_composes():
+    """onebit + ZeRO-1: optimizer state leaves whose dim0 divides the DP
+    world are sharded across it (memory /8 on the big leaves), and the math
+    is unchanged — losses track the stage-0 run step for step."""
+    from jax.sharding import PartitionSpec as P
+
+    cfg0 = _onebit_config("OneBitAdam", freeze_step=5)
+    cfg1 = _onebit_config("OneBitAdam", freeze_step=5)
+    cfg1["zero_optimization"] = {"stage": 1}
+    e0, *_ = ds.initialize(model=SimpleModel(),
+                           example_batch=random_batch(16), config=cfg0)
+    e1, *_ = ds.initialize(model=SimpleModel(),
+                           example_batch=random_batch(16), config=cfg1)
+    assert e1.onebit is not None and e1.onebit.zero_stage == 1
+
+    # m/v leaves with divisible dim0 carry the DP axis in their sharding
+    mv = e1.state.opt_state["onebit"]["m"]
+    sharded = [l for l in jax.tree.leaves(mv)
+               if l.ndim >= 1 and l.shape[0] % 8 == 0]
+    assert sharded, "model has no dividable leaves to shard"
+    for l in sharded:
+        assert l.sharding.spec == P("data"), l.sharding
+    # ...and the replicated-fallback leaves stay replicated
+    for l in jax.tree.leaves(mv):
+        if l.ndim >= 1 and l.shape[0] % 8 != 0:
+            assert l.sharding.spec == P()
+
+    for i in range(12):
+        b = random_batch(16, seed=i)
+        l0 = float(e0.train_batch(b)["loss"])
+        l1 = float(e1.train_batch(b)["loss"])
+        assert abs(l0 - l1) < 5e-4, (i, l0, l1)
+
+    # after frozen steps the v-side leaves KEEP their ZeRO-1 sharding (m is
+    # replicated post-freeze by design: the error-feedback exchange needs
+    # the full momentum per rank)
+    assert e1.onebit._step_frozen is not None
+    v_after = e1.state.opt_state["onebit"]["v"]
+    for l in jax.tree.leaves(v_after):
+        if l.ndim >= 1 and l.shape[0] % 8 == 0:
+            assert l.sharding.spec == P("data"), l.sharding
