@@ -41,6 +41,24 @@ def _constrain(x, *spec):
         return x
 
 
+class _Gate(nn.Module):
+    """Router projection with the kernel pinned replicated.
+
+    Under ZeRO-3 the [H, E] kernel arrives sharded on its CONTRACTING dim;
+    left alone, GSPMD partitions the dot along H and reshards the token
+    activations to match — the "involuntary full rematerialization" on the
+    moe reshape. Gathering the (tiny) kernel whole instead keeps tokens on
+    their batch sharding. Param path stays gate/kernel (nn.Dense parity)."""
+    experts: int
+
+    @nn.compact
+    def __call__(self, x):
+        k = self.param("kernel", nn.initializers.lecun_normal(),
+                       (x.shape[-1], self.experts), jnp.float32)
+        k = _constrain(k, None, None)
+        return x @ k
+
+
 class ExpertMLP(nn.Module):
     """Default expert: the transformer MLP (fc -> gelu -> proj)."""
     hidden_size: int
@@ -86,9 +104,27 @@ class MoE(nn.Module):
         tokens = _constrain(tokens, ("data", "expert", "seq"), None)
         T = B * S
 
-        gate_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
-                               param_dtype=jnp.float32, name="gate")(
-                                   tokens.astype(jnp.float32))
+        # GShard data layout (reference: sharded_moe.py:89,439 — each rank
+        # gates its OWN token slice into a local-capacity queue, then the
+        # expert axis exchanges queues with an all-to-all): tokens regroup as
+        # [G, T/G, H] with G matching the token dim's mesh sharding, gating
+        # runs per group, and the [E, G*Cg, H] queue carries the expert axis
+        # on E and the data axes on the queue dim. Without the grouping the
+        # partitioner has no valid data-sharded queue layout and falls back
+        # to involuntary full rematerialization of the token tensor.
+        from ..parallel.mesh import get_global_mesh
+        mm = get_global_mesh()
+        G = 1
+        if mm is not None:
+            g = (mm.shape["data"] * mm.shape["expert"] * mm.shape["seq"])
+            if T % g == 0:
+                G = g
+        Tg = T // G
+
+        tokens_g = _constrain(tokens.reshape(G, Tg, H),
+                              ("data", "expert", "seq"), None, None)
+        gate_logits = _Gate(E, name="gate")(
+            tokens_g.astype(jnp.float32))                    # [G, Tg, E]
         # top-2 always wants an rng for the Gumbel-max second pick (reference
         # top2gating adds gumbel noise unconditionally in training); fall back
         # to noise-free gating when the caller supplied no "gating" rng stream
@@ -97,18 +133,33 @@ class MoE(nn.Module):
                and self.has_rng("gating")
                else None)
         cf = self.capacity_factor if train else self.eval_capacity_factor
-        C = compute_capacity(T, E, cf, self.k, self.min_capacity)
+        Cg = compute_capacity(Tg, E, cf, self.k, self.min_capacity)
         gating = top1_gating if self.k == 1 else top2_gating
         if self.k not in (1, 2):
             raise ValueError(f"k must be 1 or 2, got {self.k}")
-        aux, combine, dispatch, _ = gating(gate_logits, cf, self.min_capacity,
-                                           rng=rng, capacity=C)
+        if rng is None:
+            gate_one = lambda lg: gating(lg, cf, self.min_capacity,
+                                         rng=None, capacity=Cg)
+            aux, combine, dispatch, _ = jax.vmap(gate_one)(gate_logits)
+        else:
+            gate_one = lambda lg, r: gating(lg, cf, self.min_capacity,
+                                            rng=r, capacity=Cg)
+            aux, combine, dispatch, _ = jax.vmap(gate_one)(
+                gate_logits, jax.random.split(rng, G))
+        aux = jnp.mean(aux)
+        # combine/dispatch: [G, Tg, E, Cg] — group dim stays token-sharded
+        dispatch = _constrain(dispatch, ("data", "expert", "seq"),
+                              None, None, None)
 
-        # dispatch: [T,E,C] x [T,H] -> [E,C,H], then pin the queue to the
-        # expert axis so XLA exchanges tokens instead of replicating experts
-        dispatched = jnp.einsum("tec,th->ech", dispatch.astype(self.dtype),
-                                tokens.astype(self.dtype))
-        dispatched = _constrain(dispatched, "expert", None, None)
+        # per-group dispatch, then the queue exchange: [G,E,Cg,H] (group-
+        # sharded) -> [E, G*Cg, H] (expert-sharded E, data-sharded queue) is
+        # the all-to-all of the reference's _AllToAll (sharded_moe.py:89)
+        dispatched = jnp.einsum("gtec,gth->gech", dispatch.astype(self.dtype),
+                                tokens_g.astype(self.dtype))
+        dispatched = _constrain(dispatched, ("data", "expert", "seq"),
+                                None, None, None)
+        queues = dispatched.transpose(1, 0, 2, 3).reshape(E, G * Cg, H)
+        queues = _constrain(queues, "expert", ("data", "seq"), None)
 
         expert_factory = self.expert or (lambda: ExpertMLP(
             self.hidden_size, self.hidden_size * self.mlp_ratio,
@@ -120,12 +171,16 @@ class MoE(nn.Module):
             in_axes=0, out_axes=0,
             metadata_params={nn.PARTITION_NAME: "expert"},
         )
-        expert_out = vexpert(expert_factory(), dispatched)   # [E, C, H]
-        expert_out = _constrain(expert_out, "expert", None, None)
+        expert_out = vexpert(expert_factory(), queues)       # [E, G*Cg, H]
+        expert_out = _constrain(expert_out, "expert", ("data", "seq"), None)
 
-        y = jnp.einsum("tec,ech->th", combine.astype(self.dtype),
-                       expert_out.astype(self.dtype))
-        y = _constrain(y, ("data", "expert", "seq"), None)
+        # return exchange + per-group combine
+        out_g = _constrain(
+            expert_out.reshape(E, G, Cg, H).transpose(1, 0, 2, 3),
+            ("data", "expert", "seq"), None, None, None)
+        y = jnp.einsum("gtec,gech->gth", combine.astype(self.dtype),
+                       out_g.astype(self.dtype))
+        y = _constrain(y, ("data", "expert", "seq"), None, None)
         return y.reshape(B, S, H), aux.astype(jnp.float32)
 
 

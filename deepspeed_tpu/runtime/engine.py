@@ -166,7 +166,10 @@ class DeepSpeedEngine:
 
         # sharding policy ----------------------------------------------------
         stage = self.config.zero_optimization.stage
-        self.zero_policy = ZeroShardingPolicy(stage, self.mesh_mgr)
+        self.zero_policy = ZeroShardingPolicy(
+            stage, self.mesh_mgr,
+            param_persistence_threshold=(
+                self.config.zero_optimization.param_persistence_threshold))
         self.tp_specs = build_tp_specs(params_f32, sharding_rules)
         # expert params (path under an "experts" module, reference: MoE expert
         # groups carved from DP, utils/groups.py) shard ZeRO state over the
@@ -799,18 +802,17 @@ class DeepSpeedEngine:
                 self.state.params, self.state.opt_state["onebit"], micros,
                 self.next_rng(), lr, self.global_steps,
                 scale_state=self.state.scale)
-            overflowed = bool(jax.device_get(overflow))
-            # overflow does not advance the optimizer step (matches the fused
-            # path's step + 1 - overflow convention)
+            # bookkeeping stays on device (no host sync mid-dispatch), the
+            # fused path's step + 1 - overflow convention: overflow does not
+            # advance the optimizer step
+            ovf_i32 = overflow.astype(jnp.int32)
             self.state = self.state.replace(
-                step=self.state.step + 1 - int(overflowed), params=new_p,
+                step=self.state.step + 1 - ovf_i32, params=new_p,
                 opt_state={"onebit": new_s}, scale=new_scale,
-                skipped_steps=self.state.skipped_steps + int(overflowed))
-            if overflowed:
-                self.skipped_steps += 1
+                skipped_steps=self.state.skipped_steps + ovf_i32)
             metrics = {"loss": loss, "lr": lr, "grad_norm": norm,
-                       "overflow": overflowed,
-                       "loss_scale": float(jax.device_get(new_scale.scale))}
+                       "overflow": overflow,
+                       "loss_scale": new_scale.scale}
         elif self.offload is not None:
             grads_sum, loss, raw_norm, overflow = self._grads_step(
                 self._params_device(), self.state.scale, micros,

@@ -42,21 +42,30 @@ def _axes_size(mesh_shape: dict, axes: Tuple[str, ...]) -> int:
 def insert_zero_axes(shape: Tuple[int, ...],
                      tp_spec: Optional[P],
                      zero_axes: Tuple[str, ...],
-                     zero_size: int) -> P:
-    """Compose a TP PartitionSpec with ZeRO sharding on one additional dim."""
+                     zero_size: int,
+                     avoid_last: bool = False) -> P:
+    """Compose a TP PartitionSpec with ZeRO sharding on one additional dim.
+
+    ``avoid_last`` (compute-param specs only): sharding the LAST (feature)
+    dim of a >=2-D param propagates that sharding into every activation that
+    reads it — an embedding gather emits H-sharded activations and the SPMD
+    partitioner falls back to involuntary full rematerialization restoring
+    the batch layout.  Such params stay whole on the compute side (their
+    fp32 master / grad / optimizer shards, which have no activation
+    coupling, keep the last-dim sharding and carry the memory win)."""
     ndim = len(shape)
     base = list(tp_spec) if tp_spec is not None else []
     base = base[:ndim] + [None] * (ndim - len(base))
     if zero_size <= 1:
         return P(*base)
 
-    tp_sizes = [1] * ndim  # approximation: model axis size handled by divisibility below
-    # candidate dims: unclaimed by TP, divisible by zero_size; prefer the largest
-    candidates = [i for i in range(ndim) if base[i] is None and shape[i] % zero_size == 0
-                  and shape[i] > 0]
-    if not candidates:
+    free = [i for i in range(ndim)
+            if base[i] is None and shape[i] > 0 and shape[i] % zero_size == 0]
+    if avoid_last and ndim > 1:
+        free = [i for i in free if i != ndim - 1]
+    if not free:
         return P(*base)
-    dim = max(candidates, key=lambda i: shape[i])
+    dim = max(free, key=lambda i: shape[i])
     base[dim] = tuple(zero_axes) if len(zero_axes) > 1 else zero_axes[0]
     return P(*base)
 
@@ -64,7 +73,8 @@ def insert_zero_axes(shape: Tuple[int, ...],
 class ZeroShardingPolicy:
     """Maps (param path, shape, TP rule) -> shardings for each train-state element."""
 
-    def __init__(self, stage: int, mesh_mgr: MeshManager):
+    def __init__(self, stage: int, mesh_mgr: MeshManager,
+                 param_persistence_threshold: int = 0):
         if stage not in (0, 1, 2, 3):
             raise ValueError(f"invalid ZeRO stage {stage}")
         self.stage = stage
@@ -72,6 +82,10 @@ class ZeroShardingPolicy:
         self.mesh = mesh_mgr.mesh
         self._zero_size = _axes_size(mesh_mgr.shape, ZERO_AXES)
         self._expert_zero_size = _axes_size(mesh_mgr.shape, EXPERT_ZERO_AXES)
+        # reference: stage3_param_persistence_threshold (stage3.py persistent
+        # params) — compute-dtype params smaller than this stay whole; the
+        # fp32 master/grad/optimizer shards are unaffected
+        self.param_persistence_threshold = int(param_persistence_threshold)
 
     def _zero_axes_for(self, is_expert: bool) -> Tuple[Tuple[str, ...], int]:
         if is_expert:
@@ -81,11 +95,16 @@ class ZeroShardingPolicy:
     # -- specs ---------------------------------------------------------------
 
     def param_spec(self, shape, tp_spec: Optional[P] = None, is_expert: bool = False) -> P:
-        """Compute-dtype params: sharded only at stage 3."""
+        """Compute-dtype params: sharded only at stage 3; params under the
+        persistence threshold stay whole (reference:
+        stage3_param_persistence_threshold, stage3.py)."""
         if self.stage < 3:
             return tp_spec if tp_spec is not None else P()
+        if int(np.prod(shape) if shape else 1) < self.param_persistence_threshold:
+            return tp_spec if tp_spec is not None else P()
         axes, size = self._zero_axes_for(is_expert)
-        return insert_zero_axes(tuple(shape), tp_spec, axes, size)
+        return insert_zero_axes(tuple(shape), tp_spec, axes, size,
+                                avoid_last=True)
 
     def master_spec(self, shape, tp_spec: Optional[P] = None, is_expert: bool = False) -> P:
         """fp32 master params + optimizer state: sharded from stage 1 up."""
@@ -94,9 +113,17 @@ class ZeroShardingPolicy:
         axes, size = self._zero_axes_for(is_expert)
         return insert_zero_axes(tuple(shape), tp_spec, axes, size)
 
+    # grads smaller than this stay whole: sharding a 64-element layernorm
+    # grad saves nothing and couples an H-sharded reduction into the backward
+    # activations (the reference's analogue is reduce-scatter bucket
+    # granularity — tiny tensors ride whole in a bucket)
+    GRAD_SHARD_MIN_ELEMS = 8192
+
     def grad_spec(self, shape, tp_spec: Optional[P] = None, is_expert: bool = False) -> P:
         """Gradients: sharded from stage 2 up (constraint → XLA reduce-scatter)."""
         if self.stage < 2:
+            return tp_spec if tp_spec is not None else P()
+        if int(np.prod(shape) if shape else 1) < self.GRAD_SHARD_MIN_ELEMS:
             return tp_spec if tp_spec is not None else P()
         axes, size = self._zero_axes_for(is_expert)
         return insert_zero_axes(tuple(shape), tp_spec, axes, size)

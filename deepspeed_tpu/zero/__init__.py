@@ -57,7 +57,10 @@ class Init(contextlib.AbstractContextManager):
         if self.mesh_mgr is None:
             from ..parallel.mesh import build_mesh_from_config
             self.mesh_mgr = build_mesh_from_config(self.config)
-        policy = ZeroShardingPolicy(3, self.mesh_mgr)
+        policy = ZeroShardingPolicy(
+            3, self.mesh_mgr,
+            param_persistence_threshold=(
+                self.config.zero_optimization.param_persistence_threshold))
         shapes = jax.eval_shape(init_fn, *args, **kwargs)
         shardings = policy.tree_shardings(
             jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), shapes),

@@ -17,7 +17,10 @@ def make_engine(stage=0, precision="bf16", extra=None, tp=1):
         "train_batch_size": 32,
         "gradient_accumulation_steps": 2,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
-        "zero_optimization": {"stage": stage},
+        # threshold 0: at toy param sizes the reference-parity default (1e5)
+        # would keep every param persistent and stage 3 would shard nothing
+        "zero_optimization": {"stage": stage,
+                              "stage3_param_persistence_threshold": 0},
         "gradient_clipping": 1.0,
     }
     if precision == "bf16":
@@ -192,7 +195,8 @@ def test_zero_quantized_weights_qwz():
         return {
             "train_batch_size": 16,
             "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
-            "zero_optimization": {"stage": 3, "zero_quantized_weights": qw},
+            "zero_optimization": {"stage": 3, "zero_quantized_weights": qw,
+                                  "stage3_param_persistence_threshold": 0},
             "seed": 3,
         }
 
@@ -232,7 +236,8 @@ def test_zero_quantized_weights_composes_with_tp():
     shard_map marks the TP axes manual and leaves them shard-local)."""
     engine = make_engine(stage=3, tp=2,
                          extra={"zero_optimization": {
-                             "stage": 3, "zero_quantized_weights": True}})
+                             "stage": 3, "zero_quantized_weights": True,
+                             "stage3_param_persistence_threshold": 0}})
     losses = train_n(engine, n=10)
     assert losses[-1] < losses[0]
     import jax as _jax
