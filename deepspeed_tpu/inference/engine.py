@@ -25,6 +25,38 @@ from ..utils.partitioning import build_tp_specs
 from .config import DeepSpeedInferenceConfig, load_inference_config
 
 
+def quantize_weights_int8(params):
+    """Weight-only int8: per-output-channel symmetric quantization of the
+    matmul kernels (attention / MLP / experts / lm_head).  Embeddings,
+    layernorms, biases and the MoE router stay high precision.  Each
+    quantized leaf ``kernel`` gains a sibling ``kernel_scale`` such that
+    ``kernel.astype(f32) * kernel_scale`` reconstructs the weight within
+    scale/2 elementwise (the int8 error bound) — the capability slot of the
+    reference's int8 inference kernels (csrc/transformer/inference
+    ds_*_int8, pt_binding.cpp:1703-1779)."""
+
+    def walk(node, path=()):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for key, val in node.items():
+            if (key == "kernel" and hasattr(val, "ndim") and val.ndim >= 2
+                    and "gate" not in path):
+                w = jnp.asarray(val, jnp.float32)
+                scale = jnp.max(jnp.abs(w), axis=-2, keepdims=True) / 127.0
+                scale = jnp.where(scale == 0.0, 1.0, scale)
+                out["kernel"] = jnp.clip(jnp.round(w / scale),
+                                         -127, 127).astype(jnp.int8)
+                out["kernel_scale"] = scale
+            elif isinstance(val, dict):
+                out[key] = walk(val, path + (key,))
+            else:
+                out[key] = val
+        return out
+
+    return walk(params)
+
+
 class InferenceEngine:
     def __init__(self,
                  model=None,
@@ -40,6 +72,8 @@ class InferenceEngine:
         tp = self.config.tensor_parallel.tp_size
         self.mesh_mgr = mesh_manager or MeshManager(tp_size=tp)
         self.mesh = self.mesh_mgr.mesh
+        self.quantized = str(self.config.dtype) == "int8"
+        # int8 = weight-only quantization; activations compute in bf16
         self.dtype = {"float16": jnp.float16, "fp16": jnp.float16,
                       "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
                       "float32": jnp.float32, "fp32": jnp.float32,
@@ -58,16 +92,89 @@ class InferenceEngine:
             lambda spec: jax.sharding.NamedSharding(self.mesh, spec if spec is not None
                                                     else P()),
             tp_specs, is_leaf=lambda x: isinstance(x, P) or x is None)
-        self.params = jax.tree.map(
-            lambda p, s: jax.device_put(jnp.asarray(p, self.dtype), s),
-            model_parameters, self._shardings)
 
-        if apply_fn is not None:
-            self._apply = apply_fn
+        if self.quantized:
+            from ..models.transformer import Transformer
+            if not isinstance(model, Transformer) or apply_fn is not None:
+                raise ValueError(
+                    "dtype='int8' is weight-only quantization through the "
+                    "deepspeed_tpu.models.Transformer decode path; an "
+                    "arbitrary module/apply_fn computes through its own "
+                    "flax Dense layers which the int8 kernels cannot "
+                    "intercept — build the model via models.build_model, "
+                    "or use dtype='bf16'")
+            import numpy as _np
+            self._raw_like = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(_np.shape(x), _np.float32),
+                model_parameters)
+            self._sharding_rules = sharding_rules
+            self._quantize_and_place(model_parameters)
+            cfg = model.cfg
+            from ..models.generation import (forward_with_cache, init_cache,
+                                             padded_cache_len)
+
+            def int8_apply(params, batch):
+                ids = batch["input_ids"] if isinstance(batch, dict) else batch
+                B, T = ids.shape
+                cache = init_cache(cfg, B, padded_cache_len(T))
+                logits, _ = forward_with_cache(cfg, params, ids, cache)
+                return logits
+
+            self._apply = int8_apply
         else:
-            self._apply = lambda params, batch: model.apply({"params": params}, batch)
+            self.params = jax.tree.map(
+                lambda p, s: jax.device_put(jnp.asarray(p, self.dtype), s),
+                model_parameters, self._shardings)
+            if apply_fn is not None:
+                self._apply = apply_fn
+            else:
+                self._apply = lambda params, batch: model.apply(
+                    {"params": params}, batch)
         self._fwd = jax.jit(self._apply)
-        log_dist(f"InferenceEngine: dtype={self.config.dtype} tp={tp}", ranks=[0])
+        log_dist(f"InferenceEngine: dtype={self.config.dtype} tp={tp}"
+                 + (" (int8 weight-only)" if self.quantized else ""), ranks=[0])
+
+    def _quantize_and_place(self, model_parameters) -> None:
+        """Quantize f32 host params into the int8 weight-only layout and
+        place on the TP mesh: int8 kernels keep their TP spec, the tiny
+        per-channel scales replicate.  The restack to scan layout happens
+        FIRST so self._shardings always matches self.params structurally."""
+        from ..models.generation import ensure_scan_layout
+        stacked = ensure_scan_layout(model_parameters,
+                                     self.module.cfg.num_layers)
+        tp_specs = build_tp_specs(stacked, self._sharding_rules)
+        base = jax.tree.map(
+            lambda spec: jax.sharding.NamedSharding(
+                self.mesh, spec if spec is not None else P()),
+            tp_specs, is_leaf=lambda x: isinstance(x, P) or x is None)
+        qparams = quantize_weights_int8(stacked)
+        rep = jax.sharding.NamedSharding(self.mesh, P())
+
+        def _shard_like(path, leaf):
+            keys = tuple(getattr(k, "key", k) for k in path)
+            node = base
+            for key in keys:
+                if not (isinstance(node, dict) and key in node):
+                    return rep                       # kernel_scale etc.
+                node = node[key]
+            return node if isinstance(node, jax.sharding.Sharding) else rep
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(qparams)
+        self._shardings = jax.tree_util.tree_unflatten(
+            treedef, [_shard_like(p, l) for p, l in flat])
+
+        def _place(path, p, s):
+            key = getattr(path[-1], "key", "")
+            if hasattr(p, "dtype") and p.dtype == jnp.int8:
+                arr = p
+            elif key == "kernel_scale":
+                arr = jnp.asarray(p, jnp.float32)     # dequant precision
+            else:
+                arr = jnp.asarray(p, self.dtype)
+            return jax.device_put(arr, s)
+
+        self.params = jax.tree_util.tree_map_with_path(
+            _place, qparams, self._shardings)
 
     def load_checkpoint(self, path: str):
         """Load a name-keyed npz (save_16bit_model / model_states.npz output)
@@ -78,7 +185,13 @@ class InferenceEngine:
         onto any tp_size; the device_put splits along the rule-declared axes.
         """
         from ..runtime import checkpointing as ckpt_lib
-        self.params = ckpt_lib.load_tree(path, self.params, self._shardings)
+        if self.quantized:
+            # checkpoints hold full-precision kernels: load to host f32,
+            # then re-quantize into the int8 layout
+            raw = ckpt_lib.load_tree(path, self._raw_like)
+            self._quantize_and_place(raw)
+        else:
+            self.params = ckpt_lib.load_tree(path, self.params, self._shardings)
         log_dist(f"InferenceEngine: loaded + TP-resharded {path}", ranks=[0])
         return self
 

@@ -35,11 +35,34 @@ def _layer_norm(x, p, eps):
     return (y * p["scale"] + p["bias"]).astype(x.dtype)
 
 
+def _kernel_of(p, dtype):
+    """Matmul weight, dequantizing the int8 weight-only form in place.
+
+    int8 kernels carry a per-output-channel symmetric scale
+    (``kernel_scale``); the convert+multiply fuses into the consuming dot,
+    so the HBM read is half the bf16 bytes — the role of the reference's
+    int8 inference kernels (csrc/transformer/inference, pt_binding
+    ds_*_int8 entry points)."""
+    k = p["kernel"]
+    if "kernel_scale" in p:
+        return k.astype(dtype) * p["kernel_scale"].astype(dtype)
+    return k.astype(dtype)
+
+
 def _dense(x, p):
-    y = x @ p["kernel"].astype(x.dtype)
+    y = x @ _kernel_of(p, x.dtype)
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
     return y
+
+
+# cache lengths round up to this so the decode kernel always has a >=128
+# block tiling (ops/pallas/decode_attention.py); dead positions are masked
+KV_CACHE_ROUND = 256
+
+
+def padded_cache_len(n: int) -> int:
+    return -(-n // KV_CACHE_ROUND) * KV_CACHE_ROUND
 
 
 def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
@@ -82,12 +105,12 @@ def _moe_mlp(cfg: TransformerConfig, p_moe, h):
     _aux, combine, dispatch, _ = gating(gate_logits, capacity=B * T)
     disp = jnp.einsum("tec,th->ech", dispatch.astype(h.dtype), tokens)
     fc = p_moe["experts"]["fc"]
-    hh = jnp.einsum("ech,ehm->ecm", disp, fc["kernel"].astype(h.dtype))
+    hh = jnp.einsum("ech,ehm->ecm", disp, _kernel_of(fc, h.dtype))
     if "bias" in fc:
         hh = hh + fc["bias"][:, None].astype(h.dtype)
     hh = jax.nn.gelu(hh)
     proj = p_moe["experts"]["proj"]
-    out = jnp.einsum("ecm,emh->ech", hh, proj["kernel"].astype(h.dtype))
+    out = jnp.einsum("ecm,emh->ech", hh, _kernel_of(proj, h.dtype))
     if "bias" in proj:
         out = out + proj["bias"][:, None].astype(h.dtype)
     y = jnp.einsum("tec,ech->th", combine.astype(h.dtype), out)
@@ -143,8 +166,20 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
                if cfg.layer_windows is not None
                else jnp.zeros((cfg.num_layers,), jnp.int32))
 
-    def layer(x, xs):
-        p, k_cache, v_cache, window = xs            # k/v: [B, nh, max_len, hd]
+    # Pallas decode kernel: visits only the live ceil(cur_len/block_k) K/V
+    # blocks (compute + DMA of the dead cache tail skipped) — the slot of the
+    # reference's fused softmax_context kernels (pt_binding.cpp:1703-1779).
+    # alibi needs a bias the kernel doesn't carry -> jnp path.
+    use_kernel = (cfg.attention_impl in ("auto", "flash")
+                  and jax.default_backend() == "tpu" and ali is None)
+
+    def layer(carry, xs):
+        # the FULL [L, ...] caches ride in the carry so the per-token write
+        # is an in-place dynamic-update-slice inside the compiled loop — the
+        # stacked-ys layout copied the whole cache every layer (O(L x
+        # max_len) HBM traffic per token, the decode bottleneck)
+        x, k_all, v_all = carry
+        p, window, li = xs
         h = _layer_norm(x, p["ln1"], cfg.layer_norm_eps)
         qkv = _dense(h, p["attn_qkv"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -153,18 +188,37 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
         if cfg.pos_embed == "rotary":
             q = apply_rotary(q, q_abs, cfg.rotary_dim, cfg.rotary_interleaved)
             k = apply_rotary(k, q_abs, cfg.rotary_dim, cfg.rotary_interleaved)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache).astype(jnp.float32)
-        s = s * sm_scale
-        if ali is not None:
-            s = s + ali[None]
-        m = mask
-        # local sliding window (0 = global)
-        m = m & ((q_abs[:, None] - k_pos[None, :] < window) | (window <= 0))
-        s = jnp.where(m[None, None], s, -1e30)
-        prob = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-        o = jnp.einsum("bhqk,bhkd->bhqd", prob, v_cache)
+        k_all = jax.lax.dynamic_update_slice(k_all, k[None],
+                                             (li, 0, 0, pos, 0))
+        v_all = jax.lax.dynamic_update_slice(v_all, v[None],
+                                             (li, 0, 0, pos, 0))
+        o = None
+        if use_kernel:
+            from ..ops.pallas.decode_attention import decode_attention
+            try:
+                # stacked form: the kernel indexes layer li out of the
+                # carried [L, ...] cache itself — no materialized slice
+                o = decode_attention(q, k_all, v_all, pos + T_new,
+                                     window=window, sm_scale=sm_scale,
+                                     layer_idx=li)
+            except ValueError:
+                o = None                       # shapes don't tile
+        if o is None:
+            # the slice reads fuse into the attention consumers (no copy)
+            k_cache = jax.lax.dynamic_index_in_dim(k_all, li, 0,
+                                                   keepdims=False)
+            v_cache = jax.lax.dynamic_index_in_dim(v_all, li, 0,
+                                                   keepdims=False)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache).astype(jnp.float32)
+            s = s * sm_scale
+            if ali is not None:
+                s = s + ali[None]
+            m = mask
+            # local sliding window (0 = global)
+            m = m & ((q_abs[:, None] - k_pos[None, :] < window) | (window <= 0))
+            s = jnp.where(m[None, None], s, -1e30)
+            prob = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+            o = jnp.einsum("bhqk,bhkd->bhqd", prob, v_cache)
         o = o.transpose(0, 2, 1, 3).reshape(B, T_new, nh * hd)
         attn_out = _dense(o, p["attn_proj"])
 
@@ -182,10 +236,11 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
             x_mid = x + attn_out
             h2 = _layer_norm(x_mid, p["ln2"], cfg.layer_norm_eps)
             x_out = x_mid + mlp(h2)
-        return x_out, (k_cache, v_cache)
+        return (x_out, k_all, v_all), None
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x, (params["blocks"], cache["k"], cache["v"], windows))
+    (x, k_new, v_new), _ = jax.lax.scan(
+        layer, (x, cache["k"], cache["v"]),
+        (params["blocks"], windows, jnp.arange(cfg.num_layers)))
     x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bth,vh->btv", x, wte.astype(x.dtype))
@@ -225,7 +280,9 @@ def generate(cfg: TransformerConfig,
                          f"{cfg.max_seq_len}")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     params = ensure_scan_layout(params, cfg.num_layers)
-    cache = init_cache(cfg, B, max_len)
+    # round the workspace up to a decode-kernel-friendly block multiple
+    # (positions past the logical max are masked, never attended)
+    cache = init_cache(cfg, B, padded_cache_len(max_len))
     logits, cache = forward_with_cache(cfg, params, input_ids, cache)
     rng, r0 = jax.random.split(rng)
     tok = _sample(logits[:, -1], r0, temperature, top_k)

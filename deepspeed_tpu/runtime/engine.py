@@ -370,7 +370,6 @@ class DeepSpeedEngine:
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(batch_size=self.config.train_batch_size)
         self.global_steps = 0
-        self.skipped_steps = 0
         self.micro_steps = 0
 
         # progressive layer drop + eigenvalue (reference: engine hooks for
@@ -1042,6 +1041,12 @@ class DeepSpeedEngine:
 
     def get_loss_scale(self) -> float:
         return float(jax.device_get(self.state.scale.scale))
+
+    @property
+    def skipped_steps(self) -> int:
+        """Reference-parity overflow-skip counter; the truth lives on device
+        in TrainState (no per-step host sync)."""
+        return int(jax.device_get(self.state.skipped_steps))
 
     @property
     def train_batch_size(self):

@@ -194,3 +194,75 @@ def test_spatial_attention_inference():
     y = eng.forward(x)
     assert np.asarray(y).shape == (2, 8, 8, 32)
     assert np.all(np.isfinite(np.asarray(y)))
+
+
+# ----------------------------------------------------------------- int8
+
+def test_int8_quantization_error_bound():
+    """Dequantized int8 weights reconstruct within scale/2 elementwise (the
+    symmetric per-output-channel bound)."""
+    from deepspeed_tpu.inference.engine import quantize_weights_int8
+    rng = np.random.default_rng(0)
+    params = {"attn": {"kernel": rng.standard_normal((32, 16)).astype(np.float32),
+                       "bias": np.zeros(16, np.float32)},
+              "gate": {"kernel": rng.standard_normal((32, 4)).astype(np.float32)},
+              "ln": {"scale": np.ones(32, np.float32)}}
+    q = quantize_weights_int8(params)
+    assert q["attn"]["kernel"].dtype == jnp.int8
+    deq = np.asarray(q["attn"]["kernel"], np.float32) * np.asarray(q["attn"]["kernel_scale"])
+    bound = np.asarray(q["attn"]["kernel_scale"]) / 2 + 1e-7
+    assert (np.abs(deq - params["attn"]["kernel"]) <= bound).all()
+    # the router and non-kernel leaves are untouched
+    assert q["gate"]["kernel"].dtype == np.float32
+    assert "kernel_scale" not in q["gate"]
+    assert q["ln"]["scale"].dtype == np.float32
+
+
+def test_int8_engine_logits_close_and_generates():
+    """dtype:int8 builds a weight-only-quantized engine whose logits track
+    the bf16 engine within int8 noise and whose generate() runs end to end
+    (round-2 Weak #7: int8 used to silently mean bf16)."""
+    model, cfg, params = _model_and_params()
+    ids = np.random.default_rng(1).integers(0, 128, (2, 16)).astype(np.int32)
+
+    e_bf = ds.init_inference(model=model, model_parameters=params,
+                             config={"dtype": "bf16"})
+    e_q = ds.init_inference(model=model, model_parameters=params,
+                            config={"dtype": "int8"})
+    assert e_q.quantized
+    l_bf = np.asarray(e_bf.forward({"input_ids": jnp.asarray(ids)}), np.float32)
+    l_q = np.asarray(e_q.forward({"input_ids": jnp.asarray(ids)}), np.float32)
+    # int8 weight noise perturbs logits but must keep them close; top-1
+    # predictions should overwhelmingly agree
+    agree = (l_bf.argmax(-1) == l_q.argmax(-1)).mean()
+    assert agree > 0.9, agree
+    assert np.abs(l_q - l_bf).mean() < 0.15 * (np.abs(l_bf).mean() + 1.0)
+
+    out = e_q.generate(jnp.asarray(ids), max_new_tokens=4)
+    assert out.shape == (2, 20)
+
+    # checkpoint load re-quantizes from full precision
+    import tempfile, os
+    from deepspeed_tpu.runtime import checkpointing as ckpt_lib
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.npz")
+        ckpt_lib.save_tree(params, path)
+        e_q.load_checkpoint(path)
+        l_q2 = np.asarray(e_q.forward({"input_ids": jnp.asarray(ids)}), np.float32)
+        np.testing.assert_allclose(l_q2, l_q, rtol=1e-4, atol=1e-4)
+
+
+def test_int8_engine_rejects_arbitrary_module():
+    import flax.linen as nn
+
+    class Plain(nn.Module):
+        @nn.compact
+        def __call__(self, batch):
+            return nn.Dense(4)(batch["x"])
+
+    with pytest.raises(ValueError, match="int8"):
+        ds.init_inference(model=Plain(),
+                          model_parameters=Plain().init(
+                              jax.random.PRNGKey(0),
+                              {"x": np.zeros((1, 8), np.float32)})["params"],
+                          config={"dtype": "int8"})

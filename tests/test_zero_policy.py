@@ -88,11 +88,13 @@ def test_moe_step_has_no_involuntary_rematerialization(tmp_path):
     fall back to replicate-and-reshard anywhere in the compiled MoE train
     step (round-2 VERDICT: 'a wall of XLA involuntary full rematerialization
     warnings on blocks/moe/reshape')."""
+    import pathlib
+    repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
     script = tmp_path / "moe_no_remat.py"
     script.write_text(MOE_NO_REMAT_SCRIPT)
     proc = subprocess.run(
         [sys.executable, str(script)], capture_output=True, text=True,
-        timeout=900, cwd="/root/repo")
+        timeout=900, cwd=repo_root)
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "loss" in proc.stdout
     assert "Involuntary full rematerialization" not in proc.stderr, \
