@@ -86,13 +86,9 @@ class InferenceEngine:
             model_parameters = model.init(jax.random.PRNGKey(0), example_batch)["params"]
 
         # dtype conversion + TP sharding of weights (reference: engine.py:450 dtype
-        # convert + module_inject TP slicing — here one device_put with specs)
-        tp_specs = build_tp_specs(model_parameters, sharding_rules)
-        self._shardings = jax.tree.map(
-            lambda spec: jax.sharding.NamedSharding(self.mesh, spec if spec is not None
-                                                    else P()),
-            tp_specs, is_leaf=lambda x: isinstance(x, P) or x is None)
-
+        # convert + module_inject TP slicing — here one device_put with specs).
+        # the quantized path builds its own shardings over the restacked
+        # int8 tree inside _quantize_and_place.
         if self.quantized:
             from ..models.transformer import Transformer
             if not isinstance(model, Transformer) or apply_fn is not None:
@@ -122,6 +118,11 @@ class InferenceEngine:
 
             self._apply = int8_apply
         else:
+            tp_specs = build_tp_specs(model_parameters, sharding_rules)
+            self._shardings = jax.tree.map(
+                lambda spec: jax.sharding.NamedSharding(
+                    self.mesh, spec if spec is not None else P()),
+                tp_specs, is_leaf=lambda x: isinstance(x, P) or x is None)
             self.params = jax.tree.map(
                 lambda p, s: jax.device_put(jnp.asarray(p, self.dtype), s),
                 model_parameters, self._shardings)

@@ -55,7 +55,10 @@ def _tree_to_flat_dict(tree, lazy: bool = False
     6.7B ladder config."""
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        if lazy:
+        if callable(leaf):
+            # already a thunk (offload tiers stream leaves off RAM/NVMe)
+            flat[path_str(path)] = leaf if lazy else leaf()
+        elif lazy:
             flat[path_str(path)] = (lambda l=leaf: _gather_leaf(l))
         else:
             flat[path_str(path)] = _gather_leaf(leaf)
@@ -96,6 +99,125 @@ def read_flat_npz(path: str) -> Dict[str, np.ndarray]:
                 if dt == "bfloat16" and _BF16 is not None:
                     flat[k] = flat[k].view(_BF16)
     return flat
+
+
+_MANIFEST_KEY = "__manifest__"
+
+
+def shard_flat_dict(tree) -> Dict[str, np.ndarray]:
+    """THIS process's shard pieces of ``tree`` as a flat dict (replica-0
+    only, so replicated leaves are stored once across the job).  Each piece
+    is keyed ``<leaf-path>::<n>`` with a manifest of global shapes + piece
+    offsets — the per-host half of a sharded save: no process ever
+    materializes a tensor it does not already hold (round-2 Weak #5: the
+    rank-0 process_allgather save moved O(model) over the network per
+    save)."""
+    flat: Dict[str, np.ndarray] = {}
+    manifest: Dict[str, Any] = {}
+    for pathk, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = path_str(pathk)
+        if callable(leaf):
+            leaf = leaf()              # offload thunk: resolve one at a time
+        pieces = []
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            name = f"{key}::0"
+            flat[name] = np.asarray(leaf)
+            pieces.append({"name": name, "start": [0] * np.ndim(leaf)})
+        else:
+            n = 0
+            for sh in shards:
+                if sh.replica_id != 0:
+                    continue
+                name = f"{key}::{n}"
+                flat[name] = np.asarray(sh.data)
+                pieces.append({"name": name,
+                               "start": [s.start or 0 for s in sh.index]})
+                n += 1
+        manifest[key] = {"shape": list(np.shape(leaf)), "pieces": pieces}
+    flat[_MANIFEST_KEY] = np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8)
+    return flat
+
+
+def write_shard_npz(tree, path: str) -> None:
+    write_flat_npz(shard_flat_dict(tree), path)
+
+
+def load_sharded_tree(ckpt_dir: str, base: str, like, shardings=None,
+                      expected_shards: Optional[int] = None):
+    """Reassemble a tree from ``{base}-shard*.npz`` files, ONE LEAF AT A
+    TIME (peak host memory = largest single tensor, never the model).
+    ``expected_shards`` (from the checkpoint meta) guards against partial
+    checkpoints; per-leaf element coverage is validated regardless, so a
+    missing piece can never silently zero-fill a tensor region."""
+    import glob as _glob
+    import jax.numpy as jnp
+    files = sorted(_glob.glob(os.path.join(ckpt_dir, base + "-shard*.npz")))
+    if not files:
+        raise FileNotFoundError(f"no {base}-shard*.npz under {ckpt_dir}")
+    if expected_shards is not None and len(files) != expected_shards:
+        raise FileNotFoundError(
+            f"incomplete sharded checkpoint: found {len(files)} {base} "
+            f"shard files under {ckpt_dir}, expected {expected_shards}")
+    handles = [np.load(f) for f in files]
+    try:
+        merged: Dict[str, Tuple[int, Dict]] = {}    # key -> [(h_idx, piece)]
+        dtmaps = []
+        for hi, h in enumerate(handles):
+            man = json.loads(bytes(h[_MANIFEST_KEY]).decode())
+            dt = (json.loads(bytes(h[_DTYPES_KEY]).decode())
+                  if _DTYPES_KEY in h.files else {})
+            dtmaps.append(dt)
+            for key, ent in man.items():
+                slot = merged.setdefault(key, {"shape": ent["shape"],
+                                               "pieces": []})
+                for p in ent["pieces"]:
+                    slot["pieces"].append((hi, p))
+
+        def assemble(key, ref):
+            ent = merged.get(key)
+            if ent is None:
+                raise KeyError(f"checkpoint missing parameter '{key}'")
+            hi0, p0 = ent["pieces"][0]
+            first = handles[hi0][p0["name"]]
+            if dtmaps[hi0].get(p0["name"]) == "bfloat16" and _BF16 is not None:
+                first = first.view(_BF16)
+            out = np.zeros(tuple(ent["shape"]), first.dtype)
+            covered = 0
+            for hi, p in ent["pieces"]:
+                arr = handles[hi][p["name"]]
+                if dtmaps[hi].get(p["name"]) == "bfloat16" and _BF16 is not None:
+                    arr = arr.view(_BF16)
+                idx = tuple(slice(st, st + sz)
+                            for st, sz in zip(p["start"], arr.shape))
+                out[idx] = arr
+                covered += arr.size
+            if covered != out.size:
+                raise ValueError(
+                    f"sharded checkpoint pieces for '{key}' cover {covered} "
+                    f"of {out.size} elements — missing shard data")
+            if tuple(out.shape) != tuple(np.shape(ref)):
+                raise ValueError(f"shape mismatch for '{key}': ckpt "
+                                 f"{out.shape} vs model {np.shape(ref)}")
+            return out
+
+        leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+        sh_flat = (treedef.flatten_up_to(shardings)
+                   if shardings is not None else None)
+        new_leaves = []
+        for i, (pathk, ref) in enumerate(leaves_with_paths):
+            arr = assemble(path_str(pathk), ref)
+            dtype = ref.dtype if hasattr(ref, "dtype") else arr.dtype
+            out = jnp.asarray(arr, dtype=dtype)
+            if sh_flat is not None and sh_flat[i] is not None:
+                out = jax.device_put(out, sh_flat[i])
+            new_leaves.append(out)
+            del arr
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+    finally:
+        for h in handles:
+            h.close()
 
 
 def _flat_dict_to_tree(flat: Dict[str, np.ndarray], like):
@@ -147,6 +269,38 @@ def save_checkpoint(save_dir: str,
     the file IO off-thread; `latest` lands only after the data is durable
     (the async engine's single FIFO worker orders it behind the writes)."""
     ckpt_dir = os.path.join(save_dir, tag)
+    optim_group = {"opt_state": state.opt_state}
+    if not master_aliases_params:
+        optim_group["master"] = state.master
+    if jax.process_count() > 1:
+        # sharded save: EVERY process writes its own addressable pieces
+        # (replica-0 dedup) through the configured checkpoint engine (async
+        # engines do the IO off-thread); a global barrier — FIFO-ordered
+        # behind the writes on each rank — gates rank 0's metadata+`latest`
+        # so `latest` never points at a partially-written checkpoint. No
+        # cross-process gather happens at all.
+        if ckpt_engine is None:
+            from ..checkpoint.engine import NpzCheckpointEngine
+            ckpt_engine = NpzCheckpointEngine()
+        os.makedirs(ckpt_dir, exist_ok=True)
+        ckpt_engine.create(tag)
+        p = jax.process_index()
+        # shard pieces are local host copies already (np.asarray of
+        # addressable shards) — safe to hand to an async writer thread
+        ckpt_engine.save(shard_flat_dict(state.params),
+                         os.path.join(ckpt_dir, f"model_states-shard{p}.npz"))
+        ckpt_engine.save(shard_flat_dict(optim_group),
+                         os.path.join(ckpt_dir, f"optim_states-shard{p}.npz"))
+
+        def _finalize():
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("dstpu_ckpt_" + tag)
+            if jax.process_index() == 0:
+                _save_meta_and_latest(save_dir, ckpt_dir, tag, state,
+                                      client_state, master_aliases_params)
+
+        ckpt_engine.run(_finalize)
+        return ckpt_dir
     if jax.process_index() != 0:
         return ckpt_dir
     if ckpt_engine is None:
@@ -159,13 +313,19 @@ def save_checkpoint(save_dir: str,
     lazy = getattr(ckpt_engine, "wants_lazy", True)
     ckpt_engine.save(_tree_to_flat_dict(state.params, lazy=lazy),
                      os.path.join(ckpt_dir, "model_states.npz"))
-    optim_group = {"opt_state": state.opt_state}
-    if not master_aliases_params:
-        optim_group["master"] = state.master
     ckpt_engine.save(_tree_to_flat_dict(optim_group, lazy=lazy),
                      os.path.join(ckpt_dir, "optim_states.npz"))
+    _save_meta_and_latest(save_dir, ckpt_dir, tag, state, client_state,
+                          master_aliases_params, ckpt_engine=ckpt_engine)
+    return ckpt_dir
+
+
+def _save_meta_and_latest(save_dir, ckpt_dir, tag, state, client_state,
+                          master_aliases_params, ckpt_engine=None) -> None:
     meta = {
         "master_aliases_params": master_aliases_params,
+        "sharded": jax.process_count() > 1,
+        "num_shards": jax.process_count(),
         "step": int(jax.device_get(state.step)),
         "skipped_steps": int(jax.device_get(state.skipped_steps)),
         "loss_scale": float(jax.device_get(state.scale.scale)),
@@ -181,8 +341,10 @@ def save_checkpoint(save_dir: str,
             f.write(tag)
         logger.info(f"saved checkpoint {ckpt_dir}")
 
-    ckpt_engine.run(_write_latest)   # async: FIFO-ordered behind the writes
-    return ckpt_dir
+    if ckpt_engine is None:
+        _write_latest()
+    else:
+        ckpt_engine.run(_write_latest)   # async: FIFO-ordered behind writes
 
 
 def get_latest_tag(load_dir: str) -> Optional[str]:
@@ -209,19 +371,27 @@ def load_checkpoint(load_dir: str,
     ckpt_dir = os.path.join(load_dir, tag)
     with open(os.path.join(ckpt_dir, "meta.json")) as f:
         meta = json.load(f)
-    params = load_tree(os.path.join(ckpt_dir, "model_states.npz"), state.params,
-                       param_shardings)
+    sharded = not os.path.exists(os.path.join(ckpt_dir, "model_states.npz"))
+
+    def _load(base, like, shardings):
+        if sharded:
+            return load_sharded_tree(ckpt_dir, base, like, shardings,
+                                     expected_shards=meta.get("num_shards"))
+        return load_tree(os.path.join(ckpt_dir, base + ".npz"), like,
+                         shardings)
+
+    params = _load("model_states", state.params, param_shardings)
     if meta.get("master_aliases_params"):
         optim = {"master": params,
-                 "opt_state": load_tree(os.path.join(ckpt_dir, "optim_states.npz"),
-                                        {"opt_state": state.opt_state},
-                                        {"opt_state": opt_shardings}
-                                        if opt_shardings is not None else None)["opt_state"]}
+                 "opt_state": _load("optim_states",
+                                    {"opt_state": state.opt_state},
+                                    {"opt_state": opt_shardings}
+                                    if opt_shardings is not None else None)["opt_state"]}
     else:
-        optim = load_tree(os.path.join(ckpt_dir, "optim_states.npz"),
-                          {"master": state.master, "opt_state": state.opt_state},
-                          {"master": master_shardings, "opt_state": opt_shardings}
-                          if master_shardings is not None else None)
+        optim = _load("optim_states",
+                      {"master": state.master, "opt_state": state.opt_state},
+                      {"master": master_shardings, "opt_state": opt_shardings}
+                      if master_shardings is not None else None)
     from .loss_scaler import LossScaleState
     new_state = state.replace(
         step=jnp.asarray(meta["step"], jnp.int32),

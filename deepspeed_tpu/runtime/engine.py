@@ -1079,13 +1079,18 @@ class DeepSpeedEngine:
 
     # ----------------------------------------------------------- checkpointing
 
-    def _ckpt_view(self):
+    def _ckpt_view(self, lazy: bool = False):
         """State as checkpointed: fp32 mode aliases params into the master slot;
-        offload mode surfaces the host-resident master/opt-state pytrees."""
+        offload mode surfaces the host-resident master/opt-state pytrees.
+
+        ``lazy=True`` (sync saves only): offload leaves become thunks so the
+        streaming writer never holds more than one leaf — with an async
+        engine the copies must be eager or the writer thread would race the
+        next step's in-place master updates."""
         if self.offload is not None:
-            sd = self.offload.state_dict()
-            params = (self.offload.host_params() if self._transient_params
-                      else self.state.params)
+            sd = self.offload.state_dict(lazy=lazy)
+            params = (self.offload.host_params(lazy=lazy)
+                      if self._transient_params else self.state.params)
             return self.state.replace(params=params,
                                       master=sd["master"],
                                       opt_state={"offload": sd["state"]})
@@ -1102,8 +1107,9 @@ class DeepSpeedEngine:
         if not hasattr(self, "checkpoint_engine"):
             from ..checkpoint.engine import build_checkpoint_engine
             self.checkpoint_engine = build_checkpoint_engine(self.config)
+        lazy = getattr(self.checkpoint_engine, "wants_lazy", True)
         return ckpt_lib.save_checkpoint(
-            save_dir, tag, self._ckpt_view(), client_state,
+            save_dir, tag, self._ckpt_view(lazy=lazy), client_state,
             master_aliases_params=(not self.keep_master
                                    and self.offload is None),
             ckpt_engine=self.checkpoint_engine)

@@ -281,18 +281,30 @@ class HostOffloadOptimizer:
 
     # -- checkpoint plumbing ------------------------------------------------------
 
-    def state_dict(self) -> Dict[str, Any]:
-        if self.swapper is not None:
-            state = [self.swapper.read_leaf(j) for j in range(self.n_leaves)]
-            state = [{s: v.reshape(self.shapes[j]) for s, v in st.items()}
-                     for j, st in enumerate(state)]
-        else:
-            state = self.state
-        master = [self._master_host(j) for j in range(self.n_leaves)]
+    def state_dict(self, lazy: bool = False) -> Dict[str, Any]:
+        """``lazy=True`` returns per-leaf THUNKS instead of arrays, so the
+        streaming checkpoint writer holds one leaf at a time — the NVMe
+        tier's O(buffers) host-RAM premise holds through saves too."""
+        def master_leaf(j):
+            return lambda: self._master_host(j)
+
+        def state_leaf(s, j):
+            if self.swapper is not None:
+                # read only this slot's pool (read_leaf would read ALL slots
+                # from NVMe per thunk — len(slots)x amplification)
+                return lambda: self.swapper.pools[s].read_sync(j).reshape(
+                    self.shapes[j])
+            return lambda: self.state[j][s].reshape(self.shapes[j])
+
+        master = [master_leaf(j) for j in range(self.n_leaves)]
+        slots = {s: [state_leaf(s, j) for j in range(self.n_leaves)]
+                 for s in self.slot_names}
+        if not lazy:
+            master = [m() for m in master]
+            slots = {s: [t() for t in ts] for s, ts in slots.items()}
         return {"master": self.treedef.unflatten(master),
-                "state": {s: self.treedef.unflatten([st[s].reshape(self.shapes[j])
-                                                     for j, st in enumerate(state)])
-                          for s in self.slot_names}}
+                "state": {s: self.treedef.unflatten(ts)
+                          for s, ts in slots.items()}}
 
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
         master = [np.ascontiguousarray(np.asarray(m, np.float32))
@@ -336,23 +348,27 @@ class HostOffloadOptimizer:
         return self.treedef.unflatten(
             [self._put_param(j) for j in range(self.n_leaves)])
 
-    def host_params(self) -> PyTree:
+    def host_params(self, lazy: bool = False) -> PyTree:
         """Compute-dtype params as HOST arrays (checkpoint/export paths in
         transient mode — no device round trip; the bf16 mirror is already
-        maintained by the step kernel)."""
-        leaves = []
-        for j in range(self.n_leaves):
-            if self.param_pool is not None:
-                m = self._master_host(j)
-                leaves.append(m.astype(_BF16)
-                              if (self.compute_dtype == jax.numpy.bfloat16
-                                  and _BF16 is not None)
-                              else m.astype(np.dtype(self.compute_dtype)))
-            elif (self.compute_dtype == jax.numpy.bfloat16
-                    and self._bf16_staging[j] is not None):
-                leaves.append(self._bf16_staging[j])
-            else:
+        maintained by the step kernel).  ``lazy=True``: per-leaf thunks."""
+        def leaf(j):
+            def get():
+                if self.param_pool is not None:
+                    m = self._master_host(j)
+                    return (m.astype(_BF16)
+                            if (self.compute_dtype == jax.numpy.bfloat16
+                                and _BF16 is not None)
+                            else m.astype(np.dtype(self.compute_dtype)))
+                if (self.compute_dtype == jax.numpy.bfloat16
+                        and self._bf16_staging[j] is not None):
+                    return self._bf16_staging[j]
                 dt = np.dtype(self.compute_dtype)
-                leaves.append(self.master[j] if dt == np.float32
-                              else self.master[j].astype(dt))
-        return self.treedef.unflatten(leaves)
+                return (self.master[j] if dt == np.float32
+                        else self.master[j].astype(dt))
+            return get
+
+        thunks = [leaf(j) for j in range(self.n_leaves)]
+        if not lazy:
+            return self.treedef.unflatten([t() for t in thunks])
+        return self.treedef.unflatten(thunks)

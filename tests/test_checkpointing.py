@@ -157,3 +157,52 @@ def test_checkpoint_inspector(tmp_path):
     summary = inspect_checkpoint(str(tmp_path / "ck"))
     assert summary["num_tensors"] == len(names)
     assert "bfloat16" in summary["dtypes"]
+
+
+def test_sharded_write_and_assemble_roundtrip(tmp_path):
+    """write_shard_npz stores only this process's replica-0 pieces;
+    load_sharded_tree reassembles leaf-by-leaf — bit-exact round trip for
+    sharded AND replicated leaves (round-2 Weak #5: sharded saves)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.runtime.checkpointing import (load_sharded_tree,
+                                                     write_shard_npz)
+    from deepspeed_tpu.parallel.mesh import MeshManager
+
+    mm = MeshManager()
+    mesh = mm.mesh
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((16, 8)).astype(np.float32)
+    b = rng.standard_normal((8,)).astype(np.float32)
+    bf = jnp.asarray(rng.standard_normal((8, 4)), jnp.bfloat16)
+    tree = {
+        "w": jax.device_put(jnp.asarray(w),
+                            NamedSharding(mesh, P(("data", "expert", "seq"), None))),
+        "b": jax.device_put(jnp.asarray(b), NamedSharding(mesh, P())),
+        "h": jax.device_put(bf, NamedSharding(mesh, P())),
+    }
+    write_shard_npz(tree, str(tmp_path / "model_states-shard0.npz"))
+    like = {"w": jnp.zeros_like(w), "b": jnp.zeros_like(b),
+            "h": jnp.zeros(bf.shape, jnp.bfloat16)}
+    out = load_sharded_tree(str(tmp_path), "model_states", like)
+    np.testing.assert_array_equal(np.asarray(out["w"]), w)
+    np.testing.assert_array_equal(np.asarray(out["b"]), b)
+    assert out["h"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out["h"]).view(np.uint16), np.asarray(bf).view(np.uint16))
+
+
+def test_sharded_write_replicated_dedup(tmp_path):
+    """A fully-replicated leaf produces exactly ONE stored piece (replica-0),
+    not one per device."""
+    import jax, json, zipfile
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.runtime.checkpointing import write_shard_npz
+    from deepspeed_tpu.parallel.mesh import MeshManager
+
+    mesh = MeshManager().mesh
+    x = jax.device_put(jnp.ones((4, 4)), NamedSharding(mesh, P()))
+    path = str(tmp_path / "g-shard0.npz")
+    write_shard_npz({"x": x}, path)
+    names = zipfile.ZipFile(path).namelist()
+    assert sum(1 for n in names if n.startswith("x::")) == 1, names

@@ -1,0 +1,119 @@
+"""Real multi-PROCESS coverage: 2 OS processes, jax.distributed rendezvous.
+
+Mirrors the reference's DistributedTest pattern (tests/unit/common.py:110 —
+fork N ranks with a TCP store rendezvous, train, checkpoint).  Everything
+else in this suite simulates multi-chip with 8 virtual devices in ONE
+process; this test exercises the rank-bootstrap path those tests skip:
+``deepspeed_tpu.init_distributed`` -> ``jax.distributed.initialize`` with
+the DSTPU_* env contract the launcher sets (launcher/runner.py), a global
+mesh spanning two processes, cross-process collectives in the train step,
+and a rank-0 checkpoint write.
+"""
+
+import pathlib
+import socket
+import subprocess
+import sys
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
+
+WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+sys.path.insert(0, os.environ["DSTPU_TEST_REPO"])
+
+import numpy as np
+import deepspeed_tpu as ds
+
+ds.init_distributed()          # DSTPU_COORDINATOR_ADDRESS / _NUM_PROCESSES / _PROCESS_ID
+rank = ds.comm.get_rank()
+world = ds.comm.get_world_size()
+assert world == 2, world
+assert len(jax.devices()) == 2          # one local device per process, global view
+
+sys.path.insert(0, os.path.join(os.environ["DSTPU_TEST_REPO"], "tests"))
+from util import SimpleModel, random_batch
+
+config = {
+    "train_batch_size": 8,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    "zero_optimization": {"stage": 1},
+    "seed": 11,
+}
+engine, *_ = ds.initialize(model=SimpleModel(), config=config,
+                           example_batch=random_batch(8))
+assert engine.dp_world_size == 2
+# correctness here is the rank bootstrap + cross-process collectives +
+# sharded checkpointing, not convergence (batch 8 is noisy): finite losses,
+# and both ranks must report IDENTICAL values (the psum really synced)
+losses = [float(engine.train_batch(random_batch(8, seed=i))["loss"])
+          for i in range(12)]
+assert np.isfinite(losses).all(), losses
+
+ckdir = os.environ["DSTPU_TEST_CKPT"]
+engine.save_checkpoint(ckdir, tag="mp")
+print(f"RANK{rank} OK last={losses[-1]:.4f}", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_train_and_checkpoint(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    port = _free_port()
+    ck = tmp_path / "ck"
+    procs = []
+    for pid in range(2):
+        env = dict(**__import__("os").environ,
+                   DSTPU_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   DSTPU_NUM_PROCESSES="2",
+                   DSTPU_PROCESS_ID=str(pid),
+                   DSTPU_TEST_REPO=REPO_ROOT,
+                   DSTPU_TEST_CKPT=str(ck))
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out[-3000:]}"
+        assert f"RANK{pid} OK" in out, out[-2000:]
+    # both ranks computed the same loss (the collectives really synced)
+    l0 = outs[0].split("last=")[1].split()[0]
+    l1 = outs[1].split("last=")[1].split()[0]
+    assert l0 == l1, (l0, l1)
+    assert (ck / "mp").is_dir()
+
+    # the 2-process job wrote SHARDED files (per-host pieces, no gather);
+    # restore them here in the single-process 8-device suite — a
+    # cross-process-count universal restore
+    shard_files = list((ck / "mp").glob("model_states-shard*.npz"))
+    assert len(shard_files) == 2, shard_files
+
+    import deepspeed_tpu as ds
+    from util import SimpleModel, random_batch
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "seed": 11,
+    }
+    engine, *_ = ds.initialize(model=SimpleModel(), config=config,
+                               example_batch=random_batch(8))
+    engine.load_checkpoint(str(ck), tag="mp")
+    assert int(engine.state.step) == 12
+    m = engine.train_batch(random_batch(8, seed=100))
+    assert float(m["loss"]) == float(m["loss"])   # finite, trains on
