@@ -29,6 +29,8 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
                        micro: int = 64, gas: int = 1, steps: int = 4,
                        zero_stage: int = 1, remat: bool = False,
                        remat_policy: str = "dots", fused_loss=None,
+                       pure_bf16: bool = False,
+                       grad_accum_dtype=None,
                        verbose: bool = True):
     """Measure sustained train-step model TFLOPs/chip for a preset.
 
@@ -56,10 +58,16 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
         "train_micro_batch_size_per_gpu": micro,
         "gradient_accumulation_steps": gas,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-        "bf16": {"enabled": True},
+        # pure_bf16: params-are-master + bf16 moments (BF16Config.
+        # master_weights) — the device-resident route to 1.3B on one 16GB
+        # chip (host offload is relay-bandwidth-starved in this environment)
+        "bf16": ({"enabled": True, "master_weights": False} if pure_bf16
+                 else {"enabled": True}),
         "zero_optimization": {"stage": zero_stage},
         "steps_per_print": 10_000,
     }
+    if grad_accum_dtype:
+        config["data_types"] = {"grad_accum_dtype": grad_accum_dtype}
     rng = np.random.default_rng(0)
 
     def make_batch():
@@ -93,6 +101,10 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
         "vs_baseline": round(tflops / ref, 4) if ref else None,
         "detail": {"preset": preset, "seq": seq, "micro": micro, "gas": gas,
                    "batch": batch_size, "chips": n_chips,
+                   "zero_stage": zero_stage, "remat": remat,
+                   "remat_policy": remat_policy if remat else None,
+                   "pure_bf16": pure_bf16,
+                   "grad_accum_dtype": grad_accum_dtype or "fp32",
                    "step_time_s": round(dt, 4),
                    "samples_per_s": round(batch_size / dt, 2),
                    "backend": jax.default_backend()},

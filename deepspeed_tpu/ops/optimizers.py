@@ -31,8 +31,13 @@ class Optimizer(NamedTuple):
     name: str
 
 
-def _tree_zeros_like(params, dtype=jnp.float32):
-    return jax.tree.map(lambda p: jnp.zeros(p.shape, dtype), params)
+def _tree_zeros_like(params, dtype=None):
+    """Moment buffers: fp32 masters get fp32 moments (the usual mixed-
+    precision shape); pure-bf16 params get bf16 moments (6 bytes/param of
+    optimizer state — see BF16Config.master_weights)."""
+    return jax.tree.map(
+        lambda p: jnp.zeros(p.shape, dtype or getattr(p, "dtype", jnp.float32)),
+        params)
 
 
 def adam(lr: float = 1e-3,
@@ -55,16 +60,19 @@ def adam(lr: float = 1e-3,
         bc2 = 1.0 - b2 ** t if bias_correction else 1.0
 
         def leaf(g, m, v, p):
+            # math in f32; storage keeps each tensor's own dtype, so the
+            # master-less bf16 mode (params/moments bf16) round-trips
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             if weight_decay != 0.0 and not adamw_mode:
                 g = g + weight_decay * p32
-            m_new = b1 * m + (1.0 - b1) * g
-            v_new = b2 * v + (1.0 - b2) * g * g
+            m_new = b1 * m.astype(jnp.float32) + (1.0 - b1) * g
+            v_new = b2 * v.astype(jnp.float32) + (1.0 - b2) * g * g
             upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
             if weight_decay != 0.0 and adamw_mode:
                 upd = upd + weight_decay * p32
-            return p32 - lr_eff * upd, m_new, v_new
+            return ((p32 - lr_eff * upd).astype(p.dtype),
+                    m_new.astype(m.dtype), v_new.astype(v.dtype))
 
         flat_p, treedef = jax.tree.flatten(params)
         flat_g = treedef.flatten_up_to(grads)
@@ -98,7 +106,8 @@ def lamb(lr: float = 1e-3,
     b1, b2 = betas
 
     def init(params):
-        return {"m": _tree_zeros_like(params), "v": _tree_zeros_like(params)}
+        return {"m": _tree_zeros_like(params, jnp.float32),
+                "v": _tree_zeros_like(params, jnp.float32)}
 
     def update(grads, state, params, step, lr_t=None):
         lr_eff = lr if lr_t is None else lr_t
@@ -132,7 +141,7 @@ def sgd(lr: float = 1e-3, momentum: float = 0.0, weight_decay: float = 0.0,
     def init(params):
         if momentum == 0.0:
             return {}
-        return {"momentum": _tree_zeros_like(params)}
+        return {"momentum": _tree_zeros_like(params, jnp.float32)}
 
     def update(grads, state, params, step, lr_t=None):
         lr_eff = lr if lr_t is None else lr_t
@@ -165,7 +174,7 @@ def adagrad(lr: float = 1e-2, eps: float = 1e-10, weight_decay: float = 0.0) -> 
     """reference: csrc/adagrad/cpu_adagrad.cpp."""
 
     def init(params):
-        return {"sum": _tree_zeros_like(params)}
+        return {"sum": _tree_zeros_like(params, jnp.float32)}
 
     def update(grads, state, params, step, lr_t=None):
         lr_eff = lr if lr_t is None else lr_t
@@ -208,9 +217,9 @@ def onebit_adam(lr: float = 1e-3,
     from .quantizer import onebit_compress, onebit_decompress
 
     def init(params):
-        return {"m": _tree_zeros_like(params),
-                "v": _tree_zeros_like(params),
-                "comp_err": _tree_zeros_like(params)}
+        return {"m": _tree_zeros_like(params, jnp.float32),
+                "v": _tree_zeros_like(params, jnp.float32),
+                "comp_err": _tree_zeros_like(params, jnp.float32)}
 
     def update(grads, state, params, step, lr_t=None):
         lr_eff = lr if lr_t is None else lr_t
@@ -312,10 +321,10 @@ def onebit_lamb(lr: float = 1e-3,
     from .quantizer import onebit_compress, onebit_decompress
 
     def init(params):
-        return {"m": _tree_zeros_like(params),
-                "v": _tree_zeros_like(params),
-                "v_fresh": _tree_zeros_like(params),
-                "comp_err": _tree_zeros_like(params),
+        return {"m": _tree_zeros_like(params, jnp.float32),
+                "v": _tree_zeros_like(params, jnp.float32),
+                "v_fresh": _tree_zeros_like(params, jnp.float32),
+                "comp_err": _tree_zeros_like(params, jnp.float32),
                 "coeff_freeze": jax.tree.map(
                     lambda p: jnp.zeros((), jnp.float32), params),
                 "last_factor": jax.tree.map(

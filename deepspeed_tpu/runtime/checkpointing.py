@@ -121,9 +121,14 @@ def shard_flat_dict(tree) -> Dict[str, np.ndarray]:
         pieces = []
         shards = getattr(leaf, "addressable_shards", None)
         if shards is None:
-            name = f"{key}::0"
-            flat[name] = np.asarray(leaf)
-            pieces.append({"name": name, "start": [0] * np.ndim(leaf)})
+            # host-numpy leaf (offload tiers): replicated by construction —
+            # process 0 writes the single full piece, others skip, so the
+            # loader's coverage accounting stays exact
+            if jax.process_index() == 0:
+                name = f"{key}::0"
+                flat[name] = np.array(leaf)      # copy: async writers must
+                pieces.append({"name": name,     # not see later mutations
+                               "start": [0] * np.ndim(leaf)})
         else:
             n = 0
             for sh in shards:
@@ -291,15 +296,18 @@ def save_checkpoint(save_dir: str,
                          os.path.join(ckpt_dir, f"model_states-shard{p}.npz"))
         ckpt_engine.save(shard_flat_dict(optim_group),
                          os.path.join(ckpt_dir, f"optim_states-shard{p}.npz"))
-
-        def _finalize():
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("dstpu_ckpt_" + tag)
-            if jax.process_index() == 0:
-                _save_meta_and_latest(save_dir, ckpt_dir, tag, state,
-                                      client_state, master_aliases_params)
-
-        ckpt_engine.run(_finalize)
+        # the barrier + meta must run on the MAIN thread: a collective from
+        # an async writer thread could interleave with train-step
+        # collectives in different orders across ranks (deadlock), and the
+        # donated TrainState must be read before the next step consumes it.
+        # Async engines therefore drain here — multi-process saves are
+        # durable-on-return.
+        ckpt_engine.commit(tag)
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("dstpu_ckpt_" + tag)
+        if jax.process_index() == 0:
+            _save_meta_and_latest(save_dir, ckpt_dir, tag, state,
+                                  client_state, master_aliases_params)
         return ckpt_dir
     if jax.process_index() != 0:
         return ckpt_dir

@@ -85,6 +85,27 @@ class DeepSpeedEngine:
         self.compute_dtype = {"float16": jnp.float16, "bfloat16": jnp.bfloat16,
                               "float32": jnp.float32}[self.config.precision_dtype]
         self.keep_master = self.compute_dtype != jnp.float32
+        if self.config.bf16.enabled and not self.config.bf16.master_weights:
+            # pure-bf16: params are the master, moments bf16 (config.py
+            # BF16Config.master_weights) — no fp32 state anywhere. Only
+            # Adam/AdamW implement the dtype round-trip (other optimizers
+            # keep fp32 state, which would silently triple the budget).
+            opt_t = (self.config.optimizer.type.lower().replace("_", "")
+                     if self.config.optimizer else "")
+            if opt_t not in ("adam", "adamw", "fusedadam"):
+                raise ValueError(
+                    "bf16.master_weights=false (pure-bf16 state) supports "
+                    f"Adam/AdamW only; got optimizer '{opt_t or None}'")
+            self.keep_master = False
+        # reference: data_types.grad_accum_dtype (config.py:907) — the dtype
+        # microbatch grads accumulate in; fp32 default, bf16 halves the
+        # accumulator footprint (update math stays f32 in _finalize_step)
+        gad = (self.config.data_types.grad_accum_dtype or "fp32").lower()
+        self.grad_accum_dtype = {"fp32": jnp.float32, "float32": jnp.float32,
+                                 "bf16": jnp.bfloat16,
+                                 "bfloat16": jnp.bfloat16,
+                                 "fp16": jnp.float16,
+                                 "float16": jnp.float16}[gad]
         fp16 = self.config.fp16
         self.loss_scaler = LossScaler(
             static_scale=fp16.loss_scale,
@@ -322,7 +343,11 @@ class DeepSpeedEngine:
                 lambda m: jax.tree.map(lambda x: x.astype(self.compute_dtype), m),
                 out_shardings=self.param_shardings)(master)
         else:
-            params = jax.device_put(params_f32, self.param_shardings)
+            # fp32 (params are f32) or pure-bf16 (cast down; no master)
+            params = jax.device_put(
+                jax.tree.map(lambda x: x.astype(self.compute_dtype),
+                             params_f32),
+                self.param_shardings)
             master = ()
         opt_state = {}
         if self.onebit is not None:
@@ -552,7 +577,7 @@ class DeepSpeedEngine:
 
         grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
         grads = jax.tree.map(lambda g, s: lax.with_sharding_constraint(
-            g.astype(jnp.float32), s), grads, self.grad_shardings)
+            g.astype(self.grad_accum_dtype), s), grads, self.grad_shardings)
         return grads, loss
 
     def _finalize_step(self, state: TrainState, grads_sum, n_micro, lr_arg):
@@ -619,7 +644,7 @@ class DeepSpeedEngine:
             rngs = jax.random.split(rng, gas)
             zero_grads = jax.tree.map(
                 lambda p, s: lax.with_sharding_constraint(
-                    jnp.zeros(p.shape, jnp.float32), s),
+                    jnp.zeros(p.shape, self.grad_accum_dtype), s),
                 state.params, self.grad_shardings)
 
             def micro_step(acc, xs):
@@ -647,7 +672,7 @@ class DeepSpeedEngine:
             rngs = jax.random.split(rng, gas)
             zero_grads = jax.tree.map(
                 lambda p, s: lax.with_sharding_constraint(
-                    jnp.zeros(p.shape, jnp.float32), s),
+                    jnp.zeros(p.shape, self.grad_accum_dtype), s),
                 params, self.grad_shardings)
 
             def micro_step(acc, xs):

@@ -285,8 +285,13 @@ class HostOffloadOptimizer:
         """``lazy=True`` returns per-leaf THUNKS instead of arrays, so the
         streaming checkpoint writer holds one leaf at a time — the NVMe
         tier's O(buffers) host-RAM premise holds through saves too."""
+        # thunks return OWNING COPIES: an async checkpoint writer serializes
+        # them while the next step's host optimizer mutates the originals
+        # in place (pool reads already copy via read_sync)
         def master_leaf(j):
-            return lambda: self._master_host(j)
+            if self.param_pool is not None:
+                return lambda: self._master_host(j)   # pool read copies
+            return lambda: np.array(self.master[j])
 
         def state_leaf(s, j):
             if self.swapper is not None:
@@ -294,7 +299,7 @@ class HostOffloadOptimizer:
                 # from NVMe per thunk — len(slots)x amplification)
                 return lambda: self.swapper.pools[s].read_sync(j).reshape(
                     self.shapes[j])
-            return lambda: self.state[j][s].reshape(self.shapes[j])
+            return lambda: np.array(self.state[j][s]).reshape(self.shapes[j])
 
         master = [master_leaf(j) for j in range(self.n_leaves)]
         slots = {s: [state_leaf(s, j) for j in range(self.n_leaves)]
@@ -354,6 +359,9 @@ class HostOffloadOptimizer:
         maintained by the step kernel).  ``lazy=True``: per-leaf thunks."""
         def leaf(j):
             def get():
+                # owning copies throughout: async writers must not see the
+                # step kernel's in-place updates (astype copies; the two
+                # passthrough cases copy explicitly)
                 if self.param_pool is not None:
                     m = self._master_host(j)
                     return (m.astype(_BF16)
@@ -362,9 +370,9 @@ class HostOffloadOptimizer:
                             else m.astype(np.dtype(self.compute_dtype)))
                 if (self.compute_dtype == jax.numpy.bfloat16
                         and self._bf16_staging[j] is not None):
-                    return self._bf16_staging[j]
+                    return np.array(self._bf16_staging[j])
                 dt = np.dtype(self.compute_dtype)
-                return (self.master[j] if dt == np.float32
+                return (np.array(self.master[j]) if dt == np.float32
                         else self.master[j].astype(dt))
             return get
 

@@ -254,3 +254,35 @@ def test_zero_quantized_weights_requires_stage3():
                               "zero_optimization": {
                                   "stage": 2,
                                   "zero_quantized_weights": True}})
+
+
+def test_pure_bf16_mode_trains():
+    """bf16.master_weights=false: params ARE the master, moments bf16 —
+    6 bytes/param of state (the device-resident beyond-HBM route; see
+    BF16Config). Trains, and every state leaf really is bf16."""
+    import jax
+    import jax.numpy as jnp
+    engine = make_engine(stage=1, extra={
+        "bf16": {"enabled": True, "master_weights": False},
+        "data_types": {"grad_accum_dtype": "bf16"}})
+    assert engine.keep_master is False
+    assert engine.state.master == ()
+    for leaf in jax.tree.leaves(engine.state.opt_state):
+        assert leaf.dtype == jnp.bfloat16
+    losses = train_n(engine, n=40)
+    assert losses[-1] < losses[0] * 0.8
+    for leaf in jax.tree.leaves(engine.state.params):
+        assert leaf.dtype == jnp.bfloat16
+
+
+def test_grad_accum_dtype_bf16_close_to_fp32():
+    """bf16 grad accumulation tracks fp32 accumulation closely at small gas
+    (reference: data_types.grad_accum_dtype)."""
+    e32 = make_engine(stage=1)
+    e16 = make_engine(stage=1,
+                      extra={"data_types": {"grad_accum_dtype": "bf16"}})
+    stream_a, stream_b = batch_stream(32), batch_stream(32)
+    for i in range(5):
+        l32 = float(e32.train_batch(next(stream_a))["loss"])
+        l16 = float(e16.train_batch(next(stream_b))["loss"])
+        assert abs(l32 - l16) < 0.02 + 0.02 * abs(l32), (i, l32, l16)
