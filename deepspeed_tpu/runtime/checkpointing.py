@@ -302,9 +302,18 @@ def save_checkpoint(save_dir: str,
         # donated TrainState must be read before the next step consumes it.
         # Async engines therefore drain here — multi-process saves are
         # durable-on-return.
-        ckpt_engine.commit(tag)
+        ok = ckpt_engine.commit(tag)
         from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("dstpu_ckpt_" + tag)
+        # aggregate per-rank write success (the gather doubles as the
+        # durability barrier): `latest` must never advance onto a
+        # checkpoint any rank failed to write
+        flags = multihost_utils.process_allgather(
+            np.asarray([1 if ok is not False else 0], np.int32))
+        if int(np.min(flags)) == 0:
+            logger.error(
+                f"sharded checkpoint {ckpt_dir}: a rank's shard write "
+                "failed — leaving `latest` on the previous checkpoint")
+            return ckpt_dir
         if jax.process_index() == 0:
             _save_meta_and_latest(save_dir, ckpt_dir, tag, state,
                                   client_state, master_aliases_params)

@@ -85,27 +85,25 @@ class DeepSpeedEngine:
         self.compute_dtype = {"float16": jnp.float16, "bfloat16": jnp.bfloat16,
                               "float32": jnp.float32}[self.config.precision_dtype]
         self.keep_master = self.compute_dtype != jnp.float32
-        if self.config.bf16.enabled and not self.config.bf16.master_weights:
+        self._pure_bf16 = (self.config.bf16.enabled
+                           and not self.config.bf16.master_weights)
+        if self._pure_bf16:
             # pure-bf16: params are the master, moments bf16 (config.py
-            # BF16Config.master_weights) — no fp32 state anywhere. Only
-            # Adam/AdamW implement the dtype round-trip (other optimizers
-            # keep fp32 state, which would silently triple the budget).
-            opt_t = (self.config.optimizer.type.lower().replace("_", "")
-                     if self.config.optimizer else "")
-            if opt_t not in ("adam", "adamw", "fusedadam"):
-                raise ValueError(
-                    "bf16.master_weights=false (pure-bf16 state) supports "
-                    f"Adam/AdamW only; got optimizer '{opt_t or None}'")
+            # BF16Config.master_weights) — no fp32 state anywhere.
+            # (validated against the RESOLVED optimizer below)
             self.keep_master = False
         # reference: data_types.grad_accum_dtype (config.py:907) — the dtype
         # microbatch grads accumulate in; fp32 default, bf16 halves the
         # accumulator footprint (update math stays f32 in _finalize_step)
         gad = (self.config.data_types.grad_accum_dtype or "fp32").lower()
-        self.grad_accum_dtype = {"fp32": jnp.float32, "float32": jnp.float32,
-                                 "bf16": jnp.bfloat16,
-                                 "bfloat16": jnp.bfloat16,
-                                 "fp16": jnp.float16,
-                                 "float16": jnp.float16}[gad]
+        _gad_map = {"fp32": jnp.float32, "float32": jnp.float32,
+                    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                    "fp16": jnp.float16, "float16": jnp.float16}
+        if gad not in _gad_map:
+            raise ValueError(
+                f"data_types.grad_accum_dtype '{gad}' is not supported; "
+                f"expected one of {sorted(_gad_map)}")
+        self.grad_accum_dtype = _gad_map[gad]
         fp16 = self.config.fp16
         self.loss_scaler = LossScaler(
             static_scale=fp16.loss_scale,
@@ -229,6 +227,15 @@ class DeepSpeedEngine:
         else:
             self.optimizer = None
             self.base_lr = 0.0
+        if self._pure_bf16 and (self.optimizer is None or
+                                self.optimizer.name not in ("adam", "adamw")):
+            # only Adam/AdamW implement the dtype round-trip; other
+            # optimizers keep fp32 state, which would silently triple the
+            # 6-bytes/param budget this mode exists for
+            raise ValueError(
+                "bf16.master_weights=false (pure-bf16 state) supports "
+                "Adam/AdamW only; got optimizer "
+                f"'{self.optimizer.name if self.optimizer else None}'")
 
         # lr schedule --------------------------------------------------------
         # lr_fn (step->lr, evaluated in-jit) when we own the schedule; an
@@ -343,11 +350,12 @@ class DeepSpeedEngine:
                 lambda m: jax.tree.map(lambda x: x.astype(self.compute_dtype), m),
                 out_shardings=self.param_shardings)(master)
         else:
-            # fp32 (params are f32) or pure-bf16 (cast down; no master)
-            params = jax.device_put(
-                jax.tree.map(lambda x: x.astype(self.compute_dtype),
-                             params_f32),
-                self.param_shardings)
+            # fp32 (params are f32 already — no transient host copy) or
+            # pure-bf16 (cast down; no master)
+            cast = (params_f32 if self.compute_dtype == jnp.float32
+                    else jax.tree.map(
+                        lambda x: x.astype(self.compute_dtype), params_f32))
+            params = jax.device_put(cast, self.param_shardings)
             master = ()
         opt_state = {}
         if self.onebit is not None:
@@ -889,6 +897,8 @@ class DeepSpeedEngine:
 
     __call__ = forward
 
+    _warned_micro_api = False
+
     def backward(self, loss=None):
         """Compute + accumulate grads for the last forward's microbatch
         (reference: engine.backward scales by 1/gas and fires reduction hooks;
@@ -902,6 +912,13 @@ class DeepSpeedEngine:
                 "on a multi-rank mesh — use train_batch()")
         if not hasattr(self, "_pending") or self._pending is None:
             raise RuntimeError("backward() called before forward()")
+        if not DeepSpeedEngine._warned_micro_api:
+            DeepSpeedEngine._warned_micro_api = True
+            logger.warning(
+                "forward()/backward()/step() on TPU re-runs the forward "
+                "inside backward (~1.5x the FLOPs of the fused path) — "
+                "prefer engine.train_batch(batch), which compiles the whole "
+                "gas loop into one step")
         batch, rng, loss_val, params_dev = self._pending
         self._pending = None
         if params_dev is None:

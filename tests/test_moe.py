@@ -122,3 +122,44 @@ def test_moe_transformer_trains():
     assert qshape.shape[1] == 4
     losses = [float(engine.train_batch(mk())["loss"]) for _ in range(8)]
     assert losses[-1] < losses[0], losses
+
+
+def test_moe_with_tp_composes():
+    """MoE under tensor parallelism: in SPMD, TP-replicated tokens gate
+    identically on every model-rank (same logits, same rng), so there are no
+    duplicate-token semantics to fix up — the role of the reference's
+    moe/mappings.py:27-108 (gather/drop of TP-duplicated tokens) dissolves
+    into sharding propagation.  Proof: a tp=2 x ep=2 run tracks the tp=1 x
+    ep=2 run loss for loss."""
+    require_devices(4)
+
+    def make(tp):
+        model, cfg = build_model("gpt2-tiny", hidden_size=64, num_layers=2,
+                                 num_heads=4, vocab_size=256, max_seq_len=64,
+                                 moe_experts=4, moe_capacity_factor=2.0,
+                                 attention_impl="reference", dtype=jnp.float32)
+        config = {
+            # same global batch + gas for both runs: tp=1 has dp=8 (micro 2),
+            # tp=2 has dp=4 (micro 4)
+            "train_batch_size": 16,
+            "train_micro_batch_size_per_gpu": 4 if tp > 1 else 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+            "zero_optimization": {"stage": 1},
+            "moe": {"enabled": True, "ep_size": 2},
+            "seed": 5,
+        }
+        if tp > 1:
+            config["tensor_parallel"] = {"tp_size": tp}
+        engine, *_ = ds.initialize(model=model, config=config,
+                                   loss_fn=make_moe_loss(cfg.moe_aux_weight),
+                                   example_batch={"input_ids": np.zeros((16, 32), np.int64)},
+                                   sharding_rules=cfg.tp_rules())
+        return engine
+
+    e1, e2 = make(1), make(2)
+    rng = np.random.default_rng(7)
+    for i in range(6):
+        b = {"input_ids": rng.integers(0, 256, size=(16, 32))}
+        l1 = float(e1.train_batch(b)["loss"])
+        l2 = float(e2.train_batch(b)["loss"])
+        assert abs(l1 - l2) < 5e-3 + 0.01 * abs(l1), (i, l1, l2)
