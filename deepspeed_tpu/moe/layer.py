@@ -41,6 +41,23 @@ def _constrain(x, *spec):
         return x
 
 
+def _warn_ungrouped_fallback(T: int, g: int) -> None:
+    """Once-per-(T, g) signal that the dispatch dropped to the ungrouped
+    (G=1) layout: token count not divisible by the data*expert*seq mesh
+    product reverts to the rematerialization-prone path, and a silent
+    fallback makes the resulting perf regression undiagnosable from logs."""
+    import jax as _jax
+    if _jax.process_index() != 0:
+        return
+    from ..utils.logging import warning_once
+    warning_once(
+        f"MoE grouped dispatch disabled: tokens-per-step {T} is not "
+        f"divisible by the data*expert*seq mesh product {g}; falling back "
+        "to the ungrouped GShard layout, which may trigger involuntary "
+        "rematerialization reshards. Pad batch*seq to a multiple of the "
+        "mesh product to restore the grouped layout.")
+
+
 class _Gate(nn.Module):
     """Router projection with the kernel pinned replicated.
 
@@ -119,6 +136,8 @@ class MoE(nn.Module):
             g = (mm.shape["data"] * mm.shape["expert"] * mm.shape["seq"])
             if T % g == 0:
                 G = g
+            elif g > 1:
+                _warn_ungrouped_fallback(T, g)
         Tg = T // G
 
         tokens_g = _constrain(tokens.reshape(G, Tg, H),

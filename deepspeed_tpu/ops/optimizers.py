@@ -34,10 +34,17 @@ class Optimizer(NamedTuple):
 def _tree_zeros_like(params, dtype=None):
     """Moment buffers: fp32 masters get fp32 moments (the usual mixed-
     precision shape); pure-bf16 params get bf16 moments (6 bytes/param of
-    optimizer state — see BF16Config.master_weights)."""
+    optimizer state — see BF16Config.master_weights).  The param-dtype
+    inheritance is deliberately limited to bf16: a direct caller passing
+    fp16 params (outside the engine's master-weights flow) still gets fp32
+    moments — fp16 moment accumulation is never a supported mode."""
+    def moment_dtype(p):
+        if dtype is not None:
+            return dtype
+        return (jnp.bfloat16 if getattr(p, "dtype", None) == jnp.bfloat16
+                else jnp.float32)
     return jax.tree.map(
-        lambda p: jnp.zeros(p.shape, dtype or getattr(p, "dtype", jnp.float32)),
-        params)
+        lambda p: jnp.zeros(p.shape, moment_dtype(p)), params)
 
 
 def adam(lr: float = 1e-3,

@@ -164,7 +164,11 @@ def load_sharded_tree(ckpt_dir: str, base: str, like, shardings=None,
     if expected_shards is not None and len(files) != expected_shards:
         raise FileNotFoundError(
             f"incomplete sharded checkpoint: found {len(files)} {base} "
-            f"shard files under {ckpt_dir}, expected {expected_shards}")
+            f"shard files under {ckpt_dir}, expected {expected_shards}. "
+            "Multi-process restore requires every host's shard files in ONE "
+            "directory (a shared filesystem, or per-host dirs rsynced "
+            "together before load) — per-host local save dirs that were "
+            "never merged produce exactly this error.")
     handles = [np.load(f) for f in files]
     try:
         merged: Dict[str, Tuple[int, Dict]] = {}    # key -> [(h_idx, piece)]

@@ -260,6 +260,12 @@ class OneBitRunner:
             new_vf.append(vf)
             new_lf.append(factor)
 
+        # commit the replicated layout of the frozen-phase m: without this
+        # pin, XLA's layout choice under ZeRO-1 may re-shard m and pay a
+        # re-gather every step (the docstring's "one all-gather at the
+        # transition" contract)
+        rep = NamedSharding(self.mesh, P())
+        new_m = [jax.lax.with_sharding_constraint(m, rep) for m in new_m]
         out = dict(state,
                    m=treedef.unflatten(new_m),
                    w_err=treedef.unflatten(new_we),
