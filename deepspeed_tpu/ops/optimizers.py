@@ -303,6 +303,134 @@ def lamb_frozen_leaf(p32, m_old, m_comp, v, vf, lf, *, b1, b2, eps,
     return upd, factor, vf_new
 
 
+def zero_one_adam(lr: float = 1e-3,
+                  betas: Tuple[float, float] = (0.9, 0.999),
+                  eps: float = 1e-8,
+                  weight_decay: float = 0.0,
+                  var_freeze_step: int = 100000,
+                  var_update_scaler: int = 16,
+                  local_step_scaler: int = 32678,
+                  local_step_clipper: int = 16) -> Optimizer:
+    """0/1 Adam (reference: runtime/fp16/onebit/zoadam.py:11-377; paper
+    arXiv:2202.06009). A DIFFERENT algorithm from 1-bit Adam:
+
+    * Variance phase (step <= var_freeze_step): v updates only at steps
+      where ``step % var_interval == 0`` — the interval DOUBLES after every
+      ``var_update_scaler`` v-updates (kappa in the paper), so v freezes
+      gradually. v-steps use the exact gradient; in between, the gradient is
+      1-bit compressed with error feedback before entering the momentum.
+    * Local-step phase (step > var_freeze_step): updates are purely local;
+      the parameter deltas accumulate in ``u`` and only at interval
+      boundaries (``step % local_interval == 0``) is the accumulated
+      momentum exchanged (compressed) and parameters resynced; the local
+      interval doubles every ``local_step_scaler`` steps up to
+      ``local_step_clipper`` (H in the paper).
+
+    No bias correction anywhere (the reference's update is
+    m / (sqrt(v) + eps) + wd * p). This functional form reproduces the
+    multi-rank dynamics at dp=1 by compressing locally (like
+    ``onebit_adam`` above); the real cross-rank exchanges live in
+    runtime/zeroone.ZeroOneRunner. Interval counters ride in the state as
+    scalars, exactly the reference's per-param ``var_interval`` /
+    ``local_step_interval`` bookkeeping.
+
+    Because ``step`` is traced, both phases' math (including the unused
+    phase's compression) executes every step behind ``jnp.where`` — a
+    bounded ~2x on the optimizer's elementwise cost, dwarfed by fwd/bwd.
+    The ZeroOneRunner dispatches four separate compiled programs host-side
+    and pays none of this; it is the dp>1 performance path."""
+    b1, b2 = betas
+    from .quantizer import onebit_compress, onebit_decompress
+
+    def comp(x):
+        signs, scale = onebit_compress(x)
+        return onebit_decompress(signs, scale)
+
+    def init(params):
+        scalar = lambda v, dt: jnp.asarray(v, dt)
+        return {"m": _tree_zeros_like(params, jnp.float32),
+                "v": _tree_zeros_like(params, jnp.float32),
+                "u": _tree_zeros_like(params, jnp.float32),
+                "comp_err": _tree_zeros_like(params, jnp.float32),
+                "var_interval": scalar(1, jnp.int32),
+                "var_counter": scalar(0, jnp.int32),
+                "local_interval": scalar(1, jnp.int32),
+                "local_counter": scalar(0, jnp.int32),
+                "lrs": scalar(0.0, jnp.float32)}
+
+    def update(grads, state, params, step, lr_t=None):
+        lr_eff = jnp.asarray(lr if lr_t is None else lr_t, jnp.float32)
+        t = step.astype(jnp.int32) + 1
+        in_local = t > var_freeze_step
+        first_local = t == (var_freeze_step + 1)
+        iv = state["var_interval"]
+        li = state["local_interval"]
+        is_v = (~in_local) & (t % iv == 0)
+        is_b = in_local & (t % li == 0)
+        lrs_new = jnp.where(in_local, state["lrs"] + lr_eff, state["lrs"])
+
+        def leaf(g, m, v, u, err, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            # error buffers restart at the phase transition (reference:
+            # reinitial_error_buffer — grad-metric residue must not leak
+            # into the accumulated-momentum exchange)
+            err = jnp.where(first_local, 0.0, err)
+            # -- variance phase
+            g_cin = g + err
+            g_c = comp(g_cin)
+            g_eff = jnp.where(is_v, g, g_c)
+            m_var = b1 * m + (1.0 - b1) * g_eff
+            v_var = jnp.where(is_v, b2 * v + (1.0 - b2) * g * g, v)
+            # -- local phase (momentum from the raw local grad)
+            m_new = jnp.where(in_local, b1 * m + (1.0 - b1) * g, m_var)
+            v_new = jnp.where(in_local, v, v_var)
+            denom = jnp.sqrt(v_new) + eps
+            upd = m_new / denom + weight_decay * p32
+            p_upd = p32 - lr_eff * upd
+            u_upd = u - lr_eff * upd
+            # -- boundary: undo the local drift, exchange it in momentum
+            # units, reapply the synced drift, recover the averaged momentum
+            base = p_upd - u_upd
+            u_cin = u_upd * denom + err
+            u_c = comp(u_cin)
+            m_bnd = -u_c / lrs_new
+            p_bnd = base + u_c / denom
+            p_out = jnp.where(is_b, p_bnd, p_upd)
+            m_out = jnp.where(is_b, m_bnd, m_new)
+            u_out = jnp.where(is_b, 0.0,
+                              jnp.where(in_local, u_upd, u))
+            err_out = jnp.where(
+                in_local,
+                jnp.where(is_b, u_cin - u_c, err),
+                jnp.where(is_v, err, g_cin - g_c))
+            return (p_out.astype(p.dtype), m_out, v_new, u_out, err_out)
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat = [leaf(g, m, v, u, e, p) for g, m, v, u, e, p in zip(
+            treedef.flatten_up_to(grads), treedef.flatten_up_to(state["m"]),
+            treedef.flatten_up_to(state["v"]),
+            treedef.flatten_up_to(state["u"]),
+            treedef.flatten_up_to(state["comp_err"]), flat_p)]
+        unf = lambda i: treedef.unflatten([o[i] for o in flat])
+
+        # interval bookkeeping (reference zoadam.py:283-303)
+        vc1 = state["var_counter"] + is_v.astype(jnp.int32)
+        double = (~in_local) & (vc1 == var_update_scaler)
+        lc1 = state["local_counter"] + in_local.astype(jnp.int32)
+        grow = in_local & (lc1 == local_step_scaler)
+        return unf(0), {
+            "m": unf(1), "v": unf(2), "u": unf(3), "comp_err": unf(4),
+            "var_interval": jnp.where(double, iv * 2, iv),
+            "var_counter": jnp.where(double, 0, vc1),
+            "local_interval": jnp.where(
+                grow, jnp.minimum(local_step_clipper, li * 2), li),
+            "local_counter": jnp.where(grow, 0, lc1),
+            "lrs": jnp.where(is_b, 0.0, lrs_new)}
+
+    return Optimizer(init, update, "zerooneadam")
+
+
 def onebit_lamb(lr: float = 1e-3,
                 betas: Tuple[float, float] = (0.9, 0.999),
                 eps: float = 1e-8,
@@ -395,7 +523,7 @@ _REGISTRY: Dict[str, Callable[..., Optimizer]] = {
     "sgd": sgd,
     "adagrad": adagrad,
     "onebitadam": onebit_adam,
-    "zerooneadam": onebit_adam,
+    "zerooneadam": zero_one_adam,
     "onebitlamb": onebit_lamb,
 }
 

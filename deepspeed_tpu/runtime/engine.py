@@ -321,16 +321,30 @@ class DeepSpeedEngine:
                 raise ValueError(
                     "compression_training is not threaded through the 1-bit "
                     "explicit-collective step yet — disable one of the two")
-            from .onebit import OneBitRunner
-            self.onebit = OneBitRunner(
-                "lamb" if "lamb" in opt_key else "adam",
-                opt_cfg.params, self.mesh, "data",
-                self.apply_fn, self.loss_fn,
-                self.config.gradient_accumulation_steps,
-                compute_dtype=self.compute_dtype,
-                grad_clip=self.config.gradient_clipping,
-                loss_scaler=self.loss_scaler,
-                zero_stage=stage)
+            if opt_key == "zerooneadam":
+                # 0/1 Adam is a DIFFERENT algorithm from 1-bit Adam
+                # (adaptive variance freezing + 1-bit sync with local
+                # steps, reference onebit/zoadam.py) — own runner
+                from .zeroone import ZeroOneRunner
+                self.onebit = ZeroOneRunner(
+                    opt_cfg.params, self.mesh, "data",
+                    self.apply_fn, self.loss_fn,
+                    self.config.gradient_accumulation_steps,
+                    compute_dtype=self.compute_dtype,
+                    grad_clip=self.config.gradient_clipping,
+                    loss_scaler=self.loss_scaler,
+                    zero_stage=stage)
+            else:
+                from .onebit import OneBitRunner
+                self.onebit = OneBitRunner(
+                    "lamb" if "lamb" in opt_key else "adam",
+                    opt_cfg.params, self.mesh, "data",
+                    self.apply_fn, self.loss_fn,
+                    self.config.gradient_accumulation_steps,
+                    compute_dtype=self.compute_dtype,
+                    grad_clip=self.config.gradient_clipping,
+                    loss_scaler=self.loss_scaler,
+                    zero_stage=stage)
 
         # device placement of state -----------------------------------------
         # fp32 training: params ARE the master copy — TrainState.master is kept

@@ -296,3 +296,152 @@ def test_onebit_zero1_composes():
     for l in jax.tree.leaves(v_after):
         if l.ndim >= 1 and l.shape[0] % 8 == 0:
             assert l.sharding.spec == P("data"), l.sharding
+
+
+# -- 0/1 Adam (the real algorithm, not the round-3 onebit alias) --------------
+
+
+class _SmoothModel:
+    """tanh MLP factory: every parameter sees a nonzero gradient each step —
+    the healthy regime for sign-compression (elements with exactly-zero grad
+    AND zero variance would receive +-scale momentum over eps, a property
+    the reference algorithm shares)."""
+
+    def __new__(cls):
+        import flax.linen as nn
+
+        class M(nn.Module):
+            hidden: int = 32
+            nclass: int = 8
+
+            @nn.compact
+            def __call__(self, batch, train=False):
+                x, y = batch["x"], batch["y"]
+                h = nn.tanh(nn.Dense(self.hidden)(x))
+                h = nn.tanh(nn.Dense(self.hidden)(h))
+                logits = nn.Dense(self.nclass)(h)
+                logp = jax.nn.log_softmax(logits)
+                return -jnp.mean(jnp.sum(
+                    jax.nn.one_hot(y, self.nclass) * logp, -1))
+
+        return M()
+
+
+def _zeroone_config(**params):
+    p = {"lr": 2e-3, "var_freeze_step": 12, "var_update_scaler": 4,
+         "local_step_scaler": 4, "local_step_clipper": 4,
+         "weight_decay": 0.01}
+    p.update(params)
+    return {"train_batch_size": 16,
+            "optimizer": {"type": "ZeroOneAdam", "params": p},
+            "seed": 7}
+
+
+def test_zeroone_alias_removed():
+    """'ZeroOneAdam' must resolve to the real 0/1 Adam algorithm, not an
+    alias of onebit_adam (round-3 Missing #2)."""
+    from deepspeed_tpu.ops.optimizers import build_optimizer
+    zo = build_optimizer("ZeroOneAdam", {"lr": 1e-2})
+    assert zo.name == "zerooneadam"
+    ob = build_optimizer("OneBitAdam", {"lr": 1e-2})
+    params = {"w": jnp.ones((8,), jnp.float32)}
+    # 0/1 Adam state carries the interval machinery 1-bit Adam doesn't have
+    st = zo.init(params)
+    assert "var_interval" in st and "local_interval" in st and "u" in st
+    assert "var_interval" not in ob.init(params)
+
+
+def test_zeroone_interval_doubling():
+    """The variance-update interval doubles after every var_update_scaler
+    v-updates, v is untouched between v-steps and frozen after
+    var_freeze_step; the local-step interval doubles every local_step_scaler
+    steps up to local_step_clipper (reference zoadam.py:283-303)."""
+    from deepspeed_tpu.ops.optimizers import zero_one_adam
+    opt = zero_one_adam(lr=1e-2, var_freeze_step=6, var_update_scaler=2,
+                        local_step_scaler=3, local_step_clipper=4)
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    st = opt.init(params)
+    rng = np.random.RandomState(0)
+    p = params
+    v_hist, iv_hist, li_hist, u_zero = [], [], [], []
+    for t in range(16):
+        g = {"w": jnp.asarray(rng.randn(4), jnp.float32)}
+        p, st = opt.update(g, st, p, jnp.asarray(t, jnp.int32))
+        v_hist.append(np.asarray(st["v"]["w"]).copy())
+        iv_hist.append(int(st["var_interval"]))
+        li_hist.append(int(st["local_interval"]))
+        u_zero.append(float(jnp.abs(st["u"]["w"]).sum()) == 0.0)
+    # kappa=2: steps 1,2 at interval 1 -> doubles; v-steps 4, 6 -> doubles
+    assert iv_hist == [1, 2, 2, 2, 2, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4]
+    # v changes exactly at steps 1,2,4,6 (indices 0,1,3,5), frozen afterwards
+    changed = [True] + [not np.array_equal(v_hist[i], v_hist[i - 1])
+                        for i in range(1, 16)]
+    assert changed == [True, True, False, True, False, True] + [False] * 10
+    # local phase from step 7: interval 1 for 3 steps, then 2, then 4 (clip)
+    assert li_hist[5] == 1 and li_hist[8] == 2 and li_hist[11] == 4
+    assert li_hist[15] == 4  # clipper caps further doubling
+    # u resets exactly at boundaries (step % interval == 0)
+    assert u_zero[9] and not u_zero[10] and u_zero[11]  # li=2: steps 10,11,12
+
+
+def test_zeroone_differs_from_onebit():
+    """0/1 Adam and 1-bit Adam are different algorithms: same grads, same
+    shared hyperparameters, different trajectories."""
+    from deepspeed_tpu.ops.optimizers import onebit_adam, zero_one_adam
+    zo = zero_one_adam(lr=1e-2, var_freeze_step=6, var_update_scaler=2,
+                       local_step_scaler=3, local_step_clipper=4)
+    ob = onebit_adam(lr=1e-2, freeze_step=6)
+    params = {"w": jnp.asarray(np.random.RandomState(0).randn(64),
+                               jnp.float32)}
+    s_zo, s_ob = zo.init(params), ob.init(params)
+    p_zo = p_ob = params
+    for t in range(12):
+        g = {"w": jnp.asarray(np.random.RandomState(100 + t).randn(64),
+                              jnp.float32)}
+        p_zo, s_zo = zo.update(g, s_zo, p_zo, jnp.asarray(t, jnp.int32))
+        p_ob, s_ob = ob.update(g, s_ob, p_ob, jnp.asarray(t, jnp.int32))
+    diff = float(jnp.abs(p_zo["w"] - p_ob["w"]).max())
+    assert diff > 1e-4, "0/1 Adam produced 1-bit Adam's trajectory"
+    assert np.all(np.isfinite(np.asarray(p_zo["w"])))
+
+
+def test_zeroone_engine_program_schedule():
+    """The engine must dispatch the right compiled program per step: exact
+    v-steps and compressed steps interleaved per the doubling interval in
+    the variance phase, local/boundary steps after the freeze."""
+    engine, *_ = ds.initialize(model=_SmoothModel(),
+                               example_batch=random_batch(16),
+                               config=_zeroone_config(
+                                   var_freeze_step=8, var_update_scaler=2,
+                                   local_step_scaler=4, local_step_clipper=4))
+    from deepspeed_tpu.runtime.zeroone import ZeroOneRunner
+    assert isinstance(engine.onebit, ZeroOneRunner)
+    keys = [engine.onebit.program_key(t) for t in range(14)]
+    assert keys == ["vstep", "vstep", "cstep", "vstep", "cstep", "vstep",
+                    "cstep", "vstep",
+                    "boundary", "boundary", "boundary", "boundary",
+                    "local", "boundary"]
+
+
+def test_zeroone_trains_and_local_steps_are_collective_free():
+    """End-to-end: 0/1 Adam trains through all four program kinds, and the
+    HLO of the local-step program contains ZERO cross-replica collective
+    bytes — the algorithm's whole point (1-bit sync with local steps)."""
+    engine, *_ = ds.initialize(model=_SmoothModel(),
+                               example_batch=random_batch(16),
+                               config=_zeroone_config())
+    losses = [float(engine.train_batch(random_batch(16, seed=i))["loss"])
+              for i in range(40)]
+    assert np.all(np.isfinite(losses))
+    assert np.mean(losses[-6:]) < losses[0]
+
+    micros = jax.tree.map(lambda x: jnp.asarray(x)[None], random_batch(16))
+    rng = jax.random.PRNGKey(0)
+    params = engine.state.params
+    st = engine.state.opt_state["onebit"]
+    audit = {k: engine.onebit.collective_bytes(params, st, micros, rng, k)
+             for k in ("vstep", "cstep", "local", "boundary")}
+    assert audit["local"] == 0, audit
+    # compressed steps move far fewer bytes than the exact v-step
+    assert audit["cstep"] * 3 <= audit["vstep"], audit
+    assert audit["boundary"] * 3 <= audit["vstep"], audit
