@@ -539,6 +539,10 @@ def build_optimizer(opt_type: str, params: Optional[dict] = None) -> Optimizer:
     kwargs.pop("torch_adam", None)
     kwargs.pop("adam_w_mode", None)
     if key in ("onebitadam", "zerooneadam", "onebitlamb"):
-        kwargs.pop("cuda_aware", None)
-        kwargs.pop("comm_backend_name", None)
+        # reference compat knobs with no TPU meaning — accepted (and popped)
+        # by the multi-rank runners too, so a config stays portable between
+        # single-chip (this functional path) and multi-chip topologies
+        for k in ("cuda_aware", "comm_backend_name", "bias_correction",
+                  "amsgrad", "eps_inside_sqrt", "max_grad_norm"):
+            kwargs.pop(k, None)
     return _REGISTRY[key](**kwargs)

@@ -486,6 +486,12 @@ class ZeroOneRunner:
                            and self.loss_scaler.enabled
                            else LossScaleState.identity())
         key = self.program_key(global_step)
+        if key in ("vstep", "cstep"):
+            # back in the variance phase (e.g. a pre-freeze checkpoint was
+            # restored after the freeze had been crossed) — re-arm the
+            # transition so re-crossing var_freeze_step re-broadcasts m and
+            # resets the error buffers
+            self._transitioned = False
         if key in ("local", "boundary") and not self._transitioned:
             if global_step == self.var_freeze_step:
                 state = self._transition(state)
