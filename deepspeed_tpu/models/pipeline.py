@@ -9,6 +9,12 @@ construction). The apply path differs: blocks are reshaped [L,...] ->
 and head run replicated on every pipe rank (redundant compute, zero
 communication — tied-embedding gradients need no ReduceTiedGrads step, unlike
 the reference's tied-weight allreduce, pipe/engine.py _exec_reduce_tied_grads).
+
+Per-micro side inputs generalize both executors (round-3 Missing #3):
+attention masks and dropout rng keys ride next to the activations; the rng
+for a (micro, stage, layer) is fold_in(fold_in(fold_in(base, micro), stage),
+layer) in BOTH the gpipe and 1F1B paths, so the two schedules produce
+bit-identical dropout masks and their grads stay comparable.
 """
 
 from __future__ import annotations
@@ -27,28 +33,38 @@ from .transformer import Block, Transformer, TransformerConfig
 PyTree = Any
 
 
+def _pad_mask(attention_mask):
+    """[B, S] padding mask -> [B, 1, 1, S] boolean attention mask (matches
+    models/transformer.Transformer's mask construction)."""
+    if attention_mask is None:
+        return None
+    return attention_mask.astype(jnp.bool_)[:, None, None, :]
+
+
 class PipelinedTransformer:
     """Engine-compatible model object (init/apply) that pipelines its blocks.
 
     n_micro: microbatches fed through the pipeline per train step (the
     reference's gradient_accumulation_steps == pipeline micro_batches,
     engine.py:  micro_batches = gas).
+    backward: '1F1B' backward mode — 'recompute' (default; stage body re-run
+    from the saved input, nothing but boundaries stored) or 'store' (vjp
+    residuals ride the rings; no recompute, more live memory).
     """
 
     def __init__(self, cfg: TransformerConfig, pp: int, n_micro: int,
-                 mesh=None):
+                 mesh=None, backward: str = "recompute"):
         if cfg.num_layers % pp != 0:
             raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
                              f"pp {pp}")
-        if cfg.dropout != 0.0:
-            raise NotImplementedError("pipelined path does not thread dropout "
-                                      "rngs yet; set dropout=0")
-        # MoE + PP: the MoE aux loss rides the pipe as a scalar side channel
-        # next to the activations (spmd.pipeline_apply with_aux)
+        if backward not in ("recompute", "store"):
+            raise ValueError(f"backward must be recompute|store, "
+                             f"got {backward!r}")
         self.cfg = cfg
         self.pp = pp
         self.n_micro = n_micro
         self.mesh = mesh
+        self.backward = backward
         # reference model for param init: identical param structure
         self._ref = Transformer(
             cfg if cfg.scan_layers else
@@ -62,6 +78,65 @@ class PipelinedTransformer:
     def init(self, rng, batch, **kwargs):
         return self._ref.init(rng, batch, **kwargs)
 
+    def _parse_batch(self, batch):
+        if isinstance(batch, dict):
+            return (batch["input_ids"], batch.get("attention_mask"),
+                    batch.get("labels"))
+        return batch, None, None
+
+    def _micro_extras(self, attention_mask, rng, train, B, S):
+        """Per-micro side-input pytree for the executors: padding masks and
+        per-micro dropout rng keys (folded further per stage and layer
+        inside the stage body)."""
+        cfg = self.cfg
+        extras = {}
+        if attention_mask is not None:
+            extras["mask"] = attention_mask.reshape(
+                self.n_micro, B // self.n_micro, S)
+        if train and cfg.dropout > 0.0:
+            if rng is None:
+                raise ValueError("dropout>0 training needs an rng")
+            extras["rng"] = jax.vmap(
+                lambda i: jax.random.fold_in(rng, i))(
+                    jnp.arange(self.n_micro))
+        return extras
+
+    def _block_stage_fn(self, train):
+        """stage_fn(block_stack, h, extra, stage) for both executors."""
+        cfg = self.cfg
+        moe = cfg.moe_experts > 0
+        dropout = train and cfg.dropout > 0.0
+
+        def stage_fn(block_stack, h, extra, stage):
+            mask = _pad_mask(extra.get("mask")) \
+                if isinstance(extra, dict) else None
+            stage_rng = (jax.random.fold_in(extra["rng"], stage)
+                         if dropout else None)
+            n_layers = jax.tree.leaves(block_stack)[0].shape[0]
+
+            def layer(carry, xs):
+                h, li = carry
+                p = xs
+                rngs = {}
+                if dropout:
+                    rngs["dropout"] = jax.random.fold_in(stage_rng, li)
+                if moe and stage_rng is not None:
+                    # top-2 gating's Gumbel second pick; noise-free gating
+                    # without a per-micro rng (the pre-round-4 behavior)
+                    rngs["gating"] = jax.random.fold_in(stage_rng, 1000 + li)
+                out, aux = self._block.apply(
+                    {"params": p}, h, mask, train,
+                    rngs=rngs or None)
+                return (out, li + 1), aux
+
+            (h, _), auxes = jax.lax.scan(
+                layer, (h, jnp.zeros((), jnp.int32)), block_stack)
+            if moe:
+                return h, jnp.sum(auxes)
+            return h
+
+        return stage_fn
+
     def apply(self, variables, batch, train: bool = False, rngs=None,
               mesh=None):
         params = variables["params"]
@@ -70,15 +145,16 @@ class PipelinedTransformer:
         if mesh is None:
             from ..parallel.mesh import get_global_mesh
             mesh = get_global_mesh().mesh
-        if isinstance(batch, dict) and batch.get("attention_mask") is not None:
-            raise NotImplementedError(
-                "PipelinedTransformer does not thread attention_mask through "
-                "the pipe loop yet; pad-free batches only (use pp=1 for "
-                "masked batches)")
-        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        input_ids, attention_mask, _ = self._parse_batch(batch)
         B, S = input_ids.shape
         if B % self.n_micro != 0:
             raise ValueError(f"batch {B} not divisible by n_micro {self.n_micro}")
+        if isinstance(rngs, dict):
+            base_rng = rngs.get("dropout")
+            if base_rng is None:
+                base_rng = rngs.get("params")
+        else:
+            base_rng = rngs
 
         wte = params["wte"]["embedding"]            # [V, H] fp32
         wpe = params["wpe"]["embedding"]            # [T, H]
@@ -92,19 +168,12 @@ class PipelinedTransformer:
         stage_params = stack_stage_params(params["blocks"], self.pp)
 
         moe = cfg.moe_experts > 0
-
-        def stage_fn(block_stack, h):
-            # scan this stage's L/pp blocks (same compiled body per layer)
-            def layer(carry, p):
-                out, aux = self._block.apply({"params": p}, carry, None, train)
-                return out, aux
-            h, auxes = jax.lax.scan(layer, h, block_stack)
-            if moe:
-                return h, jnp.sum(auxes)
-            return h
+        extras = self._micro_extras(attention_mask, base_rng, train, B, S)
+        stage_fn = self._block_stage_fn(train)
 
         res = pipeline_apply(stage_fn, stage_params, micros, mesh=mesh,
-                             pp=self.pp, remat=cfg.remat, with_aux=moe)
+                             pp=self.pp, remat=cfg.remat, with_aux=moe,
+                             extras=extras)
         outs, aux_total = res if moe else (res, None)
         # head runs per-micro; only the fp32 logits are reshaped back to the
         # flat batch (fp32 resharding avoids the bf16 SPMD copy bug above)
@@ -120,27 +189,32 @@ class PipelinedTransformer:
 
     # -- 1F1B training path --------------------------------------------------
 
-    def train_value_and_grad(self, params, batch, mesh=None):
-        """Causal-LM loss + grads via the hand-scheduled 1F1B executor
+    def train_value_and_grad(self, params, batch, mesh=None, rng=None,
+                             loss_scale=None, loss_fn=None, train=True,
+                             aux_weight=None):
+        """Loss + grads via the hand-scheduled 1F1B executor
         (runtime/pipe/one_f_one_b): activation memory ∝ pp (not n_micro) and
         the boundary stays bf16. Returns (loss, grads) with grads matching
-        the params tree. MoE models use the GPipe path (the aux side channel
-        is not threaded through the manual backward)."""
+        the params tree.
+
+        Accepts everything the gpipe path does (round-3 Missing #3 closed):
+        attention_mask batches, dropout (per-micro/stage/layer rng folding,
+        bit-identical to gpipe's), MoE (the aux scalar flows through the
+        manual backward via its constant cotangent), fp16 loss scaling
+        (``loss_scale`` seeds the backward; grads come out scaled for the
+        engine's standard unscale/overflow tail), and a custom last-stage
+        ``loss_fn(logits, micro_batch)`` (per-micro losses averaged over
+        micros — the reference's _aggregate_total_loss semantics).
+        """
         cfg = self.cfg
-        if cfg.moe_experts > 0:
-            raise NotImplementedError("1F1B + MoE: use pipeline schedule "
-                                      "'gpipe' for MoE models")
         mesh = mesh or self.mesh
         if mesh is None:
             from ..parallel.mesh import get_global_mesh
             mesh = get_global_mesh().mesh
         from ..runtime.pipe.one_f_one_b import pipeline_1f1b_value_and_grad
-        if isinstance(batch, dict) and batch.get("attention_mask") is not None:
-            raise NotImplementedError(
-                "1F1B does not thread attention_mask; pad-free batches only")
-        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
-        labels = (batch.get("labels", input_ids) if isinstance(batch, dict)
-                  else input_ids)
+        input_ids, attention_mask, labels = self._parse_batch(batch)
+        if labels is None:
+            labels = input_ids
         B, S = input_ids.shape
         mb = B // self.n_micro
         ids_micros = input_ids.reshape(self.n_micro, mb, S)
@@ -153,39 +227,74 @@ class PipelinedTransformer:
         micros, embed_vjp = jax.vjp(embed, params["wte"]["embedding"],
                                     params["wpe"]["embedding"])
         stage_params = stack_stage_params(params["blocks"], self.pp)
-
-        def stage_fn(block_stack, h):
-            def layer(carry, p):
-                out, _ = self._block.apply({"params": p}, carry, None, False)
-                return out, None
-            h, _ = jax.lax.scan(layer, h, block_stack)
-            return h
+        extras = self._micro_extras(attention_mask, rng, train, B, S)
+        stage_fn = self._block_stage_fn(train)
+        moe = cfg.moe_experts > 0
 
         head = {"ln_f": params["ln_f"], "wte": params["wte"]["embedding"]}
-        # global token mean: the executor averages per-micro losses, so each
-        # micro contributes its nll SUM scaled by n_micro/total_valid — with
-        # unevenly -100-masked micros a per-micro mean would overweight
-        # sparse ones vs the gpipe/causal_lm_loss objective
-        total_valid = jnp.maximum(
-            jnp.sum((lab_micros[:, :, 1:] != -100).astype(jnp.float32)), 1.0)
 
-        def loss_fn(head_p, y, lab):
-            h = self._ln_f.apply({"params": head_p["ln_f"]}, y)
-            logits = jnp.einsum("bsh,vh->bsv", h,
-                                head_p["wte"].astype(h.dtype))
-            logits = logits[:, :-1].astype(jnp.float32)
-            tgt = lab[:, 1:]
-            valid = tgt != -100
-            safe = jnp.where(valid, tgt, 0)
-            logz = jax.nn.logsumexp(logits, axis=-1)
-            gold = jnp.take_along_axis(logits, safe[..., None],
-                                       axis=-1)[..., 0]
-            nll_sum = jnp.sum((logz - gold) * valid)
-            return nll_sum * (self.n_micro / total_valid)
+        if loss_fn is None:
+            # default causal-LM objective with GLOBAL token mean: the
+            # executor averages per-micro losses, so each micro contributes
+            # its nll SUM scaled by n_micro/total_valid — with unevenly
+            # -100-masked micros a per-micro mean would overweight sparse
+            # ones vs the gpipe/causal_lm_loss objective
+            total_valid = jnp.maximum(
+                jnp.sum((lab_micros[:, :, 1:] != -100).astype(jnp.float32)),
+                1.0)
 
-        loss, gs, gh, dmicros = pipeline_1f1b_value_and_grad(
-            stage_fn, loss_fn, stage_params, head, micros, lab_micros,
-            mesh=mesh, pp=self.pp)
+            def head_loss(head_p, y, lab):
+                h = self._ln_f.apply({"params": head_p["ln_f"]}, y)
+                logits = jnp.einsum("bsh,vh->bsv", h,
+                                    head_p["wte"].astype(h.dtype))
+                logits = logits[:, :-1].astype(jnp.float32)
+                tgt = lab[:, 1:]
+                valid = tgt != -100
+                safe = jnp.where(valid, tgt, 0)
+                logz = jax.nn.logsumexp(logits, axis=-1)
+                gold = jnp.take_along_axis(logits, safe[..., None],
+                                           axis=-1)[..., 0]
+                nll_sum = jnp.sum((logz - gold) * valid)
+                return nll_sum * (self.n_micro / total_valid)
+
+            head_labels = lab_micros
+        else:
+            # custom objective: loss_fn(model_output, micro_batch) per
+            # micro, averaged over micros. EVERY [B, ...] leaf of the batch
+            # reshapes to [n_micro, mb, ...]; batch-independent leaves ride
+            # replicated per micro — the user's loss sees the same fields
+            # it would on the gpipe schedule.
+            def to_micros(leaf):
+                leaf = jnp.asarray(leaf)
+                if leaf.ndim >= 1 and leaf.shape[0] == B:
+                    return leaf.reshape((self.n_micro, mb) + leaf.shape[1:])
+                return jnp.broadcast_to(leaf[None],
+                                        (self.n_micro,) + leaf.shape)
+
+            micro_batches = (jax.tree.map(to_micros, batch)
+                             if isinstance(batch, dict)
+                             else {"input_ids": ids_micros,
+                                   "labels": lab_micros})
+
+            def head_loss(head_p, y, lab):
+                h = self._ln_f.apply({"params": head_p["ln_f"]}, y)
+                logits = jnp.einsum("bsh,vh->bsv", h,
+                                    head_p["wte"].astype(h.dtype))
+                out = logits.astype(jnp.float32)
+                return loss_fn(out, lab).astype(jnp.float32)
+
+            head_labels = micro_batches
+
+        aux_w = (aux_weight if aux_weight is not None
+                 else cfg.moe_aux_weight)
+        loss, aux, gs, gh, dmicros = pipeline_1f1b_value_and_grad(
+            stage_fn, head_loss, stage_params, head, micros,
+            lab_micros if loss_fn is None else head_labels,
+            mesh=mesh, pp=self.pp, extras=extras,
+            with_aux=moe,
+            aux_cotangent=(aux_w if moe else 0.0),
+            loss_scale=loss_scale,
+            store_outputs=(self.backward == "store"))
         dwte_embed, dwpe = embed_vjp(dmicros)
         grads = {
             "wte": {"embedding": dwte_embed + gh["wte"]},
@@ -193,6 +302,9 @@ class PipelinedTransformer:
             "blocks": unstack_stage_params(gs),
             "ln_f": gh["ln_f"],
         }
+        if moe:
+            # reported loss matches make_moe_loss: task + aux_weight * aux
+            loss = loss + aux_w * aux
         return loss, grads
 
     # -- sharding rules ------------------------------------------------------
@@ -218,6 +330,8 @@ class PipelinedTransformer:
 
 def build_pipelined_model(name_or_cfg, pp: int, n_micro: int, **overrides):
     from .transformer import get_config
+    backward = overrides.pop("backward", "recompute")
     cfg = (name_or_cfg if isinstance(name_or_cfg, TransformerConfig)
            else get_config(name_or_cfg, **overrides))
-    return PipelinedTransformer(cfg, pp=pp, n_micro=n_micro), cfg
+    return (PipelinedTransformer(cfg, pp=pp, n_micro=n_micro,
+                                 backward=backward), cfg)

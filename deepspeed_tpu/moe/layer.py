@@ -35,10 +35,11 @@ from .sharded_moe import compute_capacity, top1_gating, top2_gating
 
 
 def _constrain(x, *spec):
-    try:
-        return jax.lax.with_sharding_constraint(x, P(*spec))
-    except (ValueError, RuntimeError):   # no mesh in scope
-        return x
+    """Sharding constraint that works under plain jax.jit (resolved against
+    the session's global mesh) and inside shard_map contexts (bare spec) —
+    see models/transformer._spec_constraint for the rationale."""
+    from ..models.transformer import _spec_constraint
+    return _spec_constraint(x, P(*spec))
 
 
 def _warn_ungrouped_fallback(T: int, g: int) -> None:

@@ -539,10 +539,22 @@ def build_optimizer(opt_type: str, params: Optional[dict] = None) -> Optimizer:
     kwargs.pop("torch_adam", None)
     kwargs.pop("adam_w_mode", None)
     if key in ("onebitadam", "zerooneadam", "onebitlamb"):
-        # reference compat knobs with no TPU meaning — accepted (and popped)
-        # by the multi-rank runners too, so a config stays portable between
-        # single-chip (this functional path) and multi-chip topologies
-        for k in ("cuda_aware", "comm_backend_name", "bias_correction",
-                  "amsgrad", "eps_inside_sqrt", "max_grad_norm"):
-            kwargs.pop(k, None)
+        # transport knobs with no TPU meaning — popped so a config stays
+        # portable between single-chip (this functional path) and
+        # multi-chip (runner) topologies
+        kwargs.pop("cuda_aware", None)
+        kwargs.pop("comm_backend_name", None)
+        if kwargs.pop("amsgrad", False):
+            # reference parity: zoadam.py raises for amsgrad too
+            raise ValueError(f"{opt_type} does not support amsgrad")
+        # accepted-and-unused by the reference's own implementations
+        # (their step math never reads them) — warn so a user relying on
+        # them learns the truth instead of silently different numerics
+        for k in ("eps_inside_sqrt", "max_grad_norm", "bias_correction"):
+            if kwargs.pop(k, None):
+                from ..utils.logging import warning_once
+                warning_once(
+                    f"{opt_type}: '{k}' is accepted for config compatibility "
+                    "but has no effect (the reference's 1-bit/0-1 step math "
+                    "does not apply it either)")
     return _REGISTRY[key](**kwargs)

@@ -38,25 +38,35 @@ class PipelineEngine(DeepSpeedEngine):
                 "pipeline.schedule='1f1b' needs a model exposing "
                 "train_value_and_grad (models.pipeline.PipelinedTransformer); "
                 "this module only supports the gpipe schedule")
-        if use_1f1b and self.loss_scaler.enabled:
-            raise ValueError("pipeline schedule '1f1b' computes unscaled "
-                             "grads (no fp16 loss scaling); use bf16/fp32")
+        custom_loss = None
+        aux_weight = None
         if use_1f1b:
             from ..engine import _default_loss_fn
             from ...models.transformer import causal_lm_loss
-            if self.loss_fn not in (causal_lm_loss, _default_loss_fn):
-                raise ValueError(
-                    "pipeline.schedule='1f1b' computes the causal-LM loss at "
-                    "the last stage (labels from batch['labels']/input_ids); "
-                    "custom loss_fn needs the gpipe schedule")
+            lf = self.loss_fn
+            if getattr(lf, "_moe_loss", False):
+                # MoE losses split: the aux term is computed by the executor
+                # itself (the scalar rides the pipe); only the BASE task
+                # loss goes to the last stage
+                aux_weight = lf._moe_aux_weight
+                lf = lf._moe_base_loss
+            if lf not in (causal_lm_loss, _default_loss_fn):
+                # a user loss runs per-micro at the last stage (per-micro
+                # losses averaged — the reference _aggregate_total_loss)
+                custom_loss = lf
 
         def train_step(state, batch, rng, lr_arg):
             if use_1f1b:
                 # hand-scheduled interleave: loss+grads straight from the
                 # 1F1B executor (runtime/pipe/one_f_one_b), no AD through
-                # the pipeline scan
+                # the pipeline scan. fp16: the scale seeds the backward and
+                # grads come out scaled — _finalize_step's standard
+                # unscale/overflow tail applies.
                 loss, grads = self.module.train_value_and_grad(
-                    state.params, batch, mesh=self.mesh)
+                    state.params, batch, mesh=self.mesh, rng=rng,
+                    loss_scale=(state.scale.scale
+                                if self.loss_scaler.enabled else None),
+                    loss_fn=custom_loss, aux_weight=aux_weight)
             else:
                 def scaled_loss(p):
                     out = self.apply_fn(p, batch, rng, True)

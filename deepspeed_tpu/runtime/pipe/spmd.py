@@ -32,7 +32,7 @@ from jax.sharding import PartitionSpec as P
 PyTree = Any
 
 
-def pipeline_apply(stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
+def pipeline_apply(stage_fn: Callable,
                    stage_params: PyTree,
                    micros: jnp.ndarray,
                    *,
@@ -40,35 +40,45 @@ def pipeline_apply(stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
                    pp: int,
                    remat: bool = False,
                    pipe_axis: str = "pipe",
-                   with_aux: bool = False):
+                   with_aux: bool = False,
+                   extras: PyTree = None):
     """Run stacked pipeline stages over microbatches.
 
-    stage_fn(params_of_one_stage, x) -> y   applies ONE stage's layer stack
+    stage_fn(params_of_one_stage, x, extra, stage_idx) -> y   applies ONE
+      stage's layer stack. ``extra`` is the per-micro slice of ``extras``
+      (attention masks, dropout rng keys, ... — {} when extras is None);
+      ``stage_idx`` is the rank's pipe index (for rng folding).
       (with_aux=True: -> (y, aux_scalar) — a per-stage additive side channel
       e.g. the MoE load-balance loss; aux rides the pipe next to the
       activations and sums across stages per microbatch)
     stage_params: pytree with leading dim pp on every leaf (sharded over pipe)
     micros: [n_micro, micro_batch, ...] activations entering stage 0
+    extras: optional pytree of [n_micro, ...] per-micro side inputs
     returns [n_micro, micro_batch, ...] outputs of the last stage (plus the
     summed aux scalar when with_aux), replicated over the pipe axis.
     """
     n_micro = micros.shape[0]
+    if extras is None:
+        extras = {}
     base_fn = stage_fn
     if not with_aux:
-        def base_fn(p, x):  # noqa: F811 - uniform (y, aux) contract inside
-            return stage_fn(p, x), jnp.zeros((), jnp.float32)
+        def base_fn(p, x, e, s):  # noqa: F811 - uniform (y, aux) contract
+            return stage_fn(p, x, e, s), jnp.zeros((), jnp.float32)
     fn = jax.checkpoint(base_fn) if remat else base_fn
 
     if pp == 1:
         one = jax.tree.map(lambda x: x[0], stage_params)
-        outs, auxes = jax.lax.map(lambda m: fn(one, m), micros)
+        outs, auxes = jax.lax.map(
+            lambda mi: fn(one, mi[0],
+                          jax.tree.map(lambda e: e[mi[1]], extras), 0),
+            (micros, jnp.arange(n_micro)))
         # MEAN over microbatches: the per-layer aux is a token-mean, so the
         # pipelined aux must match the pp=1 model batch-for-batch
         return (outs, jnp.mean(auxes)) if with_aux else outs
 
     compute_dtype = micros.dtype
 
-    def inner(params, micros):
+    def inner(params, micros, extras):
         # the boundary crossing is f32 (see psum note below); compute in the
         # original dtype inside
         micros = micros.astype(compute_dtype)
@@ -96,7 +106,10 @@ def pipeline_apply(stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
             is_first = (stage == 0)
             x = jnp.where(is_first, inject, recv)
             aux_in = jnp.where(is_first, 0.0, recv_aux)
-            y, aux = fn(local, x)
+            # the micro at stage s on tick t is t - s (GPipe fill/drain)
+            mid = jnp.clip(t - stage, 0, n_micro - 1)
+            extra = jax.tree.map(lambda e: e[mid], extras)
+            y, aux = fn(local, x, extra, stage)
             aux = aux_in + aux.astype(jnp.float32)
             # last stage emits microbatch t-(pp-1) at tick t
             emit_idx = jnp.clip(t - (pp - 1), 0, n_micro - 1)
@@ -131,11 +144,12 @@ def pipeline_apply(stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
     out, aux_total = jax.shard_map(
         inner,
         mesh=mesh,
-        in_specs=(jax.tree.map(lambda _: P(pipe_axis), stage_params), P()),
+        in_specs=(jax.tree.map(lambda _: P(pipe_axis), stage_params), P(),
+                  P()),
         out_specs=(P(), P()),
         axis_names={pipe_axis},
         check_vma=False,
-    )(stage_params, micros.astype(jnp.float32))
+    )(stage_params, micros.astype(jnp.float32), extras)
     out = out.astype(compute_dtype)
     return (out, aux_total) if with_aux else out
 

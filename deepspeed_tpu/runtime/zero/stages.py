@@ -92,9 +92,47 @@ class ZeroShardingPolicy:
             return EXPERT_ZERO_AXES, self._expert_zero_size
         return ZERO_AXES, self._zero_size
 
+    def _compose_tp_dim(self, shape, tp_spec: Optional[P],
+                        axes: Tuple[str, ...], size: int,
+                        path: str = "") -> Optional[P]:
+        """Extend an already-TP-sharded dim with the ZeRO axes, if divisible.
+
+        Preferred over opening a fresh dim: sharding a fresh dim of a
+        transformer kernel (or its grad) lands on the residual-stream H dim,
+        and the backward contraction producing dW then wants the activation
+        COTANGENT H-sharded — clashing with the batch/seq activation layout
+        at the backward scan boundary (involuntary-remat reshards, round-3
+        Weak #2). Composing onto the TP dim shards an INTERNAL tensor's dim
+        (dqkv / attn_out), which has no carry coupling, and gives the same
+        or better per-device memory."""
+        if tp_spec is None or size <= 1:
+            return None
+        if "embedding" in path:
+            # embedding tables are consumed by gather/scatter on their TP
+            # (vocab) dim, not by a dot contraction — widening that dim
+            # 8-way makes the embedding-grad scatter unpartitionable and
+            # trades one coupling for another; their fresh-dim sharding (H)
+            # couples nothing that loops
+            return None
+        ndim = len(shape)
+        base = list(tp_spec)[:ndim]
+        base += [None] * (ndim - len(base))
+        for i, b in enumerate(base):
+            if b is None:
+                continue
+            ab = (b,) if isinstance(b, str) else tuple(b)
+            if any(a in ab for a in axes):
+                continue
+            tp_sz = _axes_size(self.mm.shape, ab)
+            if shape[i] > 0 and shape[i] % (tp_sz * size) == 0:
+                base[i] = ab + tuple(axes)
+                return P(*base)
+        return None
+
     # -- specs ---------------------------------------------------------------
 
-    def param_spec(self, shape, tp_spec: Optional[P] = None, is_expert: bool = False) -> P:
+    def param_spec(self, shape, tp_spec: Optional[P] = None, is_expert: bool = False,
+                   path: str = "") -> P:
         """Compute-dtype params: sharded only at stage 3; params under the
         persistence threshold stay whole (reference:
         stage3_param_persistence_threshold, stage3.py)."""
@@ -103,14 +141,21 @@ class ZeroShardingPolicy:
         if int(np.prod(shape) if shape else 1) < self.param_persistence_threshold:
             return tp_spec if tp_spec is not None else P()
         axes, size = self._zero_axes_for(is_expert)
+        composed = self._compose_tp_dim(tuple(shape), tp_spec, axes, size, path)
+        if composed is not None:
+            return composed
         return insert_zero_axes(tuple(shape), tp_spec, axes, size,
                                 avoid_last=True)
 
-    def master_spec(self, shape, tp_spec: Optional[P] = None, is_expert: bool = False) -> P:
+    def master_spec(self, shape, tp_spec: Optional[P] = None, is_expert: bool = False,
+                    path: str = "") -> P:
         """fp32 master params + optimizer state: sharded from stage 1 up."""
         if self.stage < 1:
             return tp_spec if tp_spec is not None else P()
         axes, size = self._zero_axes_for(is_expert)
+        composed = self._compose_tp_dim(tuple(shape), tp_spec, axes, size, path)
+        if composed is not None:
+            return composed
         return insert_zero_axes(tuple(shape), tp_spec, axes, size)
 
     # grads smaller than this stay whole: sharding a 64-element layernorm
@@ -119,13 +164,28 @@ class ZeroShardingPolicy:
     # granularity — tiny tensors ride whole in a bucket)
     GRAD_SHARD_MIN_ELEMS = 8192
 
-    def grad_spec(self, shape, tp_spec: Optional[P] = None, is_expert: bool = False) -> P:
+    def grad_spec(self, shape, tp_spec: Optional[P] = None, is_expert: bool = False,
+                  path: str = "") -> P:
         """Gradients: sharded from stage 2 up (constraint → XLA reduce-scatter)."""
         if self.stage < 2:
             return tp_spec if tp_spec is not None else P()
         if int(np.prod(shape) if shape else 1) < self.GRAD_SHARD_MIN_ELEMS:
             return tp_spec if tp_spec is not None else P()
         axes, size = self._zero_axes_for(is_expert)
+        if "embedding" in path and tp_spec is not None and \
+                _axes_size(self.mm.shape, tuple(
+                    a for d in tp_spec if d is not None
+                    for a in ((d,) if isinstance(d, str) else d))) > 1:
+            # vocab-parallel embedding grads stay TP-only: widening the
+            # vocab dim with ZeRO axes breaks the grad scatter's
+            # partitioning, and a fresh H-dim sharding couples the backward
+            # scan carry into an H layout (involuntary remat). The grad is
+            # already 1/tp per rank; the master/optimizer shards keep the
+            # full ZeRO saving.
+            return tp_spec
+        composed = self._compose_tp_dim(tuple(shape), tp_spec, axes, size, path)
+        if composed is not None:
+            return composed
         return insert_zero_axes(tuple(shape), tp_spec, axes, size)
 
     # -- pytree-level helpers -------------------------------------------------
@@ -143,7 +203,9 @@ class ZeroShardingPolicy:
             tp = tp_flat[i] if tp_flat is not None else None
             is_expert = bool(expert_fn and expert_fn(path))
             shape = np.shape(leaf)
-            out.append(NamedSharding(self.mesh, spec_fn(shape, tp, is_expert)))
+            pstr = "/".join(str(getattr(k, "key", k)) for k in path)
+            out.append(NamedSharding(self.mesh,
+                                     spec_fn(shape, tp, is_expert, pstr)))
         return jax.tree_util.tree_unflatten(treedef, out)
 
     def param_shardings(self, params, tp_specs=None, expert_fn=None):

@@ -130,9 +130,12 @@ class ZeroOneRunner:
         self.var_update_scaler = int(h.pop("var_update_scaler", 16))
         self.local_step_scaler = int(h.pop("local_step_scaler", 32678))
         self.local_step_clipper = int(h.pop("local_step_clipper", 16))
-        # accepted-for-compat reference knobs with no TPU meaning
+        # accepted-for-compat reference knobs (transport / unused-by-the-
+        # reference's-own-math); amsgrad raises there too (zoadam.py)
+        if h.pop("amsgrad", False):
+            raise ValueError("0/1 Adam does not support amsgrad")
         for k in ("cuda_aware", "comm_backend_name", "bias_correction",
-                  "amsgrad", "eps_inside_sqrt", "max_grad_norm"):
+                  "eps_inside_sqrt", "max_grad_norm"):
             h.pop(k, None)
 
         self._programs: Dict[str, Any] = {}

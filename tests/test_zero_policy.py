@@ -4,6 +4,7 @@ the no-involuntary-rematerialization property of the compiled MoE step.
 Mirrors the reference's partitioning unit coverage (tests/unit/runtime/zero)
 at the spec level — on TPU the partition IS the spec."""
 
+import os
 import subprocess
 import sys
 
@@ -97,5 +98,55 @@ def test_moe_step_has_no_involuntary_rematerialization(tmp_path):
         timeout=900, cwd=repo_root)
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "loss" in proc.stdout
+    assert "Involuntary full rematerialization" not in proc.stderr, \
+        [l for l in proc.stderr.splitlines() if "rematerialization" in l][:4]
+
+
+def test_compose_tp_dim_specs():
+    """ZeRO axes compose onto an already-TP-sharded dim when divisible
+    (round-3 Weak #2: a fresh H-dim sharding on kernel grads couples the
+    backward scan carry into an H layout -> involuntary remat); embedding
+    grads stay TP-only when vocab is genuinely TP-sharded."""
+    from deepspeed_tpu.parallel.mesh import MeshManager
+    from deepspeed_tpu.runtime.zero.stages import ZeroShardingPolicy
+
+    mm = MeshManager(tp_size=2, sp_size=2)     # data=2, seq=2, model=2
+    pol = ZeroShardingPolicy(3, mm)
+    # stacked qkv kernel [L, H, 3H], TP on the last dim: ZeRO axes compose
+    # onto it (192 % (2 tp * 4 zero) == 0) instead of opening the H dim
+    spec = pol.grad_spec((2, 64, 192), P(None, None, "model"))
+    assert spec == P(None, None, ("model", "data", "expert", "seq")), spec
+    # compute params compose the same way
+    spec = pol.param_spec((2, 64, 192), P(None, None, "model"))
+    assert spec == P(None, None, ("model", "data", "expert", "seq")), spec
+    # row-parallel attn_proj [L, H, H]: TP dim 1 absorbs the zero axes
+    spec = pol.grad_spec((2, 64, 64), P(None, "model", None))
+    assert spec == P(None, ("model", "data", "expert", "seq"), None), spec
+    # vocab-parallel embedding: grads stay TP-only (scatter-dim widening and
+    # fresh-H sharding both break partitioning; master keeps the ZeRO win)
+    spec = pol.grad_spec((256, 64), P("model", None), path="wte/embedding")
+    assert spec == P("model", None), spec
+    assert pol.master_spec((256, 64), P("model", None),
+                           path="wte/embedding") != P("model", None)
+    # no TP spec (tp=1 world): unchanged fresh-dim behavior
+    mm1 = MeshManager()
+    pol1 = ZeroShardingPolicy(2, mm1)
+    assert pol1.grad_spec((256, 64)) == P(("data", "expert", "seq"), None)
+
+
+def test_dryrun_legs_have_no_involuntary_rematerialization():
+    """ALL multichip dryrun legs (ZeRO3+TP+SP, PP+TP+DP, 1F1B+DP, MoE+EP)
+    must compile without a single SPMD replicate-and-reshard fallback —
+    round-3 left two on the ZeRO3+TP+SP backward scan carry."""
+    import pathlib
+    repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        capture_output=True, text=True, timeout=1800, cwd=repo_root, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert proc.stdout.count("ok") >= 4, proc.stdout
     assert "Involuntary full rematerialization" not in proc.stderr, \
         [l for l in proc.stderr.splitlines() if "rematerialization" in l][:4]
