@@ -61,13 +61,57 @@ def run(preset: str, batches: List[int], seqs: List[int], new_tokens: int):
     return rows
 
 
+def run_ragged(preset: str, batch: int, max_seq: int, new_tokens: int):
+    """Batched serving with MIXED context lengths: one left-padded ragged
+    batch (per-sample positions/masks) vs the sum of per-sample runs —
+    the batching win the round-3 decode bench (B=1 only) never measured."""
+    from ..models import build_model
+    from ..models.generation import generate
+    model, cfg = build_model(preset, max_seq_len=max_seq + new_tokens)
+    rng = np.random.default_rng(0)
+    lens = [int(x) for x in
+            rng.integers(max_seq // 4, max_seq + 1, size=batch)]
+    ids = np.zeros((batch, max_seq), np.int64)
+    mask = np.zeros((batch, max_seq), np.int64)
+    for i, L in enumerate(lens):
+        ids[i, max_seq - L:] = rng.integers(1, cfg.vocab_size, size=L)
+        mask[i, max_seq - L:] = 1
+    ids_j, mask_j = jnp.asarray(ids), jnp.asarray(mask)
+    params = jax.jit(lambda r: model.init(r, {"input_ids": ids_j})
+                     ["params"])(jax.random.PRNGKey(0))
+    t_batch = _timed(lambda: generate(cfg, params, ids_j, new_tokens,
+                                      attention_mask=mask_j), iters=3)
+    t_seq = 0.0
+    probe = lens[:4]                       # sample of per-sample runs
+    for i, L in enumerate(probe):
+        one = jnp.asarray(ids[i, max_seq - L:][None])
+        t_seq += _timed(lambda: generate(cfg, params, one, new_tokens),
+                        iters=3)
+    t_seq *= batch / len(probe)            # extrapolate to full batch
+    row = {"preset": preset, "batch": batch, "ctx_lens": lens,
+           "new_tokens": new_tokens,
+           "ragged_batch_s": round(t_batch, 3),
+           "sequential_est_s": round(t_seq, 3),
+           "batching_speedup": round(t_seq / max(t_batch, 1e-9), 2),
+           "tokens_per_sec": round(batch * new_tokens / t_batch, 1)}
+    print(row)
+    return row
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--preset", default="gpt2-125m")
     p.add_argument("--batches", default="1,8")
     p.add_argument("--seqs", default="128,1024")
     p.add_argument("--new", type=int, default=64)
+    p.add_argument("--ragged", action="store_true",
+                   help="mixed-context left-padded batch bench")
+    p.add_argument("--ragged-batch", type=int, default=8)
+    p.add_argument("--ragged-seq", type=int, default=512)
     args = p.parse_args(argv)
+    if args.ragged:
+        run_ragged(args.preset, args.ragged_batch, args.ragged_seq, args.new)
+        return
     run(args.preset, [int(x) for x in args.batches.split(",")],
         [int(x) for x in args.seqs.split(",")], args.new)
 

@@ -203,21 +203,44 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k=None, rng=None,
+                 top_p=None, repetition_penalty=None, attention_mask=None,
                  **kwargs):
         """Autoregressive generation with KV cache (reference:
-        engine.generate guard + fused decode kernels, engine.py:537)."""
+        engine.generate guard + fused decode kernels, engine.py:537).
+        top_p / repetition_penalty / left-padded ragged batches
+        (attention_mask) follow HF generate semantics."""
         from ..models.transformer import Transformer
         if isinstance(self.module, Transformer):
             from ..models.generation import generate as _gen
+            if attention_mask is not None:
+                import numpy as _np
+                mask_np = _np.asarray(attention_mask)
+                if not (_np.diff(mask_np, axis=1) >= 0).all():
+                    # HF tokenizers pad RIGHT by default; a right-padded
+                    # mask silently decoded garbage here (the ragged path
+                    # assumes pads-first)
+                    raise ValueError(
+                        "generate() requires LEFT-padded prompts: every "
+                        "attention_mask row must be non-decreasing "
+                        "(0s then 1s). Re-tokenize with "
+                        "padding_side='left'.")
+                # an all-ones mask is a uniform batch: dropping it keeps the
+                # Pallas decode kernel engaged (the ragged path's per-sample
+                # masks force the jnp attention fallback)
+                attention_mask = None if mask_np.all() else jnp.asarray(
+                    mask_np)
             return _gen(self.module.cfg, self.params,
                         jnp.asarray(input_ids), max_new_tokens,
-                        temperature, rng, top_k)
+                        temperature, rng, top_k, top_p, repetition_penalty,
+                        attention_mask)
         if hasattr(self.module, "generate"):
             # forward the engine-level settings, but only those the module's
             # own generate signature accepts (or **kwargs swallows)
             import inspect
             named = {"max_new_tokens": max_new_tokens, "temperature": temperature,
-                     "top_k": top_k, "rng": rng}
+                     "top_k": top_k, "rng": rng, "top_p": top_p,
+                     "repetition_penalty": repetition_penalty,
+                     "attention_mask": attention_mask}
             try:
                 sig = inspect.signature(self.module.generate)
                 has_var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD

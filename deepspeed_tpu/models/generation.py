@@ -70,12 +70,20 @@ def padded_cache_len(n: int) -> int:
 
 
 def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
-               dtype=None) -> Dict[str, jnp.ndarray]:
-    """Preallocated KV workspace (reference: allocate_workspace, pt_binding)."""
+               dtype=None, pad_lens=None) -> Dict[str, jnp.ndarray]:
+    """Preallocated KV workspace (reference: allocate_workspace, pt_binding).
+
+    ``pad_lens`` [B]: per-sample LEFT-pad lengths for ragged batched
+    prompts — cache slots [0, pad_i) are dead for sample i (masked in every
+    attention) and logical positions are slot - pad_i. Absent for uniform
+    batches (the decode kernel path needs the uniform layout)."""
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, batch_size, cfg.num_heads, max_len, cfg.head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
-            "pos": jnp.zeros((), jnp.int32)}
+    cache = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+             "pos": jnp.zeros((), jnp.int32)}
+    if pad_lens is not None:
+        cache["pad"] = jnp.asarray(pad_lens, jnp.int32)
+    return cache
 
 
 def ensure_scan_layout(params: PyTree, num_layers: int) -> PyTree:
@@ -151,15 +159,26 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
 
     wte = params["wte"]["embedding"]
     x = wte.astype(cfg.dtype)[input_ids]
-    q_abs = pos + jnp.arange(T_new)                 # [T_new]
+    q_abs = pos + jnp.arange(T_new)                 # cache-slot positions [T]
+    pad = cache.get("pad")                          # [B] left-pad lengths
+    # logical positions (rotary / learned-wpe / HF position_ids semantics):
+    # slot - pad for left-padded ragged batches, the slot itself otherwise
+    if pad is not None:
+        q_log = jnp.maximum(q_abs[None, :] - pad[:, None], 0)    # [B, T]
+    else:
+        q_log = q_abs
     if cfg.pos_embed == "learned":
-        x = x + params["wpe"]["embedding"].astype(cfg.dtype)[q_abs][None]
+        wpe = params["wpe"]["embedding"].astype(cfg.dtype)
+        x = x + (wpe[q_log] if pad is not None else wpe[q_log][None])
     if cfg.embed_ln:
         x = _layer_norm(x, params["ln_emb"], cfg.layer_norm_eps)
 
     k_pos = jnp.arange(max_len)                     # [max_len]
     # causal-with-cache mask [T_new, max_len]
     mask = k_pos[None, :] <= q_abs[:, None]
+    if pad is not None:
+        # dead left-pad slots never attend (per sample): [B, T, max_len]
+        mask = mask[None] & (k_pos[None, None, :] >= pad[:, None, None])
     ali = None
     if cfg.pos_embed == "alibi":
         slopes = jnp.asarray(alibi_slopes(nh), jnp.float32)
@@ -173,9 +192,11 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
     # Pallas decode kernel: visits only the live ceil(cur_len/block_k) K/V
     # blocks (compute + DMA of the dead cache tail skipped) — the slot of the
     # reference's fused softmax_context kernels (pt_binding.cpp:1703-1779).
-    # alibi needs a bias the kernel doesn't carry -> jnp path.
+    # alibi needs a bias the kernel doesn't carry -> jnp path; ragged
+    # (left-padded) batches need per-sample masks -> jnp path.
     use_kernel = (cfg.attention_impl in ("auto", "flash")
-                  and jax.default_backend() == "tpu" and ali is None)
+                  and jax.default_backend() == "tpu" and ali is None
+                  and pad is None)
 
     def layer(carry, xs):
         # the FULL [L, ...] caches ride in the carry so the per-token write
@@ -190,8 +211,10 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
         to_heads = lambda t: t.reshape(B, T_new, nh, hd).transpose(0, 2, 1, 3)
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
         if cfg.pos_embed == "rotary":
-            q = apply_rotary(q, q_abs, cfg.rotary_dim, cfg.rotary_interleaved)
-            k = apply_rotary(k, q_abs, cfg.rotary_dim, cfg.rotary_interleaved)
+            # q_log: logical (pad-corrected) positions — [B, T] for ragged
+            # left-padded batches, [T] otherwise (apply_rotary handles both)
+            q = apply_rotary(q, q_log, cfg.rotary_dim, cfg.rotary_interleaved)
+            k = apply_rotary(k, q_log, cfg.rotary_dim, cfg.rotary_interleaved)
         k_all = jax.lax.dynamic_update_slice(k_all, k[None],
                                              (li, 0, 0, pos, 0))
         v_all = jax.lax.dynamic_update_slice(v_all, v[None],
@@ -218,9 +241,13 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
             if ali is not None:
                 s = s + ali[None]
             m = mask
-            # local sliding window (0 = global)
-            m = m & ((q_abs[:, None] - k_pos[None, :] < window) | (window <= 0))
-            s = jnp.where(m[None, None], s, -1e30)
+            # local sliding window (0 = global); slot distance == logical
+            # distance for valid pairs (the left-pad offset cancels)
+            win = (q_abs[:, None] - k_pos[None, :] < window) | (window <= 0)
+            m = m & (win[None] if m.ndim == 3 else win)
+            # mask is [B, T, max_len] for ragged batches, [T, max_len] else
+            s = jnp.where(m[:, None] if m.ndim == 3 else m[None, None],
+                          s, -1e30)
             prob = jax.nn.softmax(s, axis=-1).astype(x.dtype)
             o = jnp.einsum("bhqk,bhkd->bhqd", prob, v_cache)
         o = o.transpose(0, 2, 1, 3).reshape(B, T_new, nh * hd)
@@ -251,10 +278,35 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
     else:
         logits = _dense(x, params["lm_head"])
     new_cache = {"k": k_new, "v": v_new, "pos": pos + T_new}
+    if pad is not None:
+        new_cache["pad"] = pad
     return logits.astype(jnp.float32), new_cache
 
 
-def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+def apply_top_p(logits, top_p: float):
+    """Nucleus filter: keep the smallest prefix of the descending-prob
+    distribution with cumulative mass >= top_p, mask the rest (HF
+    TopPLogitsWarper semantics: tokens whose cumulative probability AFTER
+    themselves exceeds top_p survive; the top token always survives)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # a sorted position is kept while the mass BEFORE it is < top_p
+    keep_sorted = (cum - probs) < top_p
+    # threshold = smallest kept logit; everything below it is masked
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1)
+    return jnp.where(logits < thresh[..., None], -1e30, logits)
+
+
+def apply_repetition_penalty(logits, seen, penalty: float):
+    """CTRL-style (HF RepetitionPenaltyLogitsProcessor): for every already-
+    seen token, positive logits divide by the penalty, negative multiply."""
+    pen = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, pen, logits)
+
+
+def _sample(logits, rng, temperature: float, top_k: Optional[int],
+            top_p: Optional[float] = None):
     """logits [B, V] -> token ids [B]."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
@@ -262,20 +314,36 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int]):
     if top_k is not None:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p is not None and top_p < 1.0:
+        logits = apply_top_p(logits, top_p)
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-@partial(jax.jit, static_argnums=(0, 3, 4, 6))
+@partial(jax.jit, static_argnums=(0, 3, 4, 6, 7, 8))
 def generate(cfg: TransformerConfig,
              params: PyTree,
              input_ids: jnp.ndarray,
              max_new_tokens: int,
              temperature: float = 0.0,
              rng: Optional[jax.Array] = None,
-             top_k: Optional[int] = None) -> jnp.ndarray:
+             top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
+             repetition_penalty: Optional[float] = None,
+             attention_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Prefill + single-token decode loop, one compiled program.
 
     input_ids [B, T_prompt] -> [B, T_prompt + max_new_tokens].
+
+    Ragged batches: pass ``attention_mask`` [B, T_prompt] with prompts
+    LEFT-padded (pads first — the layout where every sample's last prompt
+    token sits at the same slot, so one batched prefill serves mixed
+    context lengths); positions and attention are pad-corrected per sample,
+    matching HF's left-padded batched generate.
+
+    Sampling: temperature / top_k / top_p (nucleus) compose in the HF
+    processor order (temperature, then k, then p); ``repetition_penalty``
+    applies the CTRL rescale to every token already in the sample's prompt
+    or generation.
     """
     B, T_in = input_ids.shape
     max_len = T_in + max_new_tokens
@@ -284,21 +352,48 @@ def generate(cfg: TransformerConfig,
                          f"{cfg.max_seq_len}")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     params = ensure_scan_layout(params, cfg.num_layers)
+    pad_lens = None
+    if attention_mask is not None:
+        pad_lens = (T_in - jnp.sum(attention_mask.astype(jnp.int32), axis=1)
+                    ).astype(jnp.int32)
     # round the workspace up to a decode-kernel-friendly block multiple
     # (positions past the logical max are masked, never attended)
-    cache = init_cache(cfg, B, padded_cache_len(max_len))
+    cache = init_cache(cfg, B, padded_cache_len(max_len), pad_lens=pad_lens)
     logits, cache = forward_with_cache(cfg, params, input_ids, cache)
+
+    rep = repetition_penalty is not None and repetition_penalty != 1.0
+    if rep:
+        # seen-token table [B, V]: every prompt token INCLUDING pads (HF's
+        # RepetitionPenaltyLogitsProcessor penalizes the pad id of a
+        # left-padded batch too — parity means reproducing that), updated
+        # with each generated token. Direct scatter — a one_hot here would
+        # materialize a [B, T, V] transient.
+        seen = jnp.zeros((B, cfg.vocab_size), jnp.bool_).at[
+            jnp.arange(B)[:, None], input_ids].set(True)
+    else:
+        seen = jnp.zeros((B, 1), jnp.bool_)     # placeholder carry
+
+    def pick(logits_last, seen, r):
+        if rep:
+            logits_last = apply_repetition_penalty(logits_last, seen,
+                                                   repetition_penalty)
+        tok = _sample(logits_last, r, temperature, top_k, top_p)
+        if rep:
+            seen = seen | jax.nn.one_hot(tok, cfg.vocab_size,
+                                         dtype=jnp.bool_)
+        return tok, seen
+
     rng, r0 = jax.random.split(rng)
-    tok = _sample(logits[:, -1], r0, temperature, top_k)
+    tok, seen = pick(logits[:, -1], seen, r0)
 
     def step(carry, _):
-        tok, cache, rng = carry
+        tok, cache, rng, seen = carry
         logits, cache = forward_with_cache(cfg, params, tok[:, None], cache)
         rng, r = jax.random.split(rng)
-        nxt = _sample(logits[:, -1], r, temperature, top_k)
-        return (nxt, cache, rng), tok
+        nxt, seen = pick(logits[:, -1], seen, r)
+        return (nxt, cache, rng, seen), tok
 
-    (last, _, _), toks = jax.lax.scan(
-        step, (tok, cache, rng), None, length=max_new_tokens - 1)
+    (last, _, _, _), toks = jax.lax.scan(
+        step, (tok, cache, rng, seen), None, length=max_new_tokens - 1)
     out = jnp.concatenate([toks.T, last[:, None]], axis=1)  # [B, max_new]
     return jnp.concatenate([input_ids, out], axis=1)

@@ -165,6 +165,14 @@ class PipelinedTransformer:
         ids_micros = input_ids.reshape(self.n_micro, B // self.n_micro, S)
         micros = (wte.astype(cfg.dtype)[ids_micros] +
                   wpe.astype(cfg.dtype)[jnp.arange(S)][None, None, :])
+        # pin the microbatched layout: micro dim replicated, the PER-MICRO
+        # batch dim carries the (data, expert) sharding. Left to inference
+        # the partitioner may split the micro dim instead (seen on the
+        # pp x ep ladder mesh), and the head's reshape back to [B, S, V]
+        # then pays involuntary replicate-and-reshard round trips.
+        from .transformer import _spec_constraint
+        mspec = P(None, ("data", "expert"), None, None)
+        micros = _spec_constraint(micros, mspec)
         stage_params = stack_stage_params(params["blocks"], self.pp)
 
         moe = cfg.moe_experts > 0
@@ -175,12 +183,14 @@ class PipelinedTransformer:
                              pp=self.pp, remat=cfg.remat, with_aux=moe,
                              extras=extras)
         outs, aux_total = res if moe else (res, None)
+        outs = _spec_constraint(outs, mspec)
         # head runs per-micro; only the fp32 logits are reshaped back to the
         # flat batch (fp32 resharding avoids the bf16 SPMD copy bug above)
         h = self._ln_f.apply({"params": params["ln_f"]}, outs)
         logits = jnp.einsum("nbsh,vh->nbsv", h,
                             wte.astype(cfg.dtype)).astype(jnp.float32)
         logits = logits.reshape((B, S, cfg.vocab_size))
+        logits = _spec_constraint(logits, P(("data", "expert"), None, None))
         if moe:
             return logits, aux_total
         return logits
@@ -322,6 +332,15 @@ class PipelinedTransformer:
             r"blocks/.*mlp_fc/kernel": block(None, "model"),
             r"blocks/.*mlp_fc/bias": block("model"),
             r"blocks/.*mlp_proj/kernel": block("model", None),
+            # MoE expert stacks [L, E, in, out]: the layer dim carries the
+            # pipe axis (as for every block param), expert axis on E,
+            # row/col TP inside — the non-pipelined rules with the layer
+            # lead swapped from None to 'pipe'
+            r"blocks/.*experts/fc/kernel": block("expert", None, "model"),
+            r"blocks/.*experts/fc/bias": block("expert", "model"),
+            r"blocks/.*experts/proj/kernel": block("expert", "model", None),
+            r"blocks/.*experts/proj/bias": block("expert", None),
+            r"blocks/.*moe/gate/kernel": block(),
             r"blocks/": P("pipe"),           # ln scales/biases: pipe only
             r"wte/embedding": P("model", None),
             r"lm_head/kernel": P(None, "model"),

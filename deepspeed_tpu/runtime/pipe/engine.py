@@ -54,6 +54,16 @@ class PipelineEngine(DeepSpeedEngine):
                 # a user loss runs per-micro at the last stage (per-micro
                 # losses averaged — the reference _aggregate_total_loss)
                 custom_loss = lf
+                from ...utils.logging import warning_once
+                warning_once(
+                    "pipeline.schedule='1f1b' computes a custom loss_fn "
+                    "PER MICROBATCH and averages the results (the "
+                    "reference's _aggregate_total_loss semantics). For "
+                    "per-token-mean losses this equals the full-batch "
+                    "value; losses normalized over data-dependent counts "
+                    "(e.g. valid -100-masked tokens) will weight micros "
+                    "differently than the gpipe schedule's full-batch "
+                    "evaluation.")
 
         def train_step(state, batch, rng, lr_arg):
             if use_1f1b:

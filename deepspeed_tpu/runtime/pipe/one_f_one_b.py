@@ -172,6 +172,8 @@ def pipeline_1f1b_value_and_grad(
             "in_grad": jnp.zeros((slots,) + mshape, micros.dtype),
         }
         res_treedef = None
+        res_static = None
+        static_vals = None
         if not store_outputs:
             rings["saved_x"] = jnp.zeros((slots,) + mshape, micros.dtype)
         if store_outputs:
@@ -181,8 +183,20 @@ def pipeline_1f1b_value_and_grad(
                 lambda p, x: body(p, x, extra_of(jnp.asarray(0))),
                 local, zero_m)
             res_leaves, res_treedef = jax.tree.flatten(vjp_probe)
+            # residual leaves that ARE the stage weights (jax forwards the
+            # kernels as residuals for dx = dy @ W^T) are tick-invariant:
+            # ring-buffering them would hold slots x stage-params of live
+            # copies — reinject the live values at backward instead.
+            # (Identity matching catches pass-through leaves; residuals
+            # DERIVED from weights — e.g. a sharding-constraint or dtype
+            # cast output — still ride the rings, so the saving is partial
+            # for bodies that transform their kernels before use.)
+            param_ids = {id(l) for l in jax.tree.leaves(local)}
+            res_static = [id(l) in param_ids for l in res_leaves]
+            static_vals = [l for l, st in zip(res_leaves, res_static) if st]
             rings["res"] = [
-                jnp.zeros((slots,) + l.shape, l.dtype) for l in res_leaves]
+                jnp.zeros((slots,) + l.shape, l.dtype)
+                for l, st in zip(res_leaves, res_static) if not st]
             rings["out_y"] = jnp.zeros((slots,) + mshape, micros.dtype)
 
         grads0 = jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), local)
@@ -239,12 +253,13 @@ def pipeline_1f1b_value_and_grad(
             if store_outputs:
                 (y, f_aux), f_vjp = jax.vjp(
                     lambda p, xx: body(p, xx, f_extra), local, x)
-                leaves = jax.tree.flatten(f_vjp)[0]
+                dyn = [l for l, st in zip(jax.tree.flatten(f_vjp)[0],
+                                          res_static) if not st]
                 rings["res"] = [
                     jnp.where(f_on,
                               jax.lax.dynamic_update_index_in_dim(
                                   r, l, f_slot, 0), r)
-                    for r, l in zip(rings["res"], leaves)]
+                    for r, l in zip(rings["res"], dyn)]
                 rings["out_y"] = jnp.where(
                     f_on,
                     jax.lax.dynamic_update_index_in_dim(rings["out_y"], y,
@@ -277,8 +292,13 @@ def pipeline_1f1b_value_and_grad(
                 lloss, dh, head_dy = head_bwd(yb, lab)
                 dy = jnp.where(is_last, head_dy.astype(yb.dtype),
                                ring_dy.astype(yb.dtype))
-                b_vjp = jax.tree.unflatten(
-                    res_treedef, [r[b_slot] for r in rings["res"]])
+                # interleave the live (tick-invariant) weight residuals
+                # with the ring-buffered dynamic ones, in probe order
+                ring_it = iter([r[b_slot] for r in rings["res"]])
+                stat_it = iter(static_vals)
+                res_now = [next(stat_it) if st else next(ring_it)
+                           for st in res_static]
+                b_vjp = jax.tree.unflatten(res_treedef, res_now)
                 dp, dx = b_vjp((dy, aux_ct))
             else:
                 xb = rings["saved_x"][b_slot]

@@ -522,3 +522,61 @@ def test_1f1b_moe_through_engine():
         for i in range(6)]
     assert np.all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_ladder_zero1_pp_moe_ep_composition():
+    require_devices(8)
+    """The top of the BASELINE ladder's composition (config 5: ZeRO +
+    pipeline + MoE alltoall) in ONE program: mesh(pp=2, data=2, expert=2)
+    with ZeRO-1 master sharding under the pipe, expert params sharded over
+    the expert axis, and the MoE aux riding the pipe. Round-3 Missing #1:
+    pipeline and expert axes had never been composed."""
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.models.transformer import make_moe_loss
+    piped, cfg = build_pipelined_model(
+        "gpt2-tiny", pp=2, n_micro=2, hidden_size=64, num_layers=4,
+        num_heads=4, vocab_size=256, max_seq_len=64, moe_experts=2,
+        moe_capacity_factor=2.0, dtype=jnp.float32,
+        attention_impl="reference")
+    config = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+        "zero_optimization": {"stage": 1},
+        "pipeline": {"stages": 2},
+        "moe": {"enabled": True, "ep_size": 2},
+        "seed": 3,
+    }
+    rng = np.random.default_rng(4)
+    mk = lambda: _mk_batch(rng, cfg.vocab_size, 16, 32)
+    engine, *_ = ds.initialize(model=piped, config=config,
+                               loss_fn=make_moe_loss(), example_batch=mk(),
+                               sharding_rules=piped.tp_rules())
+    assert engine.mesh_mgr.shape["pipe"] == 2
+    assert engine.mesh_mgr.shape["expert"] == 2
+    assert engine.mesh_mgr.shape["data"] == 2
+
+    # expert kernels carry BOTH the pipe and expert axes in their sharding
+    flat = jax.tree_util.tree_flatten_with_path(engine.state.params)[0]
+    expert_kernels = [(path, leaf) for path, leaf in flat
+                      if "experts" in str(path) and "kernel" in str(path)]
+    assert expert_kernels
+    for path, leaf in expert_kernels:
+        spec = leaf.sharding.spec
+        assert spec[0] == "pipe", (path, spec)
+        assert "expert" in spec, (path, spec)
+
+    # ZeRO-1: master/opt-state sharded over the zero axes under the pipe
+    opt_leaves = jax.tree.leaves(engine.state.opt_state)
+    assert any(
+        any(ax in ("data", "expert", "seq")
+            for entry in (l.sharding.spec or ())
+            for ax in ((entry,) if isinstance(entry, str)
+                       else tuple(entry or ())))
+        for l in opt_leaves if hasattr(l, "sharding")), \
+        "no opt-state leaf carries a ZeRO axis"
+
+    losses = [float(engine.train_batch(mk())["loss"]) for _ in range(6)]
+    assert np.all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
