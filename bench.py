@@ -41,22 +41,35 @@ def main():
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        # the 1.3b leg needs nearly the whole chip: run it FIRST (clean
-        # HBM), free everything, then run the 350m leg; emit 350m first so
-        # the driver records the north-star 1.3b line last
+        import gc
+
+        # the 1.3b legs need nearly the whole chip: run them FIRST (clean
+        # HBM), free everything, then run the 350m leg; emit the north-star
+        # 1.3b seq-1024 line LAST so the driver records it.
+        # 12 fenced per-step timings -> median + spread in detail (round-3
+        # Weak #1: 6 steps couldn't separate contention from regression);
+        # micro/remat sweep rationale in docs/BENCHMARKS.md (micro 4 and
+        # seq2048/micro2 exceed compile-able HBM; "full" remat loses ~5%).
         r13 = run_training_bench("gpt2-1.3b", seq=1024, micro=2, gas=16,
-                                 steps=6, zero_stage=3, remat=True,
+                                 steps=12, zero_stage=3, remat=True,
                                  remat_policy="dots", fused_loss=True,
                                  pure_bf16=True, grad_accum_dtype="bf16",
                                  verbose=False)
-        import gc
+        gc.collect()
+        jax.clear_caches()
+        r20 = run_training_bench("gpt2-1.3b", seq=2048, micro=1, gas=16,
+                                 steps=8, zero_stage=3, remat=True,
+                                 remat_policy="dots", fused_loss=True,
+                                 pure_bf16=True, grad_accum_dtype="bf16",
+                                 verbose=False)
         gc.collect()
         jax.clear_caches()
         r = run_training_bench("gpt2-350m", seq=1024, micro=16, gas=16,
-                               steps=4, zero_stage=1, remat=True,
+                               steps=6, zero_stage=1, remat=True,
                                remat_policy="dots", fused_loss=True,
                                verbose=False)
         _emit(r, "gpt2_train_tflops_per_chip")
+        _emit(r20, "gpt2_1p3b_seq2048_zero3_train_tflops_per_chip")
         _emit(r13, "gpt2_1p3b_zero3_train_tflops_per_chip")
     else:  # smoke path for CPU-only environments
         r = run_training_bench("gpt2-tiny", seq=128, micro=8, gas=1, steps=3,

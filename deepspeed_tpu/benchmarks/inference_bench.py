@@ -98,6 +98,31 @@ def run_ragged(preset: str, batch: int, max_seq: int, new_tokens: int):
     return row
 
 
+def run_spatial(size: int, batch: int, channels: int = 64,
+                context_len: int = 77):
+    """Conditional-UNet forward latency (the diffusion serving hot loop —
+    the reference's diffusers injection slot)."""
+    from ..inference import InferenceEngine
+    from ..inference.spatial import UNet2DCondition
+    unet = UNet2DCondition(block_channels=(channels, 2 * channels),
+                           num_heads=8, out_channels=4, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, size, size, 4)), jnp.bfloat16)
+    t = jnp.ones((batch,), jnp.float32)
+    ctx = jnp.asarray(rng.normal(size=(batch, context_len, 2 * channels)),
+                      jnp.bfloat16)
+    params = jax.jit(lambda r: unet.init(r, x, t, ctx)["params"])(
+        jax.random.PRNGKey(0))
+    eng = InferenceEngine(model=unet, model_parameters=params,
+                          config={"dtype": "bfloat16"})
+    dt = _timed(lambda: eng.forward(x, t, ctx))
+    row = {"model": "unet2d-cond", "latent": size, "batch": batch,
+           "channels": channels, "forward_ms": round(dt * 1e3, 2),
+           "images_per_s": round(batch / dt, 2)}
+    print(row)
+    return row
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--preset", default="gpt2-125m")
@@ -108,7 +133,13 @@ def main(argv=None):
                    help="mixed-context left-padded batch bench")
     p.add_argument("--ragged-batch", type=int, default=8)
     p.add_argument("--ragged-seq", type=int, default=512)
+    p.add_argument("--spatial", action="store_true",
+                   help="conditional-UNet forward latency")
+    p.add_argument("--latent", type=int, default=64)
     args = p.parse_args(argv)
+    if args.spatial:
+        run_spatial(args.latent, int(args.batches.split(",")[0]))
+        return
     if args.ragged:
         run_ragged(args.preset, args.ragged_batch, args.ragged_seq, args.new)
         return

@@ -93,17 +93,20 @@ def _wallclock_and_memory(pp, n_micro, hidden, layers, seq, mb, steps):
         return dt, temp, params, batch, piped, cfg
 
     def gpipe(params, batch, piped, cfg):
-        fn = jax.jit(jax.value_and_grad(lambda p: causal_lm_loss(
-            piped.apply({"params": p}, batch, train=False, mesh=mesh),
-            batch)))
-        compiled = fn.lower(params).compile()
+        # batch traced (not closed over) so the compiled program is
+        # structurally comparable to the 1F1B variants
+        fn = jax.jit(jax.value_and_grad(
+            lambda p, b: causal_lm_loss(
+                piped.apply({"params": p}, b, train=False, mesh=mesh), b),
+            argnums=0))
+        compiled = fn.lower(params, batch).compile()
         mem = compiled.memory_analysis()
         temp = int(getattr(mem, "temp_size_in_bytes", 0))
-        out = compiled(params)
+        out = compiled(params, batch)
         jax.block_until_ready(out)
         t0 = time.perf_counter()
         for _ in range(steps):
-            out = compiled(params)
+            out = compiled(params, batch)
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / steps
         return dt, temp
@@ -126,23 +129,14 @@ def _wallclock_and_memory(pp, n_micro, hidden, layers, seq, mb, steps):
 def _ensure_devices(n):
     """Re-exec in a clean subprocess configured for n virtual CPU devices
     when the current process's jax is already pinned to another backend
-    (same recipe as __graft_entry__.dryrun_multichip)."""
-    import os
+    (shared recipe: utils/respawn.clean_cpu_env)."""
     import subprocess
     import sys
     import jax
+    from ..utils.respawn import clean_cpu_env
     if len(jax.devices()) >= n:
         return False
-    env = dict(os.environ)
-    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
-                     if "host_platform_device_count" not in f)
-    env["XLA_FLAGS"] = (
-        flags + f" --xla_force_host_platform_device_count={n}").strip()
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("JAX_PLATFORM_NAME", None)
-    for k in list(env):
-        if k.startswith("PALLAS_AXON") or k.startswith("AXON_"):
-            env.pop(k)
+    env = clean_cpu_env(n)
     env["DSTPU_PIPEBENCH_CHILD"] = "1"
     proc = subprocess.run(
         [sys.executable, "-m", "deepspeed_tpu.benchmarks.pipeline_bench"]

@@ -16,6 +16,20 @@ import time
 
 import numpy as np
 
+def _bf16_peak_tflops():
+    """Per-chip bf16 peak by device kind (None when unknown — a wrong MFU
+    is worse than no MFU)."""
+    import jax
+    if jax.default_backend() != "tpu":
+        return None
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for key, peak in (("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0),
+                      ("v6", 918.0), ("v4", 275.0)):
+        if key in kind:
+            return peak
+    return None
+
+
 # reference headline numbers to report "vs" (V100, see BASELINE.md)
 REFERENCE_TFLOPS = {
     ("bert-large", 128): 64.0,
@@ -85,14 +99,29 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
                                example_batch=make_batch())
     float(engine.train_batch(make_batch())["loss"])   # compile
     float(engine.train_batch(make_batch())["loss"])   # steady state
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        m = engine.train_batch(make_batch())
-    float(m["loss"])
-    float(jax.tree.leaves(engine.state.params)[0].ravel()[0])
-    dt = (time.perf_counter() - t0) / steps
 
-    tflops = 6.0 * cfg.num_params() * batch_size * seq / dt / max(n_chips, 1) / 1e12
+    # per-step timings, each fenced on its own loss (the axon relay's
+    # block_until_ready does not fence; float() forces a real D2H) —
+    # median + spread instead of a single mean over an unfenced window
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        m = engine.train_batch(make_batch())
+        float(m["loss"])
+        times.append(time.perf_counter() - t0)
+    dt = float(np.median(times))
+    spread = (max(times) - min(times)) / dt if dt else 0.0
+
+    # FLOPs accounting: the 6N basis is what the reference's TFLOPS/GPU
+    # numbers use (attention-free); the attention matmul term (12*L*H*S per
+    # token fwd+bwd) is reported separately so MFU is honest
+    n_params = cfg.num_params()
+    tokens = batch_size * seq
+    model_flops = 6.0 * n_params * tokens
+    attn_flops = 12.0 * cfg.num_layers * cfg.hidden_size * seq * tokens
+    tflops = model_flops / dt / max(n_chips, 1) / 1e12
+    tflops_attn = (model_flops + attn_flops) / dt / max(n_chips, 1) / 1e12
+    peak = _bf16_peak_tflops()
     ref = REFERENCE_TFLOPS.get((preset, seq))
     out = {
         "metric": f"{preset}_seq{seq}_train_tflops_per_chip",
@@ -106,6 +135,12 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
                    "pure_bf16": pure_bf16,
                    "grad_accum_dtype": grad_accum_dtype or "fp32",
                    "step_time_s": round(dt, 4),
+                   "step_time_spread": round(spread, 4),
+                   "steps_timed": steps,
+                   "step_times_s": [round(t, 4) for t in times],
+                   "tflops_incl_attention": round(tflops_attn, 3),
+                   "mfu_incl_attention": (round(tflops_attn / peak, 4)
+                                          if peak else None),
                    "samples_per_s": round(batch_size / dt, 2),
                    "backend": jax.default_backend()},
     }

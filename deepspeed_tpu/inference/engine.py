@@ -129,8 +129,8 @@ class InferenceEngine:
             if apply_fn is not None:
                 self._apply = apply_fn
             else:
-                self._apply = lambda params, batch: model.apply(
-                    {"params": params}, batch)
+                self._apply = lambda params, batch, *a, **kw: model.apply(
+                    {"params": params}, batch, *a, **kw)
         self._fwd = jax.jit(self._apply)
         log_dist(f"InferenceEngine: dtype={self.config.dtype} tp={tp}"
                  + (" (int8 weight-only)" if self.quantized else ""), ranks=[0])
@@ -196,8 +196,10 @@ class InferenceEngine:
         log_dist(f"InferenceEngine: loaded + TP-resharded {path}", ranks=[0])
         return self
 
-    def forward(self, batch):
-        return self._fwd(self.params, batch)
+    def forward(self, batch, *args, **kwargs):
+        # extra positional/keyword inputs pass through to the module (e.g.
+        # a diffusion UNet's (latents, timesteps, context) signature)
+        return self._fwd(self.params, batch, *args, **kwargs)
 
     __call__ = forward
 

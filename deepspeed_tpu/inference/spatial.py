@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..ops.attention import attention
@@ -61,3 +62,265 @@ class SpatialSelfAttention(nn.Module):
         out = nn.Dense(C, dtype=self.dtype, param_dtype=jnp.float32,
                        name="proj")(out)
         return x + out.reshape(B, H, W, C)
+
+
+# -- diffusers-grade UNet assembly (round-3 Missing #4) -----------------------
+#
+# The reference injects fused kernels into diffusers' UNet2DConditionModel
+# (module_inject/replace_module.py:205 generic_injection +
+# model_implementations/diffusers/*). The TPU shape is a native flax UNet
+# with the same computational structure (resnet blocks with timestep
+# injection, spatial transformers with self+cross attention and geglu FF,
+# down/mid/up with skip concats) plus a name-mapped loader for
+# diffusers-format state dicts. The diffusers package itself is not in this
+# image (and there is no network egress), so parity is established
+# per-component against torch mirrors of the documented diffusers ops
+# (tests/test_inference.py) rather than against a downloaded checkpoint —
+# the loader speaks the diffusers key naming either way.
+
+
+def _groups(channels: int, want: int = 32) -> int:
+    """Largest group count <= want that divides the channel count (toy
+    widths aren't the multiples of 32 diffusers assumes)."""
+    g = max(min(want, channels), 1)
+    while channels % g:
+        g -= 1
+    return g
+
+
+def timestep_embedding(t: jnp.ndarray, dim: int,
+                       max_period: float = 10000.0) -> jnp.ndarray:
+    """Sinusoidal timestep embedding [B] -> [B, dim] (diffusers
+    get_timestep_embedding, flip_sin_to_cos=True arrangement)."""
+    half = dim // 2
+    freqs = jnp.exp(-jnp.log(max_period) *
+                    jnp.arange(half, dtype=jnp.float32) / half)
+    ang = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
+
+
+class TimestepMLP(nn.Module):
+    """time_embedding: Linear -> SiLU -> Linear (diffusers TimestepEmbedding)."""
+    dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, emb):
+        h = nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="linear_1")(emb)
+        h = nn.silu(h)
+        return nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
+                        name="linear_2")(h)
+
+
+class ResnetBlock(nn.Module):
+    """GroupNorm -> SiLU -> Conv3x3, + time-emb projection, GroupNorm ->
+    SiLU -> Conv3x3, residual (1x1 shortcut on channel change) — diffusers
+    ResnetBlock2D."""
+    out_channels: int
+    num_groups: int = 32
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, temb):
+        C = x.shape[-1]
+        h = nn.GroupNorm(num_groups=_groups(C, self.num_groups), epsilon=1e-5,
+                         param_dtype=jnp.float32, name="norm1")(x)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
+                    param_dtype=jnp.float32, name="conv1")(h)
+        t = nn.Dense(self.out_channels, dtype=self.dtype,
+                     param_dtype=jnp.float32,
+                     name="time_emb_proj")(nn.silu(temb))
+        h = h + t[:, None, None, :]
+        h = nn.GroupNorm(num_groups=_groups(self.out_channels, self.num_groups),
+                         epsilon=1e-5, param_dtype=jnp.float32, name="norm2")(h)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
+                    param_dtype=jnp.float32, name="conv2")(h)
+        if C != self.out_channels:
+            x = nn.Conv(self.out_channels, (1, 1), dtype=self.dtype,
+                        param_dtype=jnp.float32, name="conv_shortcut")(x)
+        return x + h
+
+
+class CrossAttention(nn.Module):
+    """Multi-head attention with an optional cross context (diffusers
+    Attention: to_q/to_k/to_v unbiased, to_out biased)."""
+    num_heads: int
+    dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, context=None):
+        B, T, C = x.shape
+        ctx = x if context is None else context
+        hd = C // self.num_heads
+        dense = lambda n, feats, bias: nn.Dense(
+            feats, use_bias=bias, dtype=self.dtype, param_dtype=jnp.float32,
+            name=n)
+        q = dense("to_q", C, False)(x)
+        k = dense("to_k", C, False)(ctx)
+        v = dense("to_v", C, False)(ctx)
+        heads = lambda t: t.reshape(B, t.shape[1], self.num_heads, hd
+                                    ).transpose(0, 2, 1, 3)
+        out = attention(heads(q), heads(k), heads(v), causal=False,
+                        impl=self.attention_impl)
+        out = out.transpose(0, 2, 1, 3).reshape(B, T, C)
+        return dense("to_out", C, True)(out)
+
+
+class GEGLU(nn.Module):
+    """geglu feed-forward gate (diffusers GEGLU: one Dense to 2*inner)."""
+    inner: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(2 * self.inner, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="proj")(x)
+        a, g = jnp.split(h, 2, axis=-1)
+        # exact (erf) gelu: torch/diffusers F.gelu default
+        return a * nn.gelu(g, approximate=False)
+
+
+class TransformerBlock(nn.Module):
+    """LayerNorm -> self-attn -> LayerNorm -> cross-attn -> LayerNorm ->
+    geglu FF, all residual (diffusers BasicTransformerBlock)."""
+    num_heads: int
+    dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, context):
+        # torch/diffusers LayerNorm eps
+        ln = lambda n: nn.LayerNorm(epsilon=1e-5, param_dtype=jnp.float32,
+                                    name=n)
+        x = x + CrossAttention(self.num_heads, self.dtype,
+                               self.attention_impl, name="attn1")(ln("norm1")(x))
+        x = x + CrossAttention(self.num_heads, self.dtype,
+                               self.attention_impl,
+                               name="attn2")(ln("norm2")(x), context)
+        h = GEGLU(4 * x.shape[-1], self.dtype, name="ff_geglu")(
+            ln("norm3")(x))
+        x = x + nn.Dense(x.shape[-1], dtype=self.dtype,
+                         param_dtype=jnp.float32, name="ff_out")(h)
+        return x
+
+
+class SpatialTransformer(nn.Module):
+    """GroupNorm -> 1x1 proj_in -> transformer blocks over the H*W grid ->
+    1x1 proj_out, residual (diffusers Transformer2DModel)."""
+    num_heads: int
+    depth: int = 1
+    num_groups: int = 32
+    dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, context):
+        B, H, W, C = x.shape
+        res = x
+        h = nn.GroupNorm(num_groups=_groups(C, self.num_groups), epsilon=1e-5,
+                         param_dtype=jnp.float32, name="norm")(x)
+        h = nn.Conv(C, (1, 1), dtype=self.dtype, param_dtype=jnp.float32,
+                    name="proj_in")(h)
+        h = h.reshape(B, H * W, C)
+        for i in range(self.depth):
+            h = TransformerBlock(self.num_heads, self.dtype,
+                                 self.attention_impl,
+                                 name=f"blocks_{i}")(h, context)
+        h = h.reshape(B, H, W, C)
+        h = nn.Conv(C, (1, 1), dtype=self.dtype, param_dtype=jnp.float32,
+                    name="proj_out")(h)
+        return res + h
+
+
+class UNet2DCondition(nn.Module):
+    """Conditional diffusion UNet: conv_in -> down (resnets + transformers +
+    downsample) -> mid -> up (skip-concat resnets + transformers +
+    upsample) -> norm/silu/conv_out. Structure of diffusers
+    UNet2DConditionModel at configurable width/depth."""
+    block_channels: tuple = (32, 64)
+    layers_per_block: int = 1
+    num_heads: int = 4
+    cross_attention: bool = True
+    out_channels: int = 4
+    dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, timesteps, context=None):
+        ch0 = self.block_channels[0]
+        temb = timestep_embedding(timesteps, ch0)
+        temb = TimestepMLP(4 * ch0, self.dtype, name="time_embedding")(temb)
+
+        h = nn.Conv(ch0, (3, 3), padding=1, dtype=self.dtype,
+                    param_dtype=jnp.float32, name="conv_in")(x)
+        skips = [h]
+        # down path
+        for bi, ch in enumerate(self.block_channels):
+            for li in range(self.layers_per_block):
+                h = ResnetBlock(ch, dtype=self.dtype,
+                                name=f"down_{bi}_res_{li}")(h, temb)
+                if self.cross_attention:
+                    h = SpatialTransformer(
+                        self.num_heads, dtype=self.dtype,
+                        attention_impl=self.attention_impl,
+                        name=f"down_{bi}_attn_{li}")(h, context)
+                skips.append(h)
+            if bi < len(self.block_channels) - 1:
+                h = nn.Conv(ch, (3, 3), strides=2, padding=1,
+                            dtype=self.dtype, param_dtype=jnp.float32,
+                            name=f"down_{bi}_downsample")(h)
+                skips.append(h)
+        # mid
+        h = ResnetBlock(self.block_channels[-1], dtype=self.dtype,
+                        name="mid_res_0")(h, temb)
+        if self.cross_attention:
+            h = SpatialTransformer(self.num_heads, dtype=self.dtype,
+                                   attention_impl=self.attention_impl,
+                                   name="mid_attn")(h, context)
+        h = ResnetBlock(self.block_channels[-1], dtype=self.dtype,
+                        name="mid_res_1")(h, temb)
+        # up path (skip concats, reverse order)
+        for bi, ch in reversed(list(enumerate(self.block_channels))):
+            for li in range(self.layers_per_block + 1):
+                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                h = ResnetBlock(ch, dtype=self.dtype,
+                                name=f"up_{bi}_res_{li}")(h, temb)
+                if self.cross_attention:
+                    h = SpatialTransformer(
+                        self.num_heads, dtype=self.dtype,
+                        attention_impl=self.attention_impl,
+                        name=f"up_{bi}_attn_{li}")(h, context)
+            if bi > 0:
+                B, H, W, C = h.shape
+                h = jax.image.resize(h, (B, 2 * H, 2 * W, C), "nearest")
+                h = nn.Conv(C, (3, 3), padding=1, dtype=self.dtype,
+                            param_dtype=jnp.float32,
+                            name=f"up_{bi}_upsample")(h)
+        h = nn.GroupNorm(num_groups=_groups(h.shape[-1]), epsilon=1e-5,
+                         param_dtype=jnp.float32, name="norm_out")(h)
+        h = nn.silu(h)
+        return nn.Conv(self.out_channels, (3, 3), padding=1,
+                       dtype=self.dtype, param_dtype=jnp.float32,
+                       name="conv_out")(h)
+
+
+def load_torch_conv(w, b=None):
+    """torch Conv2d weight [O, I, kh, kw] -> flax Conv kernel [kh, kw, I, O]."""
+    import numpy as np
+    out = {"kernel": jnp.asarray(np.transpose(np.asarray(w), (2, 3, 1, 0)))}
+    if b is not None:
+        out["bias"] = jnp.asarray(np.asarray(b))
+    return out
+
+
+def load_torch_linear(w, b=None):
+    """torch Linear weight [O, I] -> flax Dense kernel [I, O]."""
+    import numpy as np
+    out = {"kernel": jnp.asarray(np.asarray(w).T)}
+    if b is not None:
+        out["bias"] = jnp.asarray(np.asarray(b))
+    return out

@@ -287,15 +287,20 @@ def apply_top_p(logits, top_p: float):
     """Nucleus filter: keep the smallest prefix of the descending-prob
     distribution with cumulative mass >= top_p, mask the rest (HF
     TopPLogitsWarper semantics: tokens whose cumulative probability AFTER
-    themselves exceeds top_p survive; the top token always survives)."""
-    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    themselves exceeds top_p survive; the top token always survives).
+
+    Masking is POSITIONAL in the sorted order (scattered back through the
+    inverse permutation), not value-thresholded — tied logits at the
+    nucleus boundary keep exactly the sorted-prefix count, as HF does."""
+    order = jnp.argsort(-logits, axis=-1)                  # descending
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     # a sorted position is kept while the mass BEFORE it is < top_p
     keep_sorted = (cum - probs) < top_p
-    # threshold = smallest kept logit; everything below it is masked
-    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1)
-    return jnp.where(logits < thresh[..., None], -1e30, logits)
+    inv = jnp.argsort(order, axis=-1)
+    keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+    return jnp.where(keep, logits, -1e30)
 
 
 def apply_repetition_penalty(logits, seen, penalty: float):

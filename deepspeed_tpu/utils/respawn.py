@@ -1,0 +1,35 @@
+"""Clean-subprocess re-exec onto an N-device virtual CPU mesh.
+
+One shared recipe (used by __graft_entry__.dryrun_multichip and the
+benchmarks that need a multi-device mesh from a TPU-pinned process): the
+current process's jax may already be initialized against a real backend by
+a site hook, so multi-device CPU work must re-exec with a scrubbed
+environment. Includes the raised CPU-collective rendezvous timeouts —
+device threads timeshare the host cores, and arrival skew at a collective
+can exceed the runtime's default 40s abort on big programs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+
+def clean_cpu_env(n_devices: int, base: Dict[str, str] = None
+                  ) -> Dict[str, str]:
+    """Environment for a subprocess that must see n_devices CPU devices."""
+    env = dict(base if base is not None else os.environ)
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=1200").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_PLATFORM_NAME", None)
+    # a site hook may register a TPU PJRT plugin and force its platform;
+    # drop the env vars that trigger it so the CPU platform wins
+    for k in list(env):
+        if k.startswith("PALLAS_AXON") or k.startswith("AXON_"):
+            env.pop(k)
+    return env

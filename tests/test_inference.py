@@ -383,3 +383,174 @@ def test_ragged_generate_matches_hf():
                     attention_mask=jnp.asarray(mask))
     np.testing.assert_array_equal(np.asarray(ours)[:, T:],
                                   hf_out.numpy()[:, T:])
+
+
+# -- diffusers-grade spatial path (round-3 Missing #4) ------------------------
+
+
+def test_resnet_block_matches_torch_mirror():
+    """ResnetBlock == a torch mirror of diffusers' ResnetBlock2D ops
+    (GroupNorm/SiLU/Conv3x3 + time-emb injection + shortcut)."""
+    import torch
+    import torch.nn as tnn
+    from deepspeed_tpu.inference.spatial import (ResnetBlock,
+                                                 load_torch_conv,
+                                                 load_torch_linear)
+
+    torch.manual_seed(0)
+    Cin, Cout, G = 8, 16, 4
+
+    class TorchRes(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.norm1 = tnn.GroupNorm(G, Cin)
+            self.conv1 = tnn.Conv2d(Cin, Cout, 3, padding=1)
+            self.time_emb_proj = tnn.Linear(12, Cout)
+            self.norm2 = tnn.GroupNorm(G, Cout)
+            self.conv2 = tnn.Conv2d(Cout, Cout, 3, padding=1)
+            self.shortcut = tnn.Conv2d(Cin, Cout, 1)
+
+        def forward(self, x, temb):
+            h = self.conv1(tnn.functional.silu(self.norm1(x)))
+            h = h + self.time_emb_proj(
+                tnn.functional.silu(temb))[:, :, None, None]
+            h = self.conv2(tnn.functional.silu(self.norm2(h)))
+            return self.shortcut(x) + h
+
+    tm = TorchRes().eval()
+    x = torch.randn(2, Cin, 8, 8)
+    temb = torch.randn(2, 12)
+    with torch.no_grad():
+        ref = tm(x, temb).permute(0, 2, 3, 1).numpy()
+
+    params = {
+        "norm1": {"scale": jnp.asarray(tm.norm1.weight.detach().numpy()),
+                  "bias": jnp.asarray(tm.norm1.bias.detach().numpy())},
+        "conv1": load_torch_conv(tm.conv1.weight.detach(),
+                                 tm.conv1.bias.detach()),
+        "time_emb_proj": load_torch_linear(
+            tm.time_emb_proj.weight.detach(),
+            tm.time_emb_proj.bias.detach()),
+        "norm2": {"scale": jnp.asarray(tm.norm2.weight.detach().numpy()),
+                  "bias": jnp.asarray(tm.norm2.bias.detach().numpy())},
+        "conv2": load_torch_conv(tm.conv2.weight.detach(),
+                                 tm.conv2.bias.detach()),
+        "conv_shortcut": load_torch_conv(tm.shortcut.weight.detach(),
+                                         tm.shortcut.bias.detach()),
+    }
+    blk = ResnetBlock(Cout, num_groups=G)
+    ours = blk.apply({"params": params},
+                     jnp.asarray(x.permute(0, 2, 3, 1).numpy()),
+                     jnp.asarray(temb.numpy()))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_transformer_block_matches_torch_mirror():
+    """TransformerBlock (self-attn + cross-attn + geglu FF) == a torch
+    mirror of diffusers' BasicTransformerBlock."""
+    import torch
+    import torch.nn as tnn
+    from deepspeed_tpu.inference.spatial import (TransformerBlock,
+                                                 load_torch_linear)
+
+    torch.manual_seed(1)
+    C, H, Tq, Tc, Cc = 16, 2, 12, 5, 16
+
+    class TorchAttn(tnn.Module):
+        def __init__(self, kdim):
+            super().__init__()
+            self.to_q = tnn.Linear(C, C, bias=False)
+            self.to_k = tnn.Linear(kdim, C, bias=False)
+            self.to_v = tnn.Linear(kdim, C, bias=False)
+            self.to_out = tnn.Linear(C, C)
+
+        def forward(self, x, ctx=None):
+            ctx = x if ctx is None else ctx
+            B, T, _ = x.shape
+            hd = C // H
+            sh = lambda t: t.reshape(B, -1, H, hd).transpose(1, 2)
+            q, k, v = sh(self.to_q(x)), sh(self.to_k(ctx)), sh(self.to_v(ctx))
+            o = tnn.functional.scaled_dot_product_attention(q, k, v)
+            return self.to_out(o.transpose(1, 2).reshape(B, T, C))
+
+    class TorchBlock(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.norm1, self.norm2, self.norm3 = (tnn.LayerNorm(C)
+                                                  for _ in range(3))
+            self.attn1 = TorchAttn(C)
+            self.attn2 = TorchAttn(Cc)
+            self.geglu = tnn.Linear(C, 8 * C)
+            self.ff_out = tnn.Linear(4 * C, C)
+
+        def forward(self, x, ctx):
+            x = x + self.attn1(self.norm1(x))
+            x = x + self.attn2(self.norm2(x), ctx)
+            h = self.geglu(self.norm3(x))
+            a, g = h.chunk(2, dim=-1)
+            return x + self.ff_out(a * tnn.functional.gelu(g))
+
+    tm = TorchBlock().eval()
+    x = torch.randn(2, Tq, C)
+    ctx = torch.randn(2, Tc, Cc)
+    with torch.no_grad():
+        ref = tm(x, ctx).numpy()
+
+    def attn_params(ta):
+        return {"to_q": load_torch_linear(ta.to_q.weight.detach()),
+                "to_k": load_torch_linear(ta.to_k.weight.detach()),
+                "to_v": load_torch_linear(ta.to_v.weight.detach()),
+                "to_out": load_torch_linear(ta.to_out.weight.detach(),
+                                            ta.to_out.bias.detach())}
+
+    ln = lambda m: {"scale": jnp.asarray(m.weight.detach().numpy()),
+                    "bias": jnp.asarray(m.bias.detach().numpy())}
+    params = {
+        "norm1": ln(tm.norm1), "norm2": ln(tm.norm2), "norm3": ln(tm.norm3),
+        "attn1": attn_params(tm.attn1), "attn2": attn_params(tm.attn2),
+        "ff_geglu": {"proj": load_torch_linear(tm.geglu.weight.detach(),
+                                               tm.geglu.bias.detach())},
+        "ff_out": load_torch_linear(tm.ff_out.weight.detach(),
+                                    tm.ff_out.bias.detach()),
+    }
+    blk = TransformerBlock(H, attention_impl="reference")
+    ours = blk.apply({"params": params}, jnp.asarray(x.numpy()),
+                     jnp.asarray(ctx.numpy()))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_unet_serves_through_inference_engine():
+    """The assembled conditional UNet hosts in InferenceEngine like any
+    module (the reference's generic_injection capability slot) and is
+    jit-stable end to end."""
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.inference.spatial import UNet2DCondition
+    unet = UNet2DCondition(block_channels=(16, 32), num_heads=2,
+                           out_channels=4, attention_impl="reference")
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(2, 16, 16, 4)), jnp.float32)
+    t = jnp.asarray([1.0, 17.0])
+    ctx = jnp.asarray(np.random.default_rng(1).normal(
+        size=(2, 6, 16)), jnp.float32)
+    params = unet.init(jax.random.PRNGKey(0), x, t, ctx)["params"]
+    eng = InferenceEngine(model=unet, model_parameters=params,
+                          config={"dtype": "float32"})
+    y1 = eng.forward(x, t, ctx)
+    y2 = eng.forward(x, t, ctx)
+    assert np.asarray(y1).shape == (2, 16, 16, 4)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    assert np.all(np.isfinite(np.asarray(y1)))
+
+
+def test_timestep_embedding_matches_torch_mirror():
+    import torch
+    from deepspeed_tpu.inference.spatial import timestep_embedding
+    t = np.asarray([0.0, 1.0, 999.0], np.float32)
+    dim = 32
+    half = dim // 2
+    freqs = torch.exp(-torch.log(torch.tensor(10000.0)) *
+                      torch.arange(half) / half)
+    ang = torch.tensor(t)[:, None] * freqs[None]
+    ref = torch.cat([torch.cos(ang), torch.sin(ang)], dim=-1).numpy()
+    ours = np.asarray(timestep_embedding(jnp.asarray(t), dim))
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
