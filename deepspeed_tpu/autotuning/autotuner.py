@@ -24,6 +24,7 @@ import os
 import random
 import subprocess
 import sys
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -38,6 +39,8 @@ class Experiment:
     config: Dict[str, Any]
     metrics: Optional[Dict[str, float]] = None
     error: Optional[str] = None
+    overrides: Optional[Dict[str, Any]] = None
+    slot: Optional[Dict[str, Any]] = None       # reservation it ran on
 
     @property
     def score(self) -> float:
@@ -181,12 +184,14 @@ class Autotuner:
 
     def __init__(self,
                  base_config: Dict[str, Any],
-                 runner: Callable[[Dict], Optional[Dict[str, float]]],
+                 runner: Callable[..., Optional[Dict[str, float]]],
                  tuning_space: Optional[Dict[str, List]] = None,
                  tuner_type: str = "gridsearch",
                  num_trials: int = 50,
                  early_stopping: int = 0,
-                 results_dir: Optional[str] = None):
+                 results_dir: Optional[str] = None,
+                 resource_slots: Optional[List[Dict[str, Any]]] = None,
+                 kill_factor: float = 3.0):
         self.base_config = base_config
         self.runner = runner
         self.space = tuning_space or default_tuning_space(base_config)
@@ -202,6 +207,10 @@ class Autotuner:
         self.early_stopping = early_stopping
         self.results_dir = results_dir
         self.experiments: List[Experiment] = []
+        # parallel mode (reference scheduler.py:114,319): experiments run
+        # concurrently over reserved slots, losing configs killed
+        self.resource_slots = resource_slots
+        self.kill_factor = kill_factor
 
     def _materialize(self, overrides: Dict[str, Any]) -> Dict[str, Any]:
         cfg = copy.deepcopy(self.base_config)
@@ -218,13 +227,15 @@ class Autotuner:
         return cfg
 
     def tune(self) -> List[Experiment]:
+        if self.resource_slots and len(self.resource_slots) > 1:
+            return self._tune_parallel()
         best = float("-inf")
         since_best = 0
         for i, overrides in enumerate(self.tuner):
             name = "exp_" + "_".join(
                 f"{k.split('.')[-1]}{v}" for k, v in overrides.items())
             cfg = self._materialize(overrides)
-            exp = Experiment(name=name, config=cfg)
+            exp = Experiment(name=name, config=cfg, overrides=overrides)
             try:
                 exp.metrics = self.runner(cfg)
             except Exception as e:  # OOM / invalid composition: record + go on
@@ -241,6 +252,55 @@ class Autotuner:
                 since_best += 1
             logger.info("autotuning %s -> %s", name,
                         exp.metrics or exp.error)
+            if self.early_stopping and since_best >= self.early_stopping:
+                logger.info("autotuning early stop after %d stale trials",
+                            since_best)
+                break
+        self.experiments.sort(key=lambda e: e.score, reverse=True)
+        if self.results_dir:
+            self.write_results(self.results_dir)
+        return self.experiments
+
+    def _tune_parallel(self) -> List[Experiment]:
+        """Waved concurrency: up to n_slots candidates in flight, results
+        fed back to the tuner between waves (model-based feedback still
+        steers), stale-wave early stop preserved."""
+        from .scheduler import ParallelScheduler
+        sched = ParallelScheduler(self.runner, self.resource_slots,
+                                  kill_factor=self.kill_factor)
+        n = sched.rm.n_slots
+        best = float("-inf")
+        since_best = 0
+        it = iter(self.tuner)
+        done = False
+        while not done:
+            wave = []
+            for _ in range(n):
+                try:
+                    overrides = next(it)
+                except StopIteration:
+                    done = True
+                    break
+                name = "exp_" + "_".join(
+                    f"{k.split('.')[-1]}{v}" for k, v in overrides.items())
+                exp = Experiment(name=name,
+                                 config=self._materialize(overrides))
+                exp.overrides = overrides
+                wave.append(exp)
+            if not wave:
+                break
+            sched.run_wave(wave)
+            for exp in wave:
+                self.experiments.append(exp)
+                if hasattr(self.tuner, "observe"):
+                    self.tuner.observe(exp.overrides, exp.score)
+                logger.info("autotuning %s -> %s", exp.name,
+                            exp.metrics or exp.error)
+                if exp.score > best:
+                    best = exp.score
+                    since_best = 0
+                else:
+                    since_best += 1
             if self.early_stopping and since_best >= self.early_stopping:
                 logger.info("autotuning early stop after %d stale trials",
                             since_best)
@@ -312,23 +372,65 @@ def subprocess_runner(cmd: List[str], exps_dir: str,
     ds_config, launches `cmd + ['--deepspeed_config', path]`, and reads the
     metric file the engine writes at end_profile_step."""
 
-    def run(config: Dict) -> Dict[str, float]:
+    import itertools
+    counter = itertools.count()
+    lock = threading.Lock()
+
+    def run(config: Dict, slot: Optional[Dict] = None,
+            deadline: Optional[Callable[[], Optional[float]]] = None
+            ) -> Dict[str, float]:
         os.makedirs(exps_dir, exist_ok=True)
-        n = len(os.listdir(exps_dir))
+        with lock:
+            n = next(counter)
         cfg_path = os.path.join(exps_dir, f"exp_{n}_config.json")
         metric_path = os.path.join(exps_dir, f"exp_{n}_metrics.json")
         cfg = copy.deepcopy(config)
         cfg.setdefault("autotuning", {})["enabled"] = True
         with open(cfg_path, "w") as f:
             json.dump(cfg, f)
+        if os.path.exists(metric_path):
+            os.unlink(metric_path)      # a stale file from a previous
+                                        # session must not score this run
         env = dict(os.environ, **{METRIC_FILE_ENV: metric_path})
-        proc = subprocess.run(cmd + ["--deepspeed_config", cfg_path],
-                              env=env, capture_output=True, text=True,
-                              timeout=timeout)
+        if slot:
+            # pin the launch to its reservation (parallel scheduler):
+            # device slots restrict the runtime's visible accelerators
+            # (TPU + CUDA spellings so the child's backend picks it up),
+            # host slots carry explicit env
+            if slot.get("devices"):
+                dev = str(slot["devices"])
+                env["DSTPU_SLOT_DEVICES"] = dev
+                env["TPU_VISIBLE_CHIPS"] = dev
+                env["TPU_VISIBLE_DEVICES"] = dev
+                env["CUDA_VISIBLE_DEVICES"] = dev
+            env.update(slot.get("env") or {})
+        proc = subprocess.Popen(cmd + ["--deepspeed_config", cfg_path],
+                                env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        # poll so a losing config is killed as soon as its deadline expires
+        # (a pre-launch budget would never bind for the first wave, when no
+        # experiment has completed yet)
+        import time as _time
+        t0 = _time.monotonic()
+        while True:
+            try:
+                proc.wait(timeout=2.0)
+                break
+            except subprocess.TimeoutExpired:
+                pass
+            rem = deadline() if deadline is not None else None
+            if (rem is not None and rem <= 0) or                     _time.monotonic() - t0 > timeout:
+                proc.kill()
+                proc.wait()
+                raise RuntimeError(
+                    "experiment killed: losing config (exceeded the "
+                    "scheduler deadline)" if rem is not None and rem <= 0
+                    else f"experiment timed out after {timeout}s")
+        stderr = proc.stderr.read() if proc.stderr else ""
         if not os.path.exists(metric_path):
             raise RuntimeError(
                 f"experiment produced no metric file (rc={proc.returncode}): "
-                f"{proc.stderr[-1000:]}")
+                f"{stderr[-1000:]}")
         with open(metric_path) as f:
             return json.load(f)
 

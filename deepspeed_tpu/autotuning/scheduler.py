@@ -1,0 +1,142 @@
+"""Parallel experiment scheduler with resource reservations.
+
+Role of the reference's ``autotuning/scheduler.py`` (ResourceManager +
+run_job: experiments scheduled concurrently onto reserved node/GPU slots,
+reference scheduler.py:114,319). TPU shape:
+
+  * a **slot** is whatever one experiment needs — a chip set on this host
+    (``{"devices": "0"}``), a remote host (``{"host": ...}``), or an
+    abstract token for in-process runs. Slots are leased exclusively for
+    the experiment's lifetime and returned on completion or failure.
+  * experiments run on a thread per leased slot; the runner receives the
+    slot so it can pin the launch (e.g. set JAX_VISIBLE_DEVICES / ssh to
+    the host).
+  * **losing configs are killed early**: once a config completes, any
+    still-running experiment that exceeds ``kill_factor x`` the best
+    completed wall time is aborted (slow configs are losing configs — the
+    scheduler reclaims their slots instead of waiting out a 30x-slower
+    OOM-thrash run). Runners observe this via the ``deadline`` callable
+    they receive; the subprocess runner enforces it as a hard timeout.
+
+The tuner loop stays waved: up to ``len(slots)`` candidates run
+concurrently, results feed the (thread-safe) model-based tuner between
+waves, so surrogate feedback still steers the search.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+
+
+class ResourceManager:
+    """Exclusive lease of experiment slots (reference ResourceManager:
+    nodes + reservations; here a thread-safe free list)."""
+
+    def __init__(self, slots: List[Dict[str, Any]]):
+        if not slots:
+            raise ValueError("need at least one resource slot")
+        self._free: "queue.Queue[Dict]" = queue.Queue()
+        for s in slots:
+            self._free.put(dict(s))
+        self.n_slots = len(slots)
+
+    def acquire(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        return self._free.get(timeout=timeout)
+
+    def release(self, slot: Dict[str, Any]) -> None:
+        self._free.put(slot)
+
+
+class ParallelScheduler:
+    """Run a wave of experiments concurrently over the slot pool.
+
+    runner(config, slot, deadline) -> metrics dict. ``deadline()`` returns
+    the remaining seconds before this experiment is considered a losing
+    config (None = no bound yet); runners should pass it to their
+    subprocess timeout or poll it between steps.
+    """
+
+    def __init__(self, runner: Callable[..., Optional[Dict[str, float]]],
+                 slots: List[Dict[str, Any]],
+                 kill_factor: float = 3.0,
+                 min_kill_time: float = 60.0):
+        self.rm = ResourceManager(slots)
+        self.runner = runner
+        self.kill_factor = kill_factor
+        self.min_kill_time = min_kill_time
+        self._lock = threading.Lock()
+        self._best_time: Optional[float] = None
+
+    def _deadline_fn(self, started: float):
+        def remaining() -> Optional[float]:
+            with self._lock:
+                if self._best_time is None:
+                    return None
+                budget = max(self.kill_factor * self._best_time,
+                             self.min_kill_time)
+            return budget - (time.monotonic() - started)
+        return remaining
+
+    def run_wave(self, experiments: List[Any]) -> None:
+        """Run a list of Experiment objects (config/metrics/error fields)
+        to completion, at most n_slots concurrently."""
+        threads = []
+
+        import inspect
+        try:
+            n_args = len(inspect.signature(self.runner).parameters)
+        except (TypeError, ValueError):
+            n_args = 3
+
+        def work(exp):
+            slot = self.rm.acquire()
+            started = time.monotonic()
+            try:
+                exp.slot = dict(slot)
+                if n_args >= 3:
+                    exp.metrics = self.runner(exp.config, slot,
+                                              self._deadline_fn(started))
+                else:
+                    # slot-unaware runner (the in-process engine runner)
+                    exp.metrics = self.runner(exp.config)
+                elapsed = time.monotonic() - started
+                with self._lock:
+                    if exp.metrics is not None and (
+                            self._best_time is None
+                            or elapsed < self._best_time):
+                        self._best_time = elapsed
+            except Exception as e:       # OOM / kill / invalid composition
+                exp.error = f"{type(e).__name__}: {e}"
+                logger.warning("autotuning experiment %s failed: %s",
+                               exp.name, exp.error[:200])
+            finally:
+                self.rm.release(slot)
+
+        for exp in experiments:
+            t = threading.Thread(target=work, args=(exp,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+
+
+def local_chip_slots(devices_per_slot: int = 0) -> List[Dict[str, Any]]:
+    """Slot map for this host's visible accelerator(s): one slot per chip
+    group (0 = all chips in one slot — the single-chip case)."""
+    import jax
+    n = len(jax.devices())
+    if devices_per_slot <= 0 or devices_per_slot >= n:
+        return [{"devices": ",".join(str(i) for i in range(n))}]
+    if n % devices_per_slot:
+        logger.warning(
+            "local_chip_slots: %d chips do not divide into slots of %d — "
+            "the last %d chip(s) stay unassigned", n, devices_per_slot,
+            n % devices_per_slot)
+    return [{"devices": ",".join(str(j) for j in range(i,
+                                                       i + devices_per_slot))}
+            for i in range(0, n - devices_per_slot + 1, devices_per_slot)]

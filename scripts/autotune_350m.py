@@ -1,0 +1,103 @@
+"""Cold-start autotune of the gpt2-350m training config on the real chip.
+
+The round-3 hand-tuned bench config (micro 16 x gas 16, selective "dots"
+remat) took manual sweeps to find; this script hands the same search to the
+autotuner — space: micro-batch ladder x remat policy, model-based tuner,
+stale-trial early stop — and records whether it rediscovers (>=95% of) the
+hand-tuned throughput unattended. Results land in docs/BENCHMARKS.md.
+
+    python scripts/autotune_350m.py [--trials 8]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--trials", type=int, default=8)
+    p.add_argument("--steps", type=int, default=3)
+    args = p.parse_args()
+
+    import gc
+
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.autotuning import Autotuner
+    from deepspeed_tpu.models import build_model, fused_loss_passthrough
+
+    SEQ = 1024
+    GLOBAL_BATCH = 256
+
+    def runner(config, slot=None, deadline=None):
+        config = dict(config)           # the experiment record keeps the
+        remat_policy = config.pop("_remat_policy")   # full config incl. knob
+        model, cfg = build_model("gpt2-350m", max_seq_len=SEQ,
+                                 remat=remat_policy is not None,
+                                 remat_policy=remat_policy or "dots",
+                                 fused_loss=True, loss_chunk=256)
+        rng = np.random.default_rng(0)
+
+        def batch(_i):
+            return {"input_ids": rng.integers(
+                0, cfg.vocab_size, size=(GLOBAL_BATCH, SEQ))}
+
+        engine, *_ = ds.initialize(model=model, config=config,
+                                   loss_fn=fused_loss_passthrough,
+                                   example_batch=batch(0))
+        try:
+            float(engine.train_batch(batch(0))["loss"])   # compile
+            times = []
+            for i in range(args.steps):
+                t0 = time.perf_counter()
+                float(engine.train_batch(batch(i))["loss"])
+                times.append(time.perf_counter() - t0)
+                if deadline is not None:
+                    rem = deadline()
+                    if rem is not None and rem <= 0:
+                        raise RuntimeError("killed: losing config")
+            dt = float(np.median(times))
+            return {"throughput": GLOBAL_BATCH / dt, "step_time": dt}
+        finally:
+            del engine
+            gc.collect()
+            jax.clear_caches()
+
+    base = {
+        "train_batch_size": GLOBAL_BATCH,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10_000,
+    }
+    space = {
+        "train_micro_batch_size_per_gpu": [4, 8, 16, 32],
+        "_remat_policy": [None, "dots"],
+    }
+    at = Autotuner(base, runner, tuning_space=space, tuner_type="model",
+                   num_trials=args.trials, early_stopping=4,
+                   results_dir="/tmp/autotune_350m")
+    t0 = time.perf_counter()
+    at.tune()
+    wall = time.perf_counter() - t0
+    best = at.best()
+    print(json.dumps({
+        "best_overrides": best.overrides,
+        "best_throughput_samples_s": round(best.score, 2),
+        "n_experiments": len(at.experiments),
+        "wall_s": round(wall, 1),
+        "ranking": [{"name": e.name,
+                     "tput": (round(e.score, 1)
+                              if e.metrics else e.error and e.error[:60])}
+                    for e in at.experiments],
+    }, indent=2))
+
+
+if __name__ == "__main__":
+    main()

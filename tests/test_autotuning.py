@@ -143,3 +143,100 @@ def test_model_based_tuner_finds_optimum():
     # the model guided later trials toward large micro batches: the best
     # config must have been found despite sampling < 30% of the grid
     assert best.score == 32.0
+
+
+# -- parallel scheduler (round-3 Missing #5) ----------------------------------
+
+
+def test_parallel_scheduler_runs_concurrently_with_reservations():
+    """Experiments overlap in time (up to n_slots in flight) and no slot is
+    ever double-booked — the reference scheduler.py reservation semantics."""
+    import threading
+    import time
+
+    from deepspeed_tpu.autotuning.autotuner import Experiment
+    from deepspeed_tpu.autotuning.scheduler import ParallelScheduler
+
+    active = {"n": 0, "max": 0, "by_slot": set()}
+    lock = threading.Lock()
+
+    def runner(config, slot, deadline):
+        with lock:
+            key = slot["devices"]
+            assert key not in active["by_slot"], "slot double-booked"
+            active["by_slot"].add(key)
+            active["n"] += 1
+            active["max"] = max(active["max"], active["n"])
+        time.sleep(0.2)
+        with lock:
+            active["by_slot"].discard(key)
+            active["n"] -= 1
+        return {"throughput": float(config["x"])}
+
+    sched = ParallelScheduler(runner,
+                              [{"devices": "0"}, {"devices": "1"}])
+    exps = [Experiment(name=f"e{i}", config={"x": i}) for i in range(6)]
+    t0 = time.perf_counter()
+    sched.run_wave(exps)
+    wall = time.perf_counter() - t0
+    assert all(e.metrics is not None for e in exps)
+    assert active["max"] == 2, active           # really concurrent
+    assert wall < 6 * 0.2                       # faster than sequential
+    assert {e.slot["devices"] for e in exps} == {"0", "1"}
+
+
+def test_parallel_scheduler_kills_losing_configs():
+    """Once a config completes, a still-running experiment past
+    kill_factor x the best wall time sees its deadline expire (losing
+    configs give their slot back instead of running out the clock)."""
+    import time
+
+    from deepspeed_tpu.autotuning.autotuner import Experiment
+    from deepspeed_tpu.autotuning.scheduler import ParallelScheduler
+
+    def runner(config, slot, deadline):
+        if config["kind"] == "fast":
+            time.sleep(0.1)
+            return {"throughput": 100.0}
+        # losing config: poll the deadline like a real runner would
+        for _ in range(200):
+            time.sleep(0.05)
+            rem = deadline()
+            if rem is not None and rem <= 0:
+                raise RuntimeError("killed: losing config")
+        return {"throughput": 1.0}
+
+    sched = ParallelScheduler(runner, [{"devices": "0"}, {"devices": "1"}],
+                              kill_factor=2.0, min_kill_time=0.3)
+    exps = [Experiment(name="fast", config={"kind": "fast"}),
+            Experiment(name="slow", config={"kind": "slow"})]
+    t0 = time.perf_counter()
+    sched.run_wave(exps)
+    wall = time.perf_counter() - t0
+    assert exps[0].metrics == {"throughput": 100.0}
+    assert exps[1].error is not None and "killed" in exps[1].error
+    assert wall < 3.0, wall                     # the slow one did NOT run out
+
+
+def test_autotuner_parallel_mode_matches_sequential_ranking(tmp_path):
+    """Autotuner with resource_slots produces the same best config as the
+    sequential path, with experiments actually distributed over slots."""
+    import time
+
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+
+    space = {"train_micro_batch_size_per_gpu": [1, 2, 4, 8]}
+    base = {"train_batch_size": 64}
+
+    def runner(config, slot=None, deadline=None):
+        time.sleep(0.05)
+        return {"throughput": float(config["train_micro_batch_size_per_gpu"])}
+
+    at = Autotuner(base, runner, tuning_space=space,
+                   resource_slots=[{"devices": "0"}, {"devices": "1"}],
+                   results_dir=str(tmp_path))
+    exps = at.tune()
+    assert at.best().config["train_micro_batch_size_per_gpu"] == 8
+    assert len(exps) == 4
+    assert {e.slot["devices"] for e in exps} == {"0", "1"}
+    assert (tmp_path / "best_config.json").exists()
