@@ -226,45 +226,55 @@ class Autotuner:
             cfg.pop("gradient_accumulation_steps", None)
         return cfg
 
-    def tune(self) -> List[Experiment]:
-        if self.resource_slots and len(self.resource_slots) > 1:
-            return self._tune_parallel()
-        best = float("-inf")
-        since_best = 0
-        for i, overrides in enumerate(self.tuner):
-            name = "exp_" + "_".join(
-                f"{k.split('.')[-1]}{v}" for k, v in overrides.items())
-            cfg = self._materialize(overrides)
-            exp = Experiment(name=name, config=cfg, overrides=overrides)
-            try:
-                exp.metrics = self.runner(cfg)
-            except Exception as e:  # OOM / invalid composition: record + go on
-                exp.error = f"{type(e).__name__}: {e}"
-                logger.warning("autotuning experiment %s failed: %s", name,
-                               exp.error[:200])
-            self.experiments.append(exp)
-            if hasattr(self.tuner, "observe"):          # model-based feedback
-                self.tuner.observe(overrides, exp.score)
-            if exp.score > best:
-                best = exp.score
-                since_best = 0
-            else:
-                since_best += 1
-            logger.info("autotuning %s -> %s", name,
-                        exp.metrics or exp.error)
-            if self.early_stopping and since_best >= self.early_stopping:
-                logger.info("autotuning early stop after %d stale trials",
-                            since_best)
-                break
+    def _make_exp(self, overrides) -> Experiment:
+        name = "exp_" + "_".join(
+            f"{k.split('.')[-1]}{v}" for k, v in overrides.items())
+        return Experiment(name=name, config=self._materialize(overrides),
+                          overrides=overrides)
+
+    def _record(self, exp: Experiment, best: float, since_best: int):
+        """Shared per-experiment bookkeeping: observe, log, early-stop
+        accounting. Returns (best, since_best)."""
+        self.experiments.append(exp)
+        if hasattr(self.tuner, "observe"):              # model-based feedback
+            self.tuner.observe(exp.overrides, exp.score)
+        logger.info("autotuning %s -> %s", exp.name,
+                    exp.metrics or exp.error)
+        if exp.score > best:
+            return exp.score, 0
+        return best, since_best + 1
+
+    def _finish(self) -> List[Experiment]:
         self.experiments.sort(key=lambda e: e.score, reverse=True)
         if self.results_dir:
             self.write_results(self.results_dir)
         return self.experiments
 
+    def tune(self) -> List[Experiment]:
+        if self.resource_slots and len(self.resource_slots) > 1:
+            return self._tune_parallel()
+        best = float("-inf")
+        since_best = 0
+        for overrides in self.tuner:
+            exp = self._make_exp(overrides)
+            try:
+                exp.metrics = self.runner(exp.config)
+            except Exception as e:  # OOM / invalid composition: record + go on
+                exp.error = f"{type(e).__name__}: {e}"
+                logger.warning("autotuning experiment %s failed: %s",
+                               exp.name, exp.error[:200])
+            best, since_best = self._record(exp, best, since_best)
+            if self.early_stopping and since_best >= self.early_stopping:
+                logger.info("autotuning early stop after %d stale trials",
+                            since_best)
+                break
+        return self._finish()
+
     def _tune_parallel(self) -> List[Experiment]:
         """Waved concurrency: up to n_slots candidates in flight, results
         fed back to the tuner between waves (model-based feedback still
-        steers), stale-wave early stop preserved."""
+        steers), stale-wave early stop preserved. The scheduler records
+        runner failures into exp.error itself."""
         from .scheduler import ParallelScheduler
         sched = ParallelScheduler(self.runner, self.resource_slots,
                                   kill_factor=self.kill_factor)
@@ -277,38 +287,20 @@ class Autotuner:
             wave = []
             for _ in range(n):
                 try:
-                    overrides = next(it)
+                    wave.append(self._make_exp(next(it)))
                 except StopIteration:
                     done = True
                     break
-                name = "exp_" + "_".join(
-                    f"{k.split('.')[-1]}{v}" for k, v in overrides.items())
-                exp = Experiment(name=name,
-                                 config=self._materialize(overrides))
-                exp.overrides = overrides
-                wave.append(exp)
             if not wave:
                 break
             sched.run_wave(wave)
             for exp in wave:
-                self.experiments.append(exp)
-                if hasattr(self.tuner, "observe"):
-                    self.tuner.observe(exp.overrides, exp.score)
-                logger.info("autotuning %s -> %s", exp.name,
-                            exp.metrics or exp.error)
-                if exp.score > best:
-                    best = exp.score
-                    since_best = 0
-                else:
-                    since_best += 1
+                best, since_best = self._record(exp, best, since_best)
             if self.early_stopping and since_best >= self.early_stopping:
                 logger.info("autotuning early stop after %d stale trials",
                             since_best)
                 break
-        self.experiments.sort(key=lambda e: e.score, reverse=True)
-        if self.results_dir:
-            self.write_results(self.results_dir)
-        return self.experiments
+        return self._finish()
 
     def best(self) -> Optional[Experiment]:
         return self.experiments[0] if self.experiments else None
@@ -373,13 +365,16 @@ def subprocess_runner(cmd: List[str], exps_dir: str,
     metric file the engine writes at end_profile_step."""
 
     import itertools
-    counter = itertools.count()
+    os.makedirs(exps_dir, exist_ok=True)
+    # offset past any previous session's records in a reused exps_dir (the
+    # per-run counter keeps concurrent threads collision-free)
+    counter = itertools.count(
+        sum(1 for f in os.listdir(exps_dir) if f.endswith("_config.json")))
     lock = threading.Lock()
 
     def run(config: Dict, slot: Optional[Dict] = None,
             deadline: Optional[Callable[[], Optional[float]]] = None
             ) -> Dict[str, float]:
-        os.makedirs(exps_dir, exist_ok=True)
         with lock:
             n = next(counter)
         cfg_path = os.path.join(exps_dir, f"exp_{n}_config.json")
@@ -404,9 +399,13 @@ def subprocess_runner(cmd: List[str], exps_dir: str,
                 env["TPU_VISIBLE_DEVICES"] = dev
                 env["CUDA_VISIBLE_DEVICES"] = dev
             env.update(slot.get("env") or {})
+        out_path = os.path.join(exps_dir, f"exp_{n}_output.log")
+        out_f = open(out_path, "w")
+        # file-backed output: PIPEs would need draining while we poll (a
+        # chatty child fills the ~64KB pipe buffer and deadlocks)
         proc = subprocess.Popen(cmd + ["--deepspeed_config", cfg_path],
-                                env=env, stdout=subprocess.PIPE,
-                                stderr=subprocess.PIPE, text=True)
+                                env=env, stdout=out_f,
+                                stderr=subprocess.STDOUT, text=True)
         # poll so a losing config is killed as soon as its deadline expires
         # (a pre-launch budget would never bind for the first wave, when no
         # experiment has completed yet)
@@ -422,15 +421,18 @@ def subprocess_runner(cmd: List[str], exps_dir: str,
             if (rem is not None and rem <= 0) or                     _time.monotonic() - t0 > timeout:
                 proc.kill()
                 proc.wait()
+                out_f.close()
                 raise RuntimeError(
                     "experiment killed: losing config (exceeded the "
                     "scheduler deadline)" if rem is not None and rem <= 0
                     else f"experiment timed out after {timeout}s")
-        stderr = proc.stderr.read() if proc.stderr else ""
+        out_f.close()
         if not os.path.exists(metric_path):
+            with open(out_path) as f:
+                tail = f.read()[-1000:]
             raise RuntimeError(
                 f"experiment produced no metric file (rc={proc.returncode}): "
-                f"{stderr[-1000:]}")
+                f"{tail}")
         with open(metric_path) as f:
             return json.load(f)
 

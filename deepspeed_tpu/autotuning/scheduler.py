@@ -89,16 +89,19 @@ class ParallelScheduler:
 
         import inspect
         try:
-            n_args = len(inspect.signature(self.runner).parameters)
+            params = inspect.signature(self.runner).parameters.values()
+            slot_aware = (len(params) >= 3 or any(
+                p.kind in (inspect.Parameter.VAR_POSITIONAL,
+                           inspect.Parameter.VAR_KEYWORD) for p in params))
         except (TypeError, ValueError):
-            n_args = 3
+            slot_aware = True
 
         def work(exp):
             slot = self.rm.acquire()
             started = time.monotonic()
             try:
                 exp.slot = dict(slot)
-                if n_args >= 3:
+                if slot_aware:
                     exp.metrics = self.runner(exp.config, slot,
                                               self._deadline_fn(started))
                 else:

@@ -206,7 +206,7 @@ class InferenceEngine:
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k=None, rng=None,
                  top_p=None, repetition_penalty=None, attention_mask=None,
-                 **kwargs):
+                 kv_cache_dtype=None, **kwargs):
         """Autoregressive generation with KV cache (reference:
         engine.generate guard + fused decode kernels, engine.py:537).
         top_p / repetition_penalty / left-padded ragged batches
@@ -234,7 +234,7 @@ class InferenceEngine:
             return _gen(self.module.cfg, self.params,
                         jnp.asarray(input_ids), max_new_tokens,
                         temperature, rng, top_k, top_p, repetition_penalty,
-                        attention_mask)
+                        attention_mask, kv_cache_dtype)
         if hasattr(self.module, "generate"):
             # forward the engine-level settings, but only those the module's
             # own generate signature accepts (or **kwargs swallows)
@@ -242,7 +242,8 @@ class InferenceEngine:
             named = {"max_new_tokens": max_new_tokens, "temperature": temperature,
                      "top_k": top_k, "rng": rng, "top_p": top_p,
                      "repetition_penalty": repetition_penalty,
-                     "attention_mask": attention_mask}
+                     "attention_mask": attention_mask,
+                     "kv_cache_dtype": kv_cache_dtype}
             try:
                 sig = inspect.signature(self.module.generate)
                 has_var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD

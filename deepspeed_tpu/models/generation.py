@@ -76,14 +76,39 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
     ``pad_lens`` [B]: per-sample LEFT-pad lengths for ragged batched
     prompts — cache slots [0, pad_i) are dead for sample i (masked in every
     attention) and logical positions are slot - pad_i. Absent for uniform
-    batches (the decode kernel path needs the uniform layout)."""
+    batches (the decode kernel path needs the uniform layout).
+
+    ``dtype=jnp.int8``: quantized KV cache — k/v store int8 with a
+    per-(layer, batch, head, position) f32 scale (symmetric over the head
+    dim), halving the cache's HBM footprint vs bf16 (+~3% for scales):
+    2x the context length or batch fits the same workspace. Attention
+    dequantizes on read (jnp path; the block-skip decode kernel needs the
+    bf16 layout and is bypassed). Capability slot of the reference's int8
+    inference kernel family (csrc/transformer/inference ds_*_int8)."""
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, batch_size, cfg.num_heads, max_len, cfg.head_dim)
-    cache = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
-             "pos": jnp.zeros((), jnp.int32)}
+    if dtype == jnp.int8:
+        cache = {"k": jnp.zeros(shape, jnp.int8),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "k_scale": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+                 "v_scale": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+                 "pos": jnp.zeros((), jnp.int32)}
+    else:
+        cache = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                 "pos": jnp.zeros((), jnp.int32)}
     if pad_lens is not None:
         cache["pad"] = jnp.asarray(pad_lens, jnp.int32)
     return cache
+
+
+def _kv_quantize(t: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, nh, T, hd] -> (int8 values, f32 per-position scales [B,nh,T,1])."""
+    scale = jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1,
+                    keepdims=True) / 127.0
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(t.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 def ensure_scan_layout(params: PyTree, num_layers: int) -> PyTree:
@@ -130,7 +155,8 @@ def _moe_mlp(cfg: TransformerConfig, p_moe, h):
 
 
 def forward_with_cache(cfg: TransformerConfig, params: PyTree,
-                       input_ids: jnp.ndarray, cache: Dict
+                       input_ids: jnp.ndarray, cache: Dict,
+                       prefer_kernel: Optional[bool] = None
                        ) -> Tuple[jnp.ndarray, Dict]:
     """Run T_new tokens at positions [cache.pos, cache.pos+T_new) against the
     cache. Returns (logits [B, T_new, V], updated cache). Params must be the
@@ -189,21 +215,37 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
                if cfg.layer_windows is not None
                else jnp.zeros((cfg.num_layers,), jnp.int32))
 
+    quant_kv = cache["k"].dtype == jnp.int8
+
     # Pallas decode kernel: visits only the live ceil(cur_len/block_k) K/V
-    # blocks (compute + DMA of the dead cache tail skipped) — the slot of the
-    # reference's fused softmax_context kernels (pt_binding.cpp:1703-1779).
-    # alibi needs a bias the kernel doesn't carry -> jnp path; ragged
-    # (left-padded) batches need per-sample masks -> jnp path.
-    use_kernel = (cfg.attention_impl in ("auto", "flash")
+    # blocks — the slot of the reference's fused softmax_context kernels
+    # (pt_binding.cpp:1703-1779). Regime-aware routing under "auto"
+    # (round-4 measurements, docs/BENCHMARKS.md): the block-skip pays in
+    # BATCHED LONG GENERATION (B>=2, a mostly-dead preallocated cache —
+    # 1.77x at B=4, 128-prompt + 2048-new, gpt2-350m) and LOSES 2-8x at
+    # B=1 / short caches, where per-layer kernel dispatch dominates.
+    # ``prefer_kernel`` (generate passes it from the static prompt/gen
+    # plan) overrides the local B/max_len heuristic. "flash" forces the
+    # kernel; alibi needs a bias the kernel doesn't carry -> jnp path;
+    # ragged (left-padded) batches need per-sample masks -> jnp path; the
+    # int8 cache needs the dequant read -> jnp path.
+    if prefer_kernel is None:
+        prefer_kernel = B >= 2 and max_len >= 4 * 512
+    use_kernel = ((cfg.attention_impl == "flash"
+                   or (cfg.attention_impl == "auto" and prefer_kernel))
                   and jax.default_backend() == "tpu" and ali is None
-                  and pad is None)
+                  and pad is None and not quant_kv)
 
     def layer(carry, xs):
         # the FULL [L, ...] caches ride in the carry so the per-token write
         # is an in-place dynamic-update-slice inside the compiled loop — the
         # stacked-ys layout copied the whole cache every layer (O(L x
         # max_len) HBM traffic per token, the decode bottleneck)
-        x, k_all, v_all = carry
+        if quant_kv:
+            x, k_all, v_all, ks_all, vs_all = carry
+        else:
+            x, k_all, v_all = carry
+            ks_all = vs_all = None
         p, window, li = xs
         h = _layer_norm(x, p["ln1"], cfg.layer_norm_eps)
         qkv = _dense(h, p["attn_qkv"])
@@ -215,6 +257,13 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
             # left-padded batches, [T] otherwise (apply_rotary handles both)
             q = apply_rotary(q, q_log, cfg.rotary_dim, cfg.rotary_interleaved)
             k = apply_rotary(k, q_log, cfg.rotary_dim, cfg.rotary_interleaved)
+        if quant_kv:
+            k, k_s = _kv_quantize(k)
+            v, v_s = _kv_quantize(v)
+            ks_all = jax.lax.dynamic_update_slice(ks_all, k_s[None],
+                                                  (li, 0, 0, pos, 0))
+            vs_all = jax.lax.dynamic_update_slice(vs_all, v_s[None],
+                                                  (li, 0, 0, pos, 0))
         k_all = jax.lax.dynamic_update_slice(k_all, k[None],
                                              (li, 0, 0, pos, 0))
         v_all = jax.lax.dynamic_update_slice(v_all, v[None],
@@ -236,6 +285,15 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
                                                    keepdims=False)
             v_cache = jax.lax.dynamic_index_in_dim(v_all, li, 0,
                                                    keepdims=False)
+            if quant_kv:
+                # dequantize on read: int8 x f32 per-position scale (the
+                # HBM read is the int8 bytes; the multiply fuses)
+                k_sc = jax.lax.dynamic_index_in_dim(ks_all, li, 0,
+                                                    keepdims=False)
+                v_sc = jax.lax.dynamic_index_in_dim(vs_all, li, 0,
+                                                    keepdims=False)
+                k_cache = (k_cache.astype(jnp.float32) * k_sc).astype(q.dtype)
+                v_cache = (v_cache.astype(jnp.float32) * v_sc).astype(q.dtype)
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache).astype(jnp.float32)
             s = s * sm_scale
             if ali is not None:
@@ -267,17 +325,27 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
             x_mid = x + attn_out
             h2 = _layer_norm(x_mid, p["ln2"], cfg.layer_norm_eps)
             x_out = x_mid + mlp(h2)
+        if quant_kv:
+            return (x_out, k_all, v_all, ks_all, vs_all), None
         return (x_out, k_all, v_all), None
 
-    (x, k_new, v_new), _ = jax.lax.scan(
-        layer, (x, cache["k"], cache["v"]),
-        (params["blocks"], windows, jnp.arange(cfg.num_layers)))
+    xs = (params["blocks"], windows, jnp.arange(cfg.num_layers))
+    if quant_kv:
+        (x, k_new, v_new, ks_new, vs_new), _ = jax.lax.scan(
+            layer, (x, cache["k"], cache["v"], cache["k_scale"],
+                    cache["v_scale"]), xs)
+    else:
+        (x, k_new, v_new), _ = jax.lax.scan(
+            layer, (x, cache["k"], cache["v"]), xs)
     x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bth,vh->btv", x, wte.astype(x.dtype))
     else:
         logits = _dense(x, params["lm_head"])
     new_cache = {"k": k_new, "v": v_new, "pos": pos + T_new}
+    if quant_kv:
+        new_cache["k_scale"] = ks_new
+        new_cache["v_scale"] = vs_new
     if pad is not None:
         new_cache["pad"] = pad
     return logits.astype(jnp.float32), new_cache
@@ -324,7 +392,7 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int],
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-@partial(jax.jit, static_argnums=(0, 3, 4, 6, 7, 8))
+@partial(jax.jit, static_argnums=(0, 3, 4, 6, 7, 8, 10))
 def generate(cfg: TransformerConfig,
              params: PyTree,
              input_ids: jnp.ndarray,
@@ -334,7 +402,8 @@ def generate(cfg: TransformerConfig,
              top_k: Optional[int] = None,
              top_p: Optional[float] = None,
              repetition_penalty: Optional[float] = None,
-             attention_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+             attention_mask: Optional[jnp.ndarray] = None,
+             kv_cache_dtype: Optional[str] = None) -> jnp.ndarray:
     """Prefill + single-token decode loop, one compiled program.
 
     input_ids [B, T_prompt] -> [B, T_prompt + max_new_tokens].
@@ -362,9 +431,19 @@ def generate(cfg: TransformerConfig,
         pad_lens = (T_in - jnp.sum(attention_mask.astype(jnp.int32), axis=1)
                     ).astype(jnp.int32)
     # round the workspace up to a decode-kernel-friendly block multiple
-    # (positions past the logical max are masked, never attended)
-    cache = init_cache(cfg, B, padded_cache_len(max_len), pad_lens=pad_lens)
-    logits, cache = forward_with_cache(cfg, params, input_ids, cache)
+    # (positions past the logical max are masked, never attended).
+    # kv_cache_dtype="int8": half the KV HBM (2x context/batch capacity),
+    # dequant-on-read attention — see init_cache.
+    kv_dtype = jnp.int8 if kv_cache_dtype == "int8" else None
+    padded_len = padded_cache_len(max_len)
+    cache = init_cache(cfg, B, padded_len, dtype=kv_dtype,
+                       pad_lens=pad_lens)
+    # static routing hint for the decode kernel: batched long generation
+    # (most of the preallocated cache dead through the run) is its regime
+    prefer_kernel = (B >= 2 and padded_len >= 4 * 512
+                     and T_in <= padded_len // 2)
+    logits, cache = forward_with_cache(cfg, params, input_ids, cache,
+                                       prefer_kernel=prefer_kernel)
 
     rep = repetition_penalty is not None and repetition_penalty != 1.0
     if rep:
@@ -393,7 +472,8 @@ def generate(cfg: TransformerConfig,
 
     def step(carry, _):
         tok, cache, rng, seen = carry
-        logits, cache = forward_with_cache(cfg, params, tok[:, None], cache)
+        logits, cache = forward_with_cache(cfg, params, tok[:, None], cache,
+                                           prefer_kernel=prefer_kernel)
         rng, r = jax.random.split(rng)
         nxt, seen = pick(logits[:, -1], seen, r)
         return (nxt, cache, rng, seen), tok
