@@ -421,3 +421,67 @@ def test_nvme_root_collision_namespacing(tmp_path):
     after = [e1.offload._master_host(j) for j in range(e1.offload.n_leaves)]
     for a, b in zip(before, after):
         np.testing.assert_array_equal(a, b)
+
+
+def test_swap_pipeline_overlap_ratio_synthetic_bandwidth():
+    """Round-3 Weak #6: the 'transfers hidden behind compute' claim of the
+    read-ahead/write-behind pipeline, made measurable. Pool stand-ins with a
+    KNOWN synthetic transfer time drive pipeline_pools; with reads of j+1
+    and write-backs of j overlapping compute of j, wall time approaches
+    n * max(transfer, compute) instead of the serial
+    n * (read + compute + write)."""
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    from deepspeed_tpu.runtime.swap_tensor import pipeline_pools
+
+    TRANSFER = 0.05             # synthetic one-way transfer time per leaf
+    COMPUTE = 0.06
+    N = 8
+
+    class SyntheticPool:
+        """read_async/write_async/wait contract of SwappedTensorPool with a
+        sleep-backed 'device link' on a worker thread."""
+
+        def __init__(self):
+            self._pool = ThreadPoolExecutor(max_workers=2)
+            self._pending = []
+            self.n_transfers = 0
+
+        def _xfer(self):
+            time.sleep(TRANSFER)
+
+        def read_async(self, j):
+            self.n_transfers += 1
+            self._pending.append(self._pool.submit(self._xfer))
+            return np.zeros(4, np.float32)
+
+        def write_async(self, j, data):
+            self.n_transfers += 1
+            self._pending.append(self._pool.submit(self._xfer))
+
+        def wait(self):
+            pending, self._pending = self._pending, []
+            for f in pending:
+                f.result()
+
+    pool = SyntheticPool()
+
+    def compute(j, views):
+        time.sleep(COMPUTE)
+
+    t0 = time.perf_counter()
+    pipeline_pools({"state": pool}, N, compute)
+    wall = time.perf_counter() - t0
+
+    assert pool.n_transfers == 2 * N                # every leaf read+written
+    serial = N * (2 * TRANSFER + COMPUTE)           # no overlap at all
+    ideal = N * max(2 * TRANSFER, COMPUTE) + 2 * TRANSFER   # fill/drain
+    overlap_ratio = serial / wall
+    # the pipeline must recover a real fraction of the transfer time:
+    # strictly faster than serial AND within 1.5x of the ideal bound
+    # (expected wall ~0.58 s; serial 1.28 s; 1.5*ideal 1.35 s would catch
+    # a no-overlap regression, 0.75*serial = 0.96 s catches it earlier)
+    assert wall < 0.75 * serial, (wall, serial)
+    assert wall < 1.5 * ideal, (wall, ideal)
+    assert overlap_ratio > 1.3, overlap_ratio
