@@ -45,14 +45,19 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
                        remat_policy: str = "dots", fused_loss=None,
                        pure_bf16: bool = False,
                        grad_accum_dtype=None,
-                       verbose: bool = True):
+                       verbose: bool = True,
+                       **model_kw):
     """Measure sustained train-step model TFLOPs/chip for a preset.
 
-    Returns the result dict (also printed as one JSON line when verbose).
+    Extra keyword args flow into ``build_model`` (``attention_impl``,
+    ``moe_experts``, ``moe_k``, …) so long-context and MoE variants run
+    through the same timing loop. Returns the result dict (also printed as
+    one JSON line when verbose).
     """
     import jax
     import deepspeed_tpu as ds
-    from deepspeed_tpu.models import build_model, fused_loss_passthrough
+    from deepspeed_tpu.models import (build_model, fused_loss_passthrough,
+                                      make_moe_loss)
     from deepspeed_tpu.models.transformer import causal_lm_loss, cross_entropy
 
     n_chips = len(jax.devices())
@@ -65,6 +70,7 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
     kw = dict(max_seq_len=max(seq, 512), remat=remat,
               remat_policy=remat_policy, fused_loss=fused_loss,
               loss_chunk=256)
+    kw.update(model_kw)
     model, cfg = build_model(preset, **kw)
     batch_size = micro * gas * max(n_chips, 1)
     config = {
@@ -95,6 +101,10 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
                else (causal_lm_loss if causal else
                      lambda out, b: cross_entropy(
                          out, b.get("labels", b["input_ids"]))))
+    if cfg.moe_experts > 0:
+        # MoE models emit (task_output, aux); fold the aux term in the same
+        # way training does so the timed step is the real thing
+        loss_fn = make_moe_loss(cfg.moe_aux_weight, base_loss=loss_fn)
     engine, *_ = ds.initialize(model=model, config=config, loss_fn=loss_fn,
                                example_batch=make_batch())
     float(engine.train_batch(make_batch())["loss"])   # compile
@@ -115,7 +125,7 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
     # FLOPs accounting: the 6N basis is what the reference's TFLOPS/GPU
     # numbers use (attention-free); the attention matmul term (12*L*H*S per
     # token fwd+bwd) is reported separately so MFU is honest
-    n_params = cfg.num_params()
+    n_params = cfg.num_active_params()
     tokens = batch_size * seq
     model_flops = 6.0 * n_params * tokens
     attn_flops = 12.0 * cfg.num_layers * cfg.hidden_size * seq * tokens
@@ -130,6 +140,12 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
         "vs_baseline": round(tflops / ref, 4) if ref else None,
         "detail": {"preset": preset, "seq": seq, "micro": micro, "gas": gas,
                    "batch": batch_size, "chips": n_chips,
+                   **({"moe_experts": cfg.moe_experts, "moe_k": cfg.moe_k,
+                       "params_total": cfg.num_params(),
+                       "params_active": n_params}
+                      if cfg.moe_experts > 0 else {}),
+                   **({"attention_impl": cfg.attention_impl}
+                      if cfg.attention_impl != "auto" else {}),
                    "zero_stage": zero_stage, "remat": remat,
                    "remat_policy": remat_policy if remat else None,
                    "pure_bf16": pure_bf16,

@@ -119,7 +119,21 @@ class TransformerConfig:
 
     def num_params(self) -> int:
         h, L, v = self.hidden_size, self.num_layers, self.vocab_size
-        per_layer = 4 * h * h + 2 * self.mlp_dim * h  # qkv+proj + fc1+fc2
+        mlp = 2 * self.mlp_dim * h * max(self.moe_experts, 1)
+        per_layer = 4 * h * h + mlp  # qkv+proj + fc1+fc2 (x experts for MoE)
+        if self.moe_experts > 0:
+            per_layer += h * self.moe_experts  # router
+        return v * h + self.max_seq_len * h + L * per_layer
+
+    def num_active_params(self) -> int:
+        """Params touched per token (== num_params for dense; MoE routes each
+        token through moe_k of moe_experts expert MLPs). This is the N that
+        belongs in the 6N FLOPs-per-token model."""
+        if self.moe_experts <= 0:
+            return self.num_params()
+        h, L, v = self.hidden_size, self.num_layers, self.vocab_size
+        per_layer = (4 * h * h + 2 * self.mlp_dim * h * self.moe_k
+                     + h * self.moe_experts)
         return v * h + self.max_seq_len * h + L * per_layer
 
     # -- tensor-parallel sharding rules (regex on param path -> PartitionSpec) --

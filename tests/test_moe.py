@@ -163,3 +163,37 @@ def test_moe_with_tp_composes():
         l1 = float(e1.train_batch(b)["loss"])
         l2 = float(e2.train_batch(b)["loss"])
         assert abs(l1 - l2) < 5e-3 + 0.01 * abs(l1), (i, l1, l2)
+
+
+def test_moe_param_accounting():
+    """num_params counts every expert; num_active_params counts the moe_k a
+    token routes through (the N that belongs in 6N FLOPs accounting)."""
+    from deepspeed_tpu.models.transformer import get_config
+
+    dense = get_config("gpt2-tiny")
+    assert dense.num_active_params() == dense.num_params()
+
+    moe = get_config("gpt2-tiny", moe_experts=4, moe_k=1)
+    h, L = moe.hidden_size, moe.num_layers
+    # total grows by (E-1) expert MLPs + router per layer
+    assert (moe.num_params() - dense.num_params()
+            == L * (3 * 2 * moe.mlp_dim * h + h * 4))
+    # active grows only by the router term
+    assert (moe.num_active_params() - dense.num_params() == L * h * 4)
+
+    moe2 = get_config("gpt2-tiny", moe_experts=4, moe_k=2)
+    assert (moe2.num_active_params() - moe.num_active_params()
+            == L * 2 * moe.mlp_dim * h)
+
+    # the flax param tree must agree with the analytic total
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import build_model
+    model, cfg = build_model("gpt2-tiny", moe_experts=4)
+    batch = {"input_ids": jnp.zeros((1, 8), jnp.int32)}
+    params = jax.eval_shape(lambda r: model.init(r, batch)["params"],
+                            jax.random.PRNGKey(0))
+    n_tree = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    # analytic model skips biases/layernorm scales (~0.1%); stay within 1%
+    assert abs(n_tree - cfg.num_params()) / n_tree < 0.01, \
+        (n_tree, cfg.num_params())
