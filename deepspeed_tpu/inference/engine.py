@@ -213,24 +213,9 @@ class InferenceEngine:
         (attention_mask) follow HF generate semantics."""
         from ..models.transformer import Transformer
         if isinstance(self.module, Transformer):
+            # left-pad validation + all-ones-mask normalization live in
+            # generate() itself (the shared entry point) — no duplicate here
             from ..models.generation import generate as _gen
-            if attention_mask is not None:
-                import numpy as _np
-                mask_np = _np.asarray(attention_mask)
-                if not (_np.diff(mask_np, axis=1) >= 0).all():
-                    # HF tokenizers pad RIGHT by default; a right-padded
-                    # mask silently decoded garbage here (the ragged path
-                    # assumes pads-first)
-                    raise ValueError(
-                        "generate() requires LEFT-padded prompts: every "
-                        "attention_mask row must be non-decreasing "
-                        "(0s then 1s). Re-tokenize with "
-                        "padding_side='left'.")
-                # an all-ones mask is a uniform batch: dropping it keeps the
-                # Pallas decode kernel engaged (the ragged path's per-sample
-                # masks force the jnp attention fallback)
-                attention_mask = None if mask_np.all() else jnp.asarray(
-                    mask_np)
             return _gen(self.module.cfg, self.params,
                         jnp.asarray(input_ids), max_new_tokens,
                         temperature, rng, top_k, top_p, repetition_penalty,

@@ -392,8 +392,43 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int],
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-@partial(jax.jit, static_argnums=(0, 3, 4, 6, 7, 8, 10))
 def generate(cfg: TransformerConfig,
+             params: PyTree,
+             input_ids: jnp.ndarray,
+             max_new_tokens: int,
+             temperature: float = 0.0,
+             rng: Optional[jax.Array] = None,
+             top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
+             repetition_penalty: Optional[float] = None,
+             attention_mask: Optional[jnp.ndarray] = None,
+             kv_cache_dtype: Optional[str] = None) -> jnp.ndarray:
+    """Host wrapper over the jitted generation program: validates the
+    attention_mask HERE (the shared entry point — benchmarks and library
+    users call generate() directly, not only through InferenceEngine).
+    HF tokenizers pad RIGHT by default, and a right-padded mask would
+    silently decode garbage (the ragged path assumes pads-first). See
+    _generate for the full contract."""
+    if attention_mask is not None:
+        mask_np = np.asarray(attention_mask)
+        if not (np.diff(mask_np, axis=1) >= 0).all():
+            raise ValueError(
+                "generate() requires LEFT-padded prompts: every "
+                "attention_mask row must be non-decreasing (0s then 1s). "
+                "Re-tokenize with padding_side='left'.")
+        if mask_np.all():
+            # uniform batch: dropping the mask keeps the Pallas decode
+            # kernel engaged (per-sample masks force the jnp fallback)
+            attention_mask = None
+        else:
+            attention_mask = jnp.asarray(mask_np)
+    return _generate(cfg, params, input_ids, max_new_tokens, temperature,
+                     rng, top_k, top_p, repetition_penalty, attention_mask,
+                     kv_cache_dtype)
+
+
+@partial(jax.jit, static_argnums=(0, 3, 4, 6, 7, 8, 10))
+def _generate(cfg: TransformerConfig,
              params: PyTree,
              input_ids: jnp.ndarray,
              max_new_tokens: int,

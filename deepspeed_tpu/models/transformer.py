@@ -280,9 +280,25 @@ def _spec_constraint(x, spec: P):
     mm = get_global_mesh()
     if mm is None:
         return x                       # plain CPU tests: no mesh, no layout
-    # a computation not laid out on the session mesh (profiler init,
-    # single-device inference, a smaller ad-hoc batch) can't take the
-    # constraint — detectable as non-divisible sharded dims
+    # scope check (sharding-in-types): activations of a computation whose
+    # inputs are laid out on a mesh carry that mesh in their aval; a plain
+    # -jit call on single-device/committed-elsewhere data carries an EMPTY
+    # abstract mesh, and pinning IT to the session mesh would be a device
+    # -scope error — exactly the ad-hoc case (profiler init, one-device
+    # side computation) that must run unconstrained. The flip side of the
+    # contract: a program gets mesh layouts only when its INPUTS are
+    # placed on the mesh (engine APIs do this; raw jit over uncommitted
+    # arrays runs unconstrained — device_put params/batch with a
+    # NamedSharding to opt in). Engine init traces run uncommitted and
+    # intentionally skip constraints: param placement comes from the init
+    # jit's out_shardings, not activation constraints.
+    aval_mesh = getattr(getattr(jax.typeof(x), "sharding", None), "mesh",
+                        None)
+    if aval_mesh is None or getattr(aval_mesh, "empty", False):
+        return x
+    # a computation not laid out on the session mesh (e.g. a smaller
+    # ad-hoc batch) can't take the constraint — detectable as
+    # non-divisible sharded dims
     for dim, entry in enumerate(spec[:np.ndim(x)]):
         if entry is None or entry is P.UNCONSTRAINED:
             continue

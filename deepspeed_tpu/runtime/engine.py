@@ -840,9 +840,20 @@ class DeepSpeedEngine:
                 lr = float(jax.device_get(self.lr_fn(self.state.step)))
             else:
                 lr = float(jax.device_get(self._current_lr()))
+            # the runner's program schedule (warmup freeze / v-update and
+            # local-step intervals) must count only EFFECTIVE steps: an
+            # fp16 overflow reverts the optimizer state in-jit, and the
+            # reference's zoadam/onebit counters do not advance on a
+            # skipped torch step. state.step is exactly that count (step +
+            # 1 - overflow) and survives checkpoint resume; reading it
+            # costs one scalar D2H only when a scaler can actually skip
+            scaler = getattr(self.onebit, "loss_scaler", None)
+            sched_step = (int(jax.device_get(self.state.step))
+                          if scaler is not None and scaler.enabled
+                          else self.global_steps)
             new_p, new_s, loss, norm, overflow, new_scale = self.onebit.step(
                 self.state.params, self.state.opt_state["onebit"], micros,
-                self.next_rng(), lr, self.global_steps,
+                self.next_rng(), lr, sched_step,
                 scale_state=self.state.scale)
             # bookkeeping stays on device (no host sync mid-dispatch), the
             # fused path's step + 1 - overflow convention: overflow does not
@@ -937,6 +948,23 @@ class DeepSpeedEngine:
         # materialization already; dropping params_dev here frees the
         # full-model device copy between forward and backward
         del params_dev
+        prev = getattr(self, "_pending", None)
+        if grads is not None and prev is not None and prev[3] is not None:
+            # a fused-gradient forward whose predecessor's grads were never
+            # consumed: scoring loops that never call backward() are paying
+            # the fused fwd+bwd program (FLOPs + a full gradient pytree)
+            # per call — make the train-mode default diagnosable instead of
+            # silent. (The 1-bit branch runs a forward-only program, so
+            # it never counts; backward() resets the streak.)
+            self._fwd_no_bwd = getattr(self, "_fwd_no_bwd", 0) + 1
+            if self._fwd_no_bwd >= 3:
+                from ..utils.logging import warning_once
+                warning_once(
+                    "3+ train-mode forward() calls without backward(): "
+                    "each one runs the fused forward+backward program and "
+                    "materializes gradients. For scoring/inference call "
+                    "engine.eval() first (forward-only program, no "
+                    "gradient residuals).")
         self._pending = (batch, rng, loss, grads)
         return loss
 
@@ -958,6 +986,7 @@ class DeepSpeedEngine:
             raise RuntimeError("backward() called before forward()")
         batch, rng, loss_val, grads = self._pending
         self._pending = None
+        self._fwd_no_bwd = 0          # the pair completed: not a scoring loop
         if grads is None:
             # eval-mode forward has no gradient residuals (that is its cost
             # model); silently differentiating a DIFFERENT computation

@@ -43,6 +43,53 @@ from .comm.compressed import chunk_elems, compressed_allreduce
 PyTree = Any
 
 
+def stacked_local_grads(runner, params, micros, rng, scale):
+    """shard_map over the DP axis: grads stacked [n, ...] (dim0 sharded),
+    NO cross-rank reduction — the whole point of the explicit-collective
+    optimizers. Shared by the 1-bit (OneBitRunner) and 0/1
+    (ZeroOneRunner) gradient stages.
+
+    ``scale`` is the fp16 loss scale (1.0 when scaling is off): the loss
+    is scaled inside the backward and the stacked grads come out UNSCALED
+    (divided back out with the gas normalization), so inf/nan from a
+    genuine fp16 overflow still propagates for detection. Returns
+    (grads_st, loss_st, sq_st), every leaf stacked per-rank on dim0."""
+    gas = runner.gas
+
+    def local(params, micros_l, rng, scale):
+        r = jax.random.fold_in(rng, lax.axis_index(runner.axis))
+        rngs = jax.random.split(r, gas)
+
+        def body(acc, xs):
+            micro, rr = xs
+            cparams = jax.tree.map(
+                lambda p: p.astype(runner.compute_dtype), params)
+
+            def lossf(p):
+                out = runner.apply_fn(p, micro, rr, True)
+                # scale in f32: casting the scale itself to fp16 turns
+                # 2^16 into inf and every step would spuriously overflow
+                return runner.loss_fn(out, micro).astype(jnp.float32) * scale
+
+            l, g = jax.value_and_grad(lossf)(cparams)
+            return jax.tree.map(
+                lambda a, gg: a + gg.astype(jnp.float32), acc, g), l
+
+        zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                            params)
+        gsum, losses = lax.scan(body, zero, (micros_l, rngs))
+        g = jax.tree.map(lambda x: x[None] / (gas * scale), gsum)
+        sq = sum(jnp.sum(jnp.square(x)) for x in jax.tree.leaves(g))
+        return g, (jnp.mean(losses) / scale)[None], sq[None]
+
+    mapped = jax.shard_map(
+        local, mesh=runner.mesh,
+        in_specs=(P(), P(None, runner.axis), P(), P()),
+        out_specs=(P(runner.axis), P(runner.axis), P(runner.axis)),
+        axis_names={runner.axis}, check_vma=False)
+    return mapped(params, micros, rng, scale)
+
+
 class OneBitRunner:
     """Owns optimizer state + the two-stage compiled train step."""
 
@@ -123,46 +170,8 @@ class OneBitRunner:
     # -- the per-rank grad stage ---------------------------------------------
 
     def _local_grads(self, params, micros, rng, scale):
-        """shard_map over the DP axis: grads stacked [n, ...] (dim0 sharded),
-        NO cross-rank reduction — the whole point of the explicit mode.
-        ``scale`` is the fp16 loss scale (1.0 when scaling is off): the loss
-        is scaled inside the backward and the stacked grads come out
-        UNSCALED (divided back out with the gas normalization), so inf/nan
-        from a genuine fp16 overflow still propagates for detection."""
-        gas = self.gas
-
-        def local(params, micros_l, rng, scale):
-            r = jax.random.fold_in(rng, lax.axis_index(self.axis))
-            rngs = jax.random.split(r, gas)
-
-            def body(acc, xs):
-                micro, rr = xs
-                cparams = jax.tree.map(
-                    lambda p: p.astype(self.compute_dtype), params)
-
-                def lossf(p):
-                    out = self.apply_fn(p, micro, rr, True)
-                    # scale in f32: casting the scale itself to fp16 turns
-                    # 2^16 into inf and every step would spuriously overflow
-                    return self.loss_fn(out, micro).astype(jnp.float32) * scale
-
-                l, g = jax.value_and_grad(lossf)(cparams)
-                return jax.tree.map(
-                    lambda a, gg: a + gg.astype(jnp.float32), acc, g), l
-
-            zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
-                                params)
-            gsum, losses = lax.scan(body, zero, (micros_l, rngs))
-            g = jax.tree.map(lambda x: x[None] / (gas * scale), gsum)
-            sq = sum(jnp.sum(jnp.square(x)) for x in jax.tree.leaves(g))
-            return g, (jnp.mean(losses) / scale)[None], sq[None]
-
-        mapped = jax.shard_map(
-            local, mesh=self.mesh,
-            in_specs=(P(), P(None, self.axis), P(), P()),
-            out_specs=(P(self.axis), P(self.axis), P(self.axis)),
-            axis_names={self.axis}, check_vma=False)
-        grads_st, loss_st, sq_st = mapped(params, micros, rng, scale)
+        grads_st, loss_st, sq_st = stacked_local_grads(
+            self, params, micros, rng, scale)
         return grads_st, jnp.mean(loss_st), sq_st
 
     # -- update math ---------------------------------------------------------

@@ -54,6 +54,21 @@ class PipelineEngine(DeepSpeedEngine):
                 # a user loss runs per-micro at the last stage (per-micro
                 # losses averaged — the reference _aggregate_total_loss)
                 custom_loss = lf
+                if getattr(getattr(self.module, "cfg", None),
+                           "moe_experts", 0) > 0 and aux_weight is None:
+                    # the 1F1B executor computes the aux term itself (the
+                    # scalar rides the pipe) and hands the last stage BARE
+                    # logits — a gpipe-style loss_fn expecting the model's
+                    # (logits, aux) tuple would silently index the batch
+                    # dim instead, and one folding aux in itself would
+                    # double-count it
+                    raise ValueError(
+                        "pipeline.schedule='1f1b' with an MoE model needs "
+                        "the loss built by models.make_moe_loss(aux_weight, "
+                        "base_loss=...): the executor computes the aux "
+                        "term itself and passes the base loss bare logits, "
+                        "so a raw loss_fn written against the model's "
+                        "(logits, aux) output would misread its input.")
                 from ...utils.logging import warning_once
                 warning_once(
                     "pipeline.schedule='1f1b' computes a custom loss_fn "

@@ -194,6 +194,29 @@ def pipeline_1f1b_value_and_grad(
             param_ids = {id(l) for l in jax.tree.leaves(local)}
             res_static = [id(l) in param_ids for l in res_leaves]
             static_vals = [l for l, st in zip(res_leaves, res_static) if st]
+            # the id() match is best-effort: if jax stops passing weights
+            # through as identical objects (or the body casts/constrains
+            # its kernels first), everything classifies dynamic and the
+            # rings hold slots x stage-weights of live copies — the exact
+            # memory this mode exists to bound. Make that degradation
+            # loud instead of silent.
+            dyn_bytes = sum(
+                int(np.prod(l.shape)) * l.dtype.itemsize
+                for l, st in zip(res_leaves, res_static) if not st)
+            par_bytes = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                            for l in jax.tree.leaves(local))
+            if par_bytes and not any(res_static) \
+                    and dyn_bytes >= par_bytes:
+                from ...utils.logging import warning_once
+                warning_once(
+                    "1F1B store_outputs: no vjp residual was identified "
+                    "as a tick-invariant stage weight (0 of "
+                    f"{len(res_leaves)} leaves; ringing "
+                    f"{dyn_bytes / 1e6:.1f} MB/slot vs "
+                    f"{par_bytes / 1e6:.1f} MB of stage params). The "
+                    "ring buffers will hold a live copy of the stage's "
+                    "weight-derived residuals PER SLOT — if memory "
+                    "matters here, use backward='recompute'.")
             rings["res"] = [
                 jnp.zeros((slots,) + l.shape, l.dtype)
                 for l, st in zip(res_leaves, res_static) if not st]

@@ -179,40 +179,10 @@ class ZeroOneRunner:
     # -- per-rank grad stage ---------------------------------------------------
 
     def _stacked_grads(self, params, micros, rng, scale):
-        """shard_map over the DP axis: stacked per-rank grads at the shared
-        base params, no reduction (variance-phase programs)."""
-        gas = self.gas
-
-        def local(params, micros_l, rng, scale):
-            r = jax.random.fold_in(rng, lax.axis_index(self.axis))
-            rngs = jax.random.split(r, gas)
-
-            def body(acc, xs):
-                micro, rr = xs
-                cparams = jax.tree.map(
-                    lambda p: p.astype(self.compute_dtype), params)
-
-                def lossf(p):
-                    out = self.apply_fn(p, micro, rr, True)
-                    return self.loss_fn(out, micro).astype(jnp.float32) * scale
-
-                l, g = jax.value_and_grad(lossf)(cparams)
-                return jax.tree.map(
-                    lambda a, gg: a + gg.astype(jnp.float32), acc, g), l
-
-            zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
-                                params)
-            gsum, losses = lax.scan(body, zero, (micros_l, rngs))
-            g = jax.tree.map(lambda x: x[None] / (gas * scale), gsum)
-            sq = sum(jnp.sum(jnp.square(x)) for x in jax.tree.leaves(g))
-            return g, (jnp.mean(losses) / scale)[None], sq[None]
-
-        mapped = jax.shard_map(
-            local, mesh=self.mesh,
-            in_specs=(P(), P(None, self.axis), P(), P()),
-            out_specs=(P(self.axis), P(self.axis), P(self.axis)),
-            axis_names={self.axis}, check_vma=False)
-        return mapped(params, micros, rng, scale)
+        """Stacked per-rank grads at the shared base params, no reduction
+        (variance-phase programs) — the shared 1-bit/0-1 gradient stage."""
+        from .onebit import stacked_local_grads
+        return stacked_local_grads(self, params, micros, rng, scale)
 
     # -- variance-phase programs ----------------------------------------------
 

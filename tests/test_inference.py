@@ -584,3 +584,26 @@ def test_int8_kv_cache_parity_and_capacity():
     bytes8 = (cache8["k"].nbytes + cache8["v"].nbytes
               + cache8["k_scale"].nbytes + cache8["v_scale"].nbytes)
     assert bytes8 < 0.32 * bytes16, (bytes8, bytes16)   # f32 ref: ~0.28x
+
+
+def test_generate_rejects_right_padded_mask():
+    """The left-pad guard lives in models.generation.generate itself (the
+    shared entry point), not only in the InferenceEngine wrapper — a direct
+    caller with an HF-default right-padded mask must fail loudly, not
+    silently decode garbage."""
+    model, cfg, params = _model_and_params(seed=5)
+    rng = np.random.default_rng(6)
+    ids = np.zeros((2, 8), np.int64)
+    mask = np.zeros((2, 8), np.int64)
+    ids[0], mask[0] = rng.integers(1, 128, size=8), 1
+    ids[1, :5] = rng.integers(1, 128, size=5)
+    mask[1, :5] = 1                              # right-padded (HF default)
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        generate(cfg, params, jnp.asarray(ids), 4,
+                 attention_mask=jnp.asarray(mask))
+    # an all-ones mask is accepted and equals the maskless call
+    ids2 = rng.integers(1, 128, size=(2, 8))
+    a = generate(cfg, params, jnp.asarray(ids2), 4,
+                 attention_mask=jnp.ones((2, 8), np.int64))
+    b = generate(cfg, params, jnp.asarray(ids2), 4)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -184,3 +184,34 @@ def test_fused_loss_encoder_no_shift():
     l1m = cross_entropy(m1.apply({"params": params}, b2), jnp.asarray(labels))
     l2m = m2.apply({"params": params}, b2)
     assert abs(float(l1m - l2m)) < 1e-5
+
+
+def test_adhoc_jit_off_mesh_runs_unconstrained():
+    """With a multi-device session mesh installed, a plain-jit model call on
+    data committed to ONE device must run unconstrained (the activation
+    constraints would otherwise pin it to the full mesh and fail dispatch
+    with an incompatible-devices error)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices")
+    model, cfg = build_model("gpt2-tiny", hidden_size=64, num_layers=2,
+                             num_heads=4, vocab_size=256, max_seq_len=64,
+                             attention_impl="reference")
+    engine, *_ = ds.initialize(
+        model=model,
+        config={"train_batch_size": 16,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1}},
+        loss_fn=causal_lm_loss,
+        example_batch={"input_ids": np.zeros((16, 32), np.int64)})
+    # divisible batch, params + inputs committed to a non-default device
+    params = jax.device_put(jax.device_get(engine.state.params),
+                            jax.devices()[1])
+    x = jax.device_put(jnp.zeros((8, 32), jnp.int32), jax.devices()[1])
+    out = jax.jit(lambda p, b: model.apply({"params": p}, b))(
+        params, {"input_ids": x})
+    assert out.shape == (8, 32, 256)
+    assert {d.id for d in out.devices()} == {1}
+    # the session engine still steps (its program keeps the mesh layout)
+    m = engine.train_batch({"input_ids": np.random.default_rng(1).integers(
+        0, 256, size=(16, 32))})
+    assert np.isfinite(float(m["loss"]))

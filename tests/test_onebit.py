@@ -445,3 +445,33 @@ def test_zeroone_trains_and_local_steps_are_collective_free():
     # compressed steps move far fewer bytes than the exact v-step
     assert audit["cstep"] * 3 <= audit["vstep"], audit
     assert audit["boundary"] * 3 <= audit["vstep"], audit
+
+
+def test_overflow_does_not_consume_schedule_steps():
+    """An fp16 overflow reverts the optimizer state in-jit; the runner's
+    program schedule (freeze / v-update / local-step intervals) must not
+    advance past the skipped step — the reference's onebit/zoadam counters
+    only move on executed torch steps."""
+    cfg = _onebit_config("OneBitAdam", freeze_step=12, lr=2e-3)
+    cfg["fp16"] = {"enabled": True, "initial_scale_power": 8, "hysteresis": 1}
+    engine, *_ = ds.initialize(model=SimpleModel(),
+                               example_batch=random_batch(16), config=cfg)
+    seen = []
+    orig = engine.onebit.step
+
+    def spy(params, state, micros, rng, lr, step, **kw):
+        seen.append(step)
+        return orig(params, state, micros, rng, lr, step, **kw)
+
+    engine.onebit.step = spy
+    m0 = engine.train_batch(random_batch(16, seed=0))
+    assert not bool(m0["overflow"])
+    bad = random_batch(16, seed=99)
+    bad["x"] = (bad["x"] * 1e30).astype(np.float32)
+    m1 = engine.train_batch(bad)
+    assert bool(m1["overflow"])
+    m2 = engine.train_batch(random_batch(16, seed=1))
+    assert not bool(m2["overflow"])
+    # good step consumed slot 0; the overflow attempted slot 1 and was
+    # skipped; the next good step must RETRY slot 1, not move to slot 2
+    assert seen == [0, 1, 1], seen

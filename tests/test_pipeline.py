@@ -580,3 +580,32 @@ def test_ladder_zero1_pp_moe_ep_composition():
     losses = [float(engine.train_batch(mk())["loss"]) for _ in range(6)]
     assert np.all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_1f1b_moe_requires_marked_loss():
+    require_devices(2)
+    """A raw custom loss on MoE+1F1B is rejected loudly: gpipe hands it the
+    model's (logits, aux) tuple but the 1F1B executor computes aux itself
+    and passes bare logits — silent misreads must be impossible."""
+    from deepspeed_tpu.models.transformer import make_moe_loss
+    piped, cfg = _tiny_piped(moe_experts=4)
+
+    def raw_loss(out, b):          # written against the gpipe contract
+        logits, aux = out
+        return causal_lm_loss(logits, b) + 0.01 * aux
+
+    with pytest.raises(ValueError, match="make_moe_loss"):
+        _init_engine(piped, cfg, loss_fn=raw_loss)
+
+    # the supported spelling: make_moe_loss-wrapped custom base loss runs
+    # and trains (base receives bare logits on BOTH schedules)
+    def base(logits, b):
+        return causal_lm_loss(logits, b)
+
+    piped2, cfg2 = _tiny_piped(moe_experts=4)
+    engine = _init_engine(piped2, cfg2,
+                          loss_fn=make_moe_loss(0.01, base_loss=base))
+    rng = np.random.default_rng(5)
+    losses = [float(engine.train_batch(
+        _mk_batch(rng, cfg2.vocab_size, 16, 32))["loss"]) for _ in range(4)]
+    assert losses[-1] < losses[0], losses
