@@ -99,4 +99,18 @@ def argparse_suppress():
     return argparse.SUPPRESS
 
 
+
+
+def default_inference_config():
+    """reference: deepspeed/__init__.py:246 — the default inference config
+    as a plain dict (feed it back to init_inference after edits)."""
+    from .inference.config import DeepSpeedInferenceConfig
+    return DeepSpeedInferenceConfig().model_dump()
+
+
+from .models.transformer import (  # noqa: E402  (reference export names)
+    DeepSpeedTransformerLayer, DeepSpeedTransformerConfig)
+from .models.hf import (  # noqa: E402
+    replace_transformer_layer, revert_transformer_layer)
+
 from . import zero  # noqa: E402  (re-export; depends on runtime)

@@ -409,8 +409,17 @@ def generate(cfg: TransformerConfig,
     HF tokenizers pad RIGHT by default, and a right-padded mask would
     silently decode garbage (the ragged path assumes pads-first). See
     _generate for the full contract."""
+    if isinstance(attention_mask, jax.core.Tracer):
+        # under an outer jit/vmap/scan the mask is a tracer — host
+        # validation is impossible there; inline the jitted program as the
+        # pre-wrapper generate() did
+        return _generate(cfg, params, input_ids, max_new_tokens,
+                         temperature, rng, top_k, top_p, repetition_penalty,
+                         attention_mask, kv_cache_dtype)
     if attention_mask is not None:
-        mask_np = np.asarray(attention_mask)
+        # int cast first: np.diff on a BOOL array is XOR (always >= 0), so
+        # a bool right-padded mask would sail through the guard
+        mask_np = np.asarray(attention_mask, dtype=np.int32)
         if not (np.diff(mask_np, axis=1) >= 0).all():
             raise ValueError(
                 "generate() requires LEFT-padded prompts: every "

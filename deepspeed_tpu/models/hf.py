@@ -786,14 +786,42 @@ HF_POLICIES = {
 }
 
 
-def load_hf(model, arch: str = None):
+def load_hf(model, arch: str = None, config=None):
     """Dispatch on HF architecture name (reference: replace_module.py policy
     matching by class). Exact matches only: substring matching misfires on
-    sibling arches (GPTNeoX contains 'gptneo', Roberta contains 'bert')."""
+    sibling arches (GPTNeoX contains 'gptneo', Roberta contains 'bert').
+    ``config``: explicit HF config for the raw-state-dict path (live models
+    carry their own)."""
     arch = arch or type(model).__name__
     fn = HF_POLICIES.get(arch) or HF_POLICIES.get(arch.lower())
     if fn is not None:
-        return fn(model)
+        return fn(model, config=config)
     raise NotImplementedError(
         f"no import policy for architecture '{arch}'; have "
         f"{sorted(k for k in HF_POLICIES if not k.islower())}")
+
+
+def replace_transformer_layer(model, config=None, arch: str = None,
+                              dtype=None):
+    """Reference-API shim (module_inject/replace_module.py:300): where the
+    reference rewires a torch model's layers IN PLACE to fused CUDA
+    modules, the TPU-native substitution is functional — the matched
+    policy maps the HF weights onto the in-house Transformer (XLA fusion +
+    Pallas attention; models/transformer.py) and returns
+    ``(module, params, cfg)``. The input torch model is never mutated;
+    serve the returned module through InferenceEngine (which calls this
+    path itself via ``models.hf.load_hf``).
+    """
+    import dataclasses
+    from .transformer import Transformer
+    params, cfg = load_hf(model, arch=arch, config=config)
+    if dtype is not None:
+        cfg = dataclasses.replace(cfg, dtype=dtype)
+    return Transformer(cfg), params, cfg
+
+
+def revert_transformer_layer(model, *_, **__):
+    """Reference-API shim (deepspeed/__init__.py:35): the reference undoes
+    its in-place layer surgery. The TPU substitution is functional — the
+    original model was never touched — so revert returns it unchanged."""
+    return model

@@ -292,10 +292,16 @@ def _spec_constraint(x, spec: P):
     # NamedSharding to opt in). Engine init traces run uncommitted and
     # intentionally skip constraints: param placement comes from the init
     # jit's out_shardings, not activation constraints.
-    aval_mesh = getattr(getattr(jax.typeof(x), "sharding", None), "mesh",
-                        None)
-    if aval_mesh is None or getattr(aval_mesh, "empty", False):
-        return x
+    # programs placing inputs via jit(in_shardings=...) ALSO trace with an
+    # empty aval mesh (verified on jax 0.9) and would skip constraints;
+    # DSTPU_FORCE_MESH_CONSTRAINTS=1 restores the always-constrain
+    # behavior for that idiom (documented in docs/USAGE.md)
+    import os
+    if os.environ.get("DSTPU_FORCE_MESH_CONSTRAINTS") != "1":
+        aval_mesh = getattr(getattr(jax.typeof(x), "sharding", None),
+                            "mesh", None)
+        if aval_mesh is None or getattr(aval_mesh, "empty", False):
+            return x
     # a computation not laid out on the session mesh (e.g. a smaller
     # ad-hoc batch) can't take the constraint — detectable as
     # non-divisible sharded dims
@@ -787,3 +793,47 @@ def build_model(name_or_cfg, **overrides) -> Tuple[Transformer, TransformerConfi
     cfg = (name_or_cfg if isinstance(name_or_cfg, TransformerConfig)
            else get_config(name_or_cfg, **overrides))
     return Transformer(cfg), cfg
+
+
+class DeepSpeedTransformerLayer(nn.Module):
+    """Reference-parity fused transformer layer
+    (ops/transformer/transformer.py:459 DeepSpeedTransformerLayer): one
+    attention+MLP block applied to [B, S, H] hidden states. On TPU the
+    "fused kernels" are XLA fusion + the Pallas attention the Block
+    routes to; configure with TransformerConfig (exported under the
+    reference's name DeepSpeedTransformerConfig — batch size and seq
+    length are runtime shapes here, not config fields)."""
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None,
+                 train: bool = False):
+        if attention_mask is not None:
+            if jnp.issubdtype(jnp.asarray(attention_mask).dtype,
+                              jnp.floating):
+                # the reference feeds ADDITIVE float masks ((1-m)*-1e4);
+                # this layer's contract is boolean True=attend — passing
+                # the additive form through jnp.where would attend exactly
+                # the inverted positions with no error
+                raise ValueError(
+                    "DeepSpeedTransformerLayer takes a boolean/int "
+                    "attention_mask (True/1 = attend), not the additive "
+                    "float mask; convert with mask = additive_mask >= 0")
+            attention_mask = jnp.asarray(attention_mask).astype(bool)
+            if attention_mask.ndim == 2:      # HF-style [B, S] key mask
+                attention_mask = attention_mask[:, None, None, :]
+        if self.config.moe_experts > 0:
+            # the single-layer shim has no channel for the router's
+            # load-balancing aux loss; dropping it silently would collapse
+            # the experts — use build_model(..., moe_experts=...) whose
+            # (logits, aux) contract carries it
+            raise ValueError(
+                "DeepSpeedTransformerLayer does not support MoE configs "
+                "(the router aux loss would be silently dropped); build "
+                "the full model via models.build_model(moe_experts=...)")
+        y, _aux = Block(self.config)(hidden_states, attention_mask, train)
+        return y
+
+
+# reference export name (deepspeed/__init__.py:24-25)
+DeepSpeedTransformerConfig = TransformerConfig

@@ -1189,6 +1189,65 @@ class DeepSpeedEngine:
     def module_state_dict(self) -> Dict[str, np.ndarray]:
         return ckpt_lib._tree_to_flat_dict(self._params_device())
 
+    def load_module_state_dict(self, state_dict: Dict[str, np.ndarray],
+                               strict: bool = True):
+        """Load weights only (reference: engine.load_module_state_dict,
+        engine.py:2582) — the inverse of ``module_state_dict``. Leaves are
+        re-placed with the engine's param shardings, and EVERY weight
+        representation follows: the fp32 master (else the next step would
+        recompute params from the stale master, silently discarding the
+        load) and the offloaded host master. Optimizer state, loss scale,
+        and counters are untouched (use load_checkpoint for full resume).
+        ``strict=False`` keeps current values for missing keys and ignores
+        unexpected ones."""
+        from jax.tree_util import tree_flatten_with_path
+        if self.offload is not None:
+            # reference the host masters LAZILY (thunk leaves): no device
+            # materialization (transient mode exists because the model
+            # doesn't fit), no eager copy of the optimizer slots — only
+            # the leaves MISSING from the state_dict are ever read
+            ref_tree = self.offload.state_dict(lazy=True)["master"]
+        else:
+            ref_tree = self.state.params
+        keys = [ckpt_lib.path_str(p)
+                for p, _ in tree_flatten_with_path(ref_tree)[0]]
+        if strict:
+            missing = sorted(set(keys) - set(state_dict))
+            unexpected = sorted(set(state_dict) - set(keys))
+            if missing or unexpected:
+                raise KeyError(
+                    f"state_dict mismatch: missing={missing[:5]} "
+                    f"unexpected={unexpected[:5]} (strict=True)")
+
+        if self.offload is not None:
+            # fp32 masters take loaded values ONLY for keys present in the
+            # state_dict (merging absent keys from the bf16 device params
+            # would round them — the lossy-master failure this method
+            # exists to prevent); absent leaves are never even read —
+            # a partial load costs I/O proportional to what it loads
+            updates = {j: state_dict[k]
+                       for j, k in enumerate(keys) if k in state_dict}
+            if updates:
+                self.offload.update_master_leaves(updates)
+            if self._transient_params:
+                return                      # nothing device-resident to touch
+
+        def place_present(tree):
+            # present keys re-place onto the leaf's sharding; ABSENT keys
+            # keep the live device leaf — no host gather, no re-upload
+            clp, ctd = tree_flatten_with_path(tree)
+            return jax.tree.unflatten(ctd, [
+                jax.device_put(jnp.asarray(state_dict[k], dtype=leaf.dtype),
+                               leaf.sharding)
+                if (k := ckpt_lib.path_str(p)) in state_dict else leaf
+                for p, leaf in clp])
+
+        params = place_present(self.state.params)
+        master = self.state.master
+        if self.keep_master and master != ():
+            master = place_present(master)
+        self.state = self.state.replace(params=params, master=master)
+
     # ----------------------------------------------------------- checkpointing
 
     def _ckpt_view(self, lazy: bool = False):

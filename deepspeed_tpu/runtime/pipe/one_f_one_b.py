@@ -200,23 +200,28 @@ def pipeline_1f1b_value_and_grad(
             # rings hold slots x stage-weights of live copies — the exact
             # memory this mode exists to bound. Make that degradation
             # loud instead of silent.
-            dyn_bytes = sum(
-                int(np.prod(l.shape)) * l.dtype.itemsize
-                for l, st in zip(res_leaves, res_static) if not st)
             par_bytes = sum(int(np.prod(l.shape)) * l.dtype.itemsize
                             for l in jax.tree.leaves(local))
-            if par_bytes and not any(res_static) \
-                    and dyn_bytes >= par_bytes:
+            # degradation signal: WEIGHT-SHAPED residuals that failed the
+            # id() match (a cast/constrained kernel riding the rings) —
+            # plain activation residuals are the mode's normal cost and
+            # must not trip this
+            par_shapes = {l.shape for l in jax.tree.leaves(local)}
+            stray_bytes = sum(
+                int(np.prod(l.shape)) * l.dtype.itemsize
+                for l, st in zip(res_leaves, res_static)
+                if not st and l.shape in par_shapes)
+            if par_bytes and stray_bytes >= par_bytes // 2:
                 from ...utils.logging import warning_once
                 warning_once(
-                    "1F1B store_outputs: no vjp residual was identified "
-                    "as a tick-invariant stage weight (0 of "
-                    f"{len(res_leaves)} leaves; ringing "
-                    f"{dyn_bytes / 1e6:.1f} MB/slot vs "
-                    f"{par_bytes / 1e6:.1f} MB of stage params). The "
-                    "ring buffers will hold a live copy of the stage's "
-                    "weight-derived residuals PER SLOT — if memory "
-                    "matters here, use backward='recompute'.")
+                    "1F1B store_outputs: "
+                    f"{stray_bytes / 1e6:.1f} MB/slot of weight-shaped "
+                    "vjp residuals failed the tick-invariance match "
+                    f"(stage params: {par_bytes / 1e6:.1f} MB; "
+                    f"{sum(res_static)} of {len(res_leaves)} leaves "
+                    "matched). The ring buffers hold that much live PER "
+                    "SLOT — if memory matters here, use "
+                    "backward='recompute'.")
             rings["res"] = [
                 jnp.zeros((slots,) + l.shape, l.dtype)
                 for l, st in zip(res_leaves, res_static) if not st]

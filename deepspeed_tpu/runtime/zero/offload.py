@@ -311,9 +311,9 @@ class HostOffloadOptimizer:
                 "state": {s: self.treedef.unflatten(ts)
                           for s, ts in slots.items()}}
 
-    def load_state_dict(self, sd: Dict[str, Any]) -> None:
-        master = [np.ascontiguousarray(np.asarray(m, np.float32))
-                  for m in self.treedef.flatten_up_to(sd["master"])]
+    def _write_master(self, master: List[np.ndarray]) -> None:
+        """Install a new fp32 master list (NVMe pool writes with bounded
+        in-flight staging, or RAM mirror + bf16 staging rebuild)."""
         if self.param_pool is not None:
             for j, m in enumerate(master):
                 self.param_pool.write_async(j, m)
@@ -325,6 +325,10 @@ class HostOffloadOptimizer:
             self._bf16_staging = [
                 m.astype(_BF16) if _BF16 is not None else None
                 for m in self.master]
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self._write_master([np.ascontiguousarray(np.asarray(m, np.float32))
+                            for m in self.treedef.flatten_up_to(sd["master"])])
         per_slot = {s: self.treedef.flatten_up_to(sd["state"][s])
                     for s in self.slot_names}
         state = [{s: np.asarray(per_slot[s][j], np.float32)
@@ -337,6 +341,23 @@ class HostOffloadOptimizer:
                 self.swapper.pools[s].wait()
         else:
             self.state = state
+
+    def update_master_leaves(self, updates: Dict[int, np.ndarray]) -> None:
+        """Overwrite SELECTED fp32 master leaves (by flatten index) — the
+        weights-only load path (engine.load_module_state_dict). Leaves not
+        in ``updates`` are never read or rewritten (no NVMe round trip for
+        a partial load); optimizer state slots are untouched."""
+        for j, m in sorted(updates.items()):
+            m = np.ascontiguousarray(np.asarray(jax.device_get(m),
+                                                np.float32))
+            if self.param_pool is not None:
+                self.param_pool.write_async(j, m)
+            else:
+                self.master[j] = m.reshape(self.shapes[j])
+                if _BF16 is not None:
+                    self._bf16_staging[j] = self.master[j].astype(_BF16)
+        if self.param_pool is not None:
+            self.param_pool.wait()
 
     def current_params_device(self) -> PyTree:
         if self.param_pool is not None:

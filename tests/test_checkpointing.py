@@ -206,3 +206,53 @@ def test_sharded_write_replicated_dedup(tmp_path):
     write_shard_npz({"x": x}, path)
     names = zipfile.ZipFile(path).namelist()
     assert sum(1 for n in names if n.startswith("x::")) == 1, names
+
+
+def test_load_module_state_dict_roundtrip():
+    """module_state_dict -> load_module_state_dict restores weights only
+    (reference: engine.load_module_state_dict, engine.py:2582): params
+    transfer across engines, optimizer state/counters stay put, and strict
+    mode rejects mismatched key sets."""
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+    e1, *_ = ds.initialize(model=SimpleModel(), config=cfg,
+                           example_batch=random_batch(8))
+    for i in range(3):
+        e1.train_batch(random_batch(8, seed=i))
+    sd = e1.module_state_dict()
+
+    e2, *_ = ds.initialize(model=SimpleModel(), config=cfg,
+                           example_batch=random_batch(8))
+    step_before = int(jax.device_get(e2.state.step))
+    e2.load_module_state_dict(sd)
+    assert int(jax.device_get(e2.state.step)) == step_before  # weights only
+    for k, v in e2.module_state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(sd[k]), k)
+    # the loaded engine continues training (placements/dtypes intact)
+    assert np.isfinite(float(e2.train_batch(random_batch(8, seed=9))["loss"]))
+
+    with pytest.raises(KeyError, match="strict"):
+        e2.load_module_state_dict({"nope": np.zeros(2, np.float32)})
+    e2.load_module_state_dict({}, strict=False)       # no-op, keeps values
+
+
+def test_load_module_state_dict_refreshes_master():
+    """bf16-with-fp32-master mode: the fused step recomputes params FROM the
+    master, so a weights-only load must refresh the master too — with lr=0
+    a post-load step must return the loaded weights, not the stale ones."""
+    cfg = {"train_batch_size": 8, "bf16": {"enabled": True},
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+    e1, *_ = ds.initialize(model=SimpleModel(), config=cfg,
+                           example_batch=random_batch(8))
+    for i in range(3):
+        e1.train_batch(random_batch(8, seed=i))
+    sd = e1.module_state_dict()
+
+    cfg0 = {**cfg, "optimizer": {"type": "Adam", "params": {"lr": 0.0}}}
+    e2, *_ = ds.initialize(model=SimpleModel(), config=cfg0,
+                           example_batch=random_batch(8))
+    e2.load_module_state_dict(sd)
+    e2.train_batch(random_batch(8, seed=9))      # lr=0: a no-op update
+    for k, v in e2.module_state_dict().items():
+        np.testing.assert_allclose(np.asarray(v), np.asarray(sd[k]),
+                                   rtol=0, atol=0, err_msg=k)

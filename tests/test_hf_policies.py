@@ -344,3 +344,98 @@ def test_megatron_gpt_load():
     ids = rng.integers(0, V, (2, 16))
     out = model.apply({"params": params}, {"input_ids": jnp.asarray(ids)})
     assert np.asarray(out).shape == (2, 16, V)
+
+
+def test_replace_and_revert_transformer_layer_api():
+    """Reference export names (deepspeed/__init__.py:24-35): replace maps an
+    HF model functionally onto the TPU-native Transformer (logits parity);
+    revert returns the untouched original."""
+    import deepspeed_tpu as ds
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=32, n_layer=2, n_head=4)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    module, params, cfg = ds.replace_transformer_layer(
+        hf, dtype=jnp.float32)
+    assert module.cfg.dtype == jnp.float32      # dtype override applied
+    ids = np.random.default_rng(2).integers(0, 96, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    import dataclasses
+    from deepspeed_tpu.models.transformer import Transformer
+    # parity through the RETURNED module's cfg (only the attention impl is
+    # swapped — the Pallas kernel needs a TPU)
+    module = Transformer(dataclasses.replace(
+        module.cfg, attention_impl="reference"))
+    ours = np.asarray(module.apply({"params": params},
+                                   {"input_ids": jnp.asarray(ids)}))
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+    assert ds.revert_transformer_layer(hf) is hf
+
+
+def test_deepspeed_transformer_layer_module():
+    """DeepSpeedTransformerLayer: one block over [B, S, H] hidden states
+    (the reference's fused-layer export, ops/transformer/transformer.py:459)."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(hidden_size=32, num_heads=4, num_layers=1,
+                            dtype=jnp.float32, attention_impl="reference")
+    layer = ds.DeepSpeedTransformerLayer(cfg)
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 8, 32)),
+                    jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)
+    y = layer.apply(params, x)
+    assert y.shape == x.shape and np.isfinite(np.asarray(y)).all()
+    assert ds.DeepSpeedTransformerConfig is TransformerConfig
+    assert "dtype" in ds.default_inference_config()
+
+
+def test_replace_transformer_layer_raw_state_dict():
+    """The shim threads an explicit HF config through to the policy (the
+    raw-state-dict path load_hf's live-model dispatch can't carry)."""
+    import deepspeed_tpu as ds
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=32, n_layer=2, n_head=4)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    sd = hf.state_dict()
+    module, params, cfg = ds.replace_transformer_layer(
+        sd, config=hf_cfg, arch="gpt2")
+    assert cfg.num_layers == 2 and cfg.hidden_size == 32
+    with pytest.raises(NotImplementedError, match="no import policy"):
+        ds.replace_transformer_layer(sd, config=hf_cfg, arch="not-an-arch")
+
+
+def test_deepspeed_transformer_layer_mask_contract():
+    """The shim validates the mask: boolean/int True=attend (HF [B,S]
+    accepted and expanded); the reference's ADDITIVE float mask is rejected
+    loudly (silently passing it would attend the inverted positions)."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(hidden_size=32, num_heads=4, num_layers=1,
+                            dtype=jnp.float32, causal=False,
+                            attention_impl="reference")
+    layer = ds.DeepSpeedTransformerLayer(cfg)
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((2, 8, 32)),
+                    jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)
+    mask = np.ones((2, 8), np.int32)
+    mask[:, -3:] = 0
+    y_masked = layer.apply(params, x, jnp.asarray(mask))
+    assert np.isfinite(np.asarray(y_masked)).all()
+    # masking the tail must change the visible positions' outputs
+    y_full = layer.apply(params, x)
+    assert not np.allclose(np.asarray(y_masked)[:, :5],
+                           np.asarray(y_full)[:, :5])
+    with pytest.raises(ValueError, match="additive"):
+        layer.apply(params, x, (1.0 - mask) * -10000.0)
+    with pytest.raises(ValueError, match="MoE"):
+        moe_layer = ds.DeepSpeedTransformerLayer(
+            TransformerConfig(hidden_size=32, num_heads=4, num_layers=1,
+                              moe_experts=4, dtype=jnp.float32,
+                              attention_impl="reference"))
+        moe_layer.init(jax.random.PRNGKey(0), x)

@@ -601,6 +601,11 @@ def test_generate_rejects_right_padded_mask():
     with pytest.raises(ValueError, match="LEFT-padded"):
         generate(cfg, params, jnp.asarray(ids), 4,
                  attention_mask=jnp.asarray(mask))
+    # bool masks must hit the same guard (np.diff on bool is XOR — a raw
+    # diff check would wave a bool right-padded mask through)
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        generate(cfg, params, jnp.asarray(ids), 4,
+                 attention_mask=jnp.asarray(mask.astype(bool)))
     # an all-ones mask is accepted and equals the maskless call
     ids2 = rng.integers(1, 128, size=(2, 8))
     a = generate(cfg, params, jnp.asarray(ids2), 4,

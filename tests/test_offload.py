@@ -485,3 +485,57 @@ def test_swap_pipeline_overlap_ratio_synthetic_bandwidth():
     assert wall < 0.75 * serial, (wall, serial)
     assert wall < 1.5 * ideal, (wall, ideal)
     assert overlap_ratio > 1.3, overlap_ratio
+
+
+def test_load_module_state_dict_transient_mode():
+    """Weights-only load in offload_param transient mode: device params are
+    (), the real weights live in the host master — the loader must reseed
+    it (not reject the state_dict against an empty tree)."""
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 1,
+            "offload_optimizer": {"device": "cpu"},
+            "offload_param": {"device": "cpu"}},
+        "seed": 42,
+    }
+    e1, *_ = ds.initialize(model=SimpleModel(), config=config,
+                           example_batch=random_batch(8))
+    for i in range(3):
+        e1.train_batch(random_batch(8, seed=i))
+    sd = e1.module_state_dict()
+
+    e2, *_ = ds.initialize(model=SimpleModel(), config=config,
+                           example_batch=random_batch(8))
+    e2.load_module_state_dict(sd)
+    assert e2.state.params == ()              # still transient
+    b = random_batch(8, seed=99)
+    np.testing.assert_allclose(float(e1.eval_batch(b)),
+                               float(e2.eval_batch(b)), rtol=1e-5)
+
+
+def test_load_module_state_dict_preserves_master_precision():
+    """Weights-only load with host-offloaded master: the fp32 master is
+    reseeded from the FULL-PRECISION state_dict, not the engine's bf16
+    device params (which would round every weight through bf16)."""
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"}},
+        "seed": 1,
+    }
+    engine, *_ = ds.initialize(model=SimpleModel(), config=config,
+                               example_batch=random_batch(8))
+    sd = engine.module_state_dict()
+    # values with bits below bf16's 8-bit mantissa: bf16 would round them
+    sd = {k: np.full_like(np.asarray(v, np.float32), 1.0 + 2.0 ** -12)
+          for k, v in sd.items()}
+    engine.load_module_state_dict(sd)
+    master = engine.offload.state_dict()["master"]
+    for leaf in jax.tree.leaves(master):
+        np.testing.assert_array_equal(np.asarray(leaf).ravel()[0],
+                                      np.float32(1.0 + 2.0 ** -12))

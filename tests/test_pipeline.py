@@ -583,10 +583,10 @@ def test_ladder_zero1_pp_moe_ep_composition():
 
 
 def test_1f1b_moe_requires_marked_loss():
-    require_devices(2)
     """A raw custom loss on MoE+1F1B is rejected loudly: gpipe hands it the
     model's (logits, aux) tuple but the 1F1B executor computes aux itself
     and passes bare logits — silent misreads must be impossible."""
+    require_devices(2)
     from deepspeed_tpu.models.transformer import make_moe_loss
     piped, cfg = _tiny_piped(moe_experts=4)
 
