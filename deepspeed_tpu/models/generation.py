@@ -27,8 +27,13 @@ from .transformer import TransformerConfig
 PyTree = Any
 
 
-def _layer_norm(x, p, eps):
+def _layer_norm(x, p, eps, rms: bool = False):
     xf = x.astype(jnp.float32)
+    if rms:
+        # RMSNorm (Llama family): uncentered, scale-only
+        y = xf * jax.lax.rsqrt(
+            jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+        return (y * p["scale"]).astype(x.dtype)
     mu = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.var(xf, axis=-1, keepdims=True)
     y = (xf - mu) * jax.lax.rsqrt(var + eps)
@@ -178,6 +183,8 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
     pos = cache["pos"]
     max_len = cache["k"].shape[3]
     nh, hd = cfg.num_heads, cfg.head_dim
+    kvh = cfg.kv_heads
+    rms = cfg.norm == "rmsnorm"
     from .transformer import _ACTIVATIONS, alibi_slopes, apply_rotary
     act = _ACTIVATIONS[cfg.activation]
     sm_scale = (cfg.attn_scale if cfg.attn_scale is not None
@@ -197,7 +204,7 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
         wpe = params["wpe"]["embedding"].astype(cfg.dtype)
         x = x + (wpe[q_log] if pad is not None else wpe[q_log][None])
     if cfg.embed_ln:
-        x = _layer_norm(x, params["ln_emb"], cfg.layer_norm_eps)
+        x = _layer_norm(x, params["ln_emb"], cfg.layer_norm_eps, rms)
 
     k_pos = jnp.arange(max_len)                     # [max_len]
     # causal-with-cache mask [T_new, max_len]
@@ -247,16 +254,26 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
             x, k_all, v_all = carry
             ks_all = vs_all = None
         p, window, li = xs
-        h = _layer_norm(x, p["ln1"], cfg.layer_norm_eps)
+        h = _layer_norm(x, p["ln1"], cfg.layer_norm_eps, rms)
         qkv = _dense(h, p["attn_qkv"])
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        to_heads = lambda t: t.reshape(B, T_new, nh, hd).transpose(0, 2, 1, 3)
-        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        q, k, v = jnp.split(qkv, [nh * hd, (nh + kvh) * hd], axis=-1)
+        to_heads = lambda t, n: t.reshape(B, T_new, n, hd).transpose(
+            0, 2, 1, 3)
+        q, k, v = to_heads(q, nh), to_heads(k, kvh), to_heads(v, kvh)
         if cfg.pos_embed == "rotary":
             # q_log: logical (pad-corrected) positions — [B, T] for ragged
             # left-padded batches, [T] otherwise (apply_rotary handles both)
-            q = apply_rotary(q, q_log, cfg.rotary_dim, cfg.rotary_interleaved)
-            k = apply_rotary(k, q_log, cfg.rotary_dim, cfg.rotary_interleaved)
+            q = apply_rotary(q, q_log, cfg.rotary_dim, cfg.rotary_interleaved,
+                             cfg.rope_theta)
+            k = apply_rotary(k, q_log, cfg.rotary_dim, cfg.rotary_interleaved,
+                             cfg.rope_theta)
+        if kvh != nh:
+            # GQA: repeat kv to full heads BEFORE the cache write — the
+            # cache stays [L, B, nh, len, hd], so the decode kernel and
+            # int8 tiers apply unchanged. (Storing kv heads only would
+            # shrink the cache nh/kvh-fold; future optimization.)
+            k = jnp.repeat(k, nh // kvh, axis=1)
+            v = jnp.repeat(v, nh // kvh, axis=1)
         if quant_kv:
             k, k_s = _kv_quantize(k)
             v, v_s = _kv_quantize(v)
@@ -314,16 +331,19 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
         def mlp(hin):
             if cfg.moe_experts > 0:
                 return _moe_mlp(cfg, p["moe"], hin)
+            if cfg.gated_mlp:            # SwiGLU (Llama family)
+                g = act(_dense(hin, p["mlp_gate"]))
+                return _dense(g * _dense(hin, p["mlp_fc"]), p["mlp_proj"])
             return _dense(act(_dense(hin, p["mlp_fc"])), p["mlp_proj"])
 
         if cfg.parallel_residual:
             # GPT-NeoX feeds the MLP branch from its own ln2; GPT-J shares ln1
-            m_in = (_layer_norm(x, p["ln2"], cfg.layer_norm_eps)
+            m_in = (_layer_norm(x, p["ln2"], cfg.layer_norm_eps, rms)
                     if cfg.parallel_residual_dual_ln else h)
             x_out = x + attn_out + mlp(m_in)
         else:
             x_mid = x + attn_out
-            h2 = _layer_norm(x_mid, p["ln2"], cfg.layer_norm_eps)
+            h2 = _layer_norm(x_mid, p["ln2"], cfg.layer_norm_eps, rms)
             x_out = x_mid + mlp(h2)
         if quant_kv:
             return (x_out, k_all, v_all, ks_all, vs_all), None
@@ -337,7 +357,7 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
     else:
         (x, k_new, v_new), _ = jax.lax.scan(
             layer, (x, cache["k"], cache["v"]), xs)
-    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
+    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps, rms)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bth,vh->btv", x, wte.astype(x.dtype))
     else:

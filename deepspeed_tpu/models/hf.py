@@ -10,7 +10,9 @@ slices 1/tp_size by hand, containers/base.py:243).
 
 Policies implemented: GPT-2, GPT-Neo, GPT-NeoX, GPT-J, OPT, BLOOM, BERT,
 RoBERTa, DistilBERT, CLIP-text, Megatron-GPT — 11 arches covering the
-reference's replace_policy.py:18-32 list. torch Linear weights are
+reference's replace_policy.py:18-32 list — plus Llama and Mistral
+(RMSNorm + SwiGLU + grouped-query attention + sliding window; EXCEEDS the
+reference, whose v0.8.1 policy list pre-dates them): 13 total. torch Linear weights are
 [out, in] and transpose into flax kernels; GPT-2's Conv1D is already
 [in, out].
 """
@@ -568,11 +570,8 @@ def load_hf_gpt_neox(model_or_state_dict, config=None):
     hd = H // nh
     parallel = bool(getattr(config, "use_parallel_residual", True))
     base = float(getattr(config, "rotary_emb_base", 10000.0))
-    if base != 10000.0:
-        raise NotImplementedError(
-            f"GPT-NeoX rotary_emb_base={base}: apply_rotary currently "
-            "hard-codes base 10000; refusing to load with wrong angles")
     cfg = TransformerConfig(
+        rope_theta=base,
         vocab_size=config.vocab_size,
         max_seq_len=config.max_position_embeddings,
         hidden_size=H,
@@ -759,7 +758,128 @@ def _to_f32(params):
 
 
 # policy registry (reference: replace_policy.py replace_policies list)
+def _llama_family_params(sd, prefix, L, attn_bias=False):
+    """Shared Llama/Mistral block mapping: RMSNorm + GQA qkv + SwiGLU."""
+    g = lambda n: _np(sd[prefix + n])
+    stack = _stacker(g, L)
+
+    def qkv(i):
+        ws = [g(f"layers.{i}.self_attn.{p}_proj.weight").T
+              for p in ("q", "k", "v")]
+        return np.concatenate(ws, axis=1)     # [H, (nh + 2*kv) * hd]
+
+    def qkv_bias(i):
+        return np.concatenate(
+            [g(f"layers.{i}.self_attn.{p}_proj.bias") for p in ("q", "k", "v")])
+
+    blocks = {
+        "ln1": {"scale": stack(
+            lambda i: g(f"layers.{i}.input_layernorm.weight"))},
+        "attn_qkv": ({"kernel": stack(qkv), "bias": stack(qkv_bias)}
+                     if attn_bias else {"kernel": stack(qkv)}),
+        "attn_proj": ({"kernel": stack(
+            lambda i: g(f"layers.{i}.self_attn.o_proj.weight").T),
+            "bias": stack(lambda i: g(f"layers.{i}.self_attn.o_proj.bias"))}
+            if attn_bias else {"kernel": stack(
+                lambda i: g(f"layers.{i}.self_attn.o_proj.weight").T)}),
+        "ln2": {"scale": stack(
+            lambda i: g(f"layers.{i}.post_attention_layernorm.weight"))},
+        "mlp_gate": {"kernel": stack(
+            lambda i: g(f"layers.{i}.mlp.gate_proj.weight").T)},
+        "mlp_fc": {"kernel": stack(
+            lambda i: g(f"layers.{i}.mlp.up_proj.weight").T)},
+        "mlp_proj": {"kernel": stack(
+            lambda i: g(f"layers.{i}.mlp.down_proj.weight").T)},
+    }
+    params = {
+        "wte": {"embedding": g("embed_tokens.weight")},
+        "blocks": blocks,
+        "ln_f": {"scale": g("norm.weight")},
+    }
+    return params, g
+
+
+def _load_hf_llama_family(model_or_state_dict, config, windows=None):
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    prefix = _prefix(sd, "model.")
+    L = config.num_hidden_layers
+    kv = getattr(config, "num_key_value_heads", None) \
+        or config.num_attention_heads
+    tie = bool(getattr(config, "tie_word_embeddings", False))
+    # refuse silently-wrong loads: scaled RoPE variants (Llama-3.1+) change
+    # the inv_freq table, and a non-standard head_dim changes every qkv
+    # shape — both must fail HERE, not decode garbage
+    scaling = getattr(config, "rope_scaling", None)
+    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
+        raise NotImplementedError(
+            f"rope_scaling={scaling}: scaled RoPE variants (llama3 / "
+            "linear / dynamic) are not implemented; loading with plain "
+            "rope_theta would produce wrong frequencies")
+    hd_cfg = getattr(config, "head_dim", None)
+    if hd_cfg and hd_cfg != config.hidden_size // config.num_attention_heads:
+        raise NotImplementedError(
+            f"head_dim={hd_cfg} != hidden_size/num_heads "
+            f"({config.hidden_size}/{config.num_attention_heads}): "
+            "decoupled head_dim (Mistral-Nemo style) is not supported")
+    if getattr(config, "mlp_bias", False):
+        raise NotImplementedError("mlp_bias=True is not supported")
+    attn_bias = bool(getattr(config, "attention_bias", False))
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_position_embeddings,
+        hidden_size=config.hidden_size,
+        num_layers=L,
+        num_heads=config.num_attention_heads,
+        num_kv_heads=kv,
+        mlp_dim_override=config.intermediate_size,
+        norm="rmsnorm",
+        gated_mlp=True,
+        activation="silu",
+        pos_embed="rotary",
+        rotary_interleaved=False,           # HF rotate_half layout
+        rope_theta=float(getattr(config, "rope_theta", 10000.0)),
+        use_bias=False,
+        # Qwen-style attention_bias=True: biased q/k/v/o, unbiased MLP
+        qkv_bias=attn_bias,
+        attn_out_bias=attn_bias,
+        tie_embeddings=tie,
+        layer_norm_eps=float(config.rms_norm_eps),
+        layer_windows=windows,
+        scan_layers=True,
+    )
+    params, g = _llama_family_params(sd, prefix, L, attn_bias=attn_bias)
+    if not tie:
+        lm_key = "lm_head.weight"
+        if lm_key in sd:                     # bare decoders lack the head
+            params["lm_head"] = {"kernel": _np(sd[lm_key]).T}
+        else:
+            params["lm_head"] = {
+                "kernel": g("embed_tokens.weight").T.copy()}
+    return _to_f32(params), cfg
+
+
+def load_hf_llama(model_or_state_dict, config=None):
+    """Llama/Llama-2/3 (HF LlamaForCausalLM): RMSNorm pre-norm, SwiGLU MLP,
+    GQA, rotate_half rotary with config rope_theta. Exceeds the reference's
+    replace_policy list (v0.8.1 pre-dates Llama)."""
+    return _load_hf_llama_family(model_or_state_dict, config)
+
+
+def load_hf_mistral(model_or_state_dict, config=None):
+    """Mistral (HF MistralForCausalLM): the Llama block family plus a
+    uniform sliding attention window on every layer."""
+    sd_cfg = (model_or_state_dict.config
+              if hasattr(model_or_state_dict, "config") else config)
+    w = getattr(sd_cfg, "sliding_window", None) if sd_cfg is not None else None
+    windows = ((int(w),) * sd_cfg.num_hidden_layers) if w else None
+    return _load_hf_llama_family(model_or_state_dict, config, windows=windows)
+
+
 HF_POLICIES = {
+    "llama": load_hf_llama,
+    "LlamaForCausalLM": load_hf_llama,
+    "mistral": load_hf_mistral,
+    "MistralForCausalLM": load_hf_mistral,
     "gptneo": load_hf_gpt_neo,
     "GPTNeoForCausalLM": load_hf_gpt_neo,
     "gptj": load_hf_gptj,

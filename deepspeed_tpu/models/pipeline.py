@@ -331,6 +331,8 @@ class PipelinedTransformer:
             r"blocks/.*attn_proj/kernel": block("model", None),
             r"blocks/.*mlp_fc/kernel": block(None, "model"),
             r"blocks/.*mlp_fc/bias": block("model"),
+            r"blocks/.*mlp_gate/kernel": block(None, "model"),
+            r"blocks/.*mlp_gate/bias": block("model"),
             r"blocks/.*mlp_proj/kernel": block("model", None),
             # MoE expert stacks [L, E, in, out]: the layer dim carries the
             # pipe axis (as for every block param), expert axis on E,

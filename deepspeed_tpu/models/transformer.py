@@ -102,6 +102,18 @@ class TransformerConfig:
     # keep layer l with prob 1 - (l/L)(1-theta); theta arrives per step via
     # the "pld_theta" batch key (so no recompile as the schedule moves)
     pld: bool = False
+    # -- modern-decoder knobs (Llama/Mistral family — post-dates the
+    #    reference v0.8.1; exceeds its policy list) ---------------------------
+    norm: str = "layernorm"        # "rmsnorm": no-mean, no-bias (Llama)
+    # SwiGLU MLP: down(silu(gate(x)) * up(x)) — three matmuls; activation
+    # field selects the gate nonlinearity ("silu" for Llama)
+    gated_mlp: bool = False
+    # grouped-query attention: k/v heads < q heads, repeated at attention
+    # (None = MHA). num_heads % num_kv_heads must be 0.
+    num_kv_heads: Optional[int] = None
+    rope_theta: float = 10000.0    # rotary base (Llama-3 uses 500000)
+    # explicit MLP width when it is not ratio*H (Llama: 11008 at H=4096)
+    mlp_dim_override: Optional[int] = None
     # MoE (reference: deepspeed/moe/*): >0 replaces every block's MLP with a
     # mixture of moe_experts experts; aux loss returned next to the logits
     moe_experts: int = 0
@@ -115,15 +127,37 @@ class TransformerConfig:
 
     @property
     def mlp_dim(self) -> int:
+        if self.mlp_dim_override is not None:
+            return self.mlp_dim_override
         return self.hidden_size * self.mlp_ratio
 
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    def _embed_params(self) -> int:
+        h, v = self.hidden_size, self.vocab_size
+        n = v * h
+        if self.pos_embed == "learned":
+            n += self.max_seq_len * h
+        if not self.tie_embeddings and not self.no_lm_head:
+            n += v * h                        # untied lm_head
+        return n
+
+    def _attn_params(self) -> int:
+        h = self.hidden_size
+        return (self.num_heads + 2 * self.kv_heads) * self.head_dim * h \
+            + h * h                           # qkv (GQA-aware) + out proj
+
+    def _mlp_params(self) -> int:
+        return (3 if self.gated_mlp else 2) * self.mlp_dim * self.hidden_size
+
     def num_params(self) -> int:
-        h, L, v = self.hidden_size, self.num_layers, self.vocab_size
-        mlp = 2 * self.mlp_dim * h * max(self.moe_experts, 1)
-        per_layer = 4 * h * h + mlp  # qkv+proj + fc1+fc2 (x experts for MoE)
+        per_layer = self._attn_params() \
+            + self._mlp_params() * max(self.moe_experts, 1)
         if self.moe_experts > 0:
-            per_layer += h * self.moe_experts  # router
-        return v * h + self.max_seq_len * h + L * per_layer
+            per_layer += self.hidden_size * self.moe_experts  # router
+        return self._embed_params() + self.num_layers * per_layer
 
     def num_active_params(self) -> int:
         """Params touched per token (== num_params for dense; MoE routes each
@@ -131,10 +165,9 @@ class TransformerConfig:
         belongs in the 6N FLOPs-per-token model."""
         if self.moe_experts <= 0:
             return self.num_params()
-        h, L, v = self.hidden_size, self.num_layers, self.vocab_size
-        per_layer = (4 * h * h + 2 * self.mlp_dim * h * self.moe_k
-                     + h * self.moe_experts)
-        return v * h + self.max_seq_len * h + L * per_layer
+        per_layer = (self._attn_params() + self._mlp_params() * self.moe_k
+                     + self.hidden_size * self.moe_experts)
+        return self._embed_params() + self.num_layers * per_layer
 
     # -- tensor-parallel sharding rules (regex on param path -> PartitionSpec) --
     def tp_rules(self) -> Dict[str, P]:
@@ -160,6 +193,8 @@ class TransformerConfig:
             prefix + r".*attn_proj/kernel": block(("model", None)),
             prefix + r".*mlp_fc/kernel": block((None, "model")),
             prefix + r".*mlp_fc/bias": block(("model",)),
+            prefix + r".*mlp_gate/kernel": block((None, "model")),
+            prefix + r".*mlp_gate/bias": block(("model",)),
             prefix + r".*mlp_proj/kernel": block(("model", None)),
             r"wte/embedding": P("model", None),
             r"lm_head/kernel": P(None, "model"),
@@ -202,11 +237,13 @@ _ACTIVATIONS = {
     "gelu_exact": lambda x: nn.gelu(x, approximate=False),
     "relu": nn.relu,
     "quick_gelu": lambda x: x * nn.sigmoid(1.702 * x),  # CLIP
+    "silu": nn.silu,                                    # Llama SwiGLU gate
 }
 
 
 def apply_rotary(x: jnp.ndarray, positions: jnp.ndarray,
-                 rotary_dim: int = 0, interleaved: bool = True) -> jnp.ndarray:
+                 rotary_dim: int = 0, interleaved: bool = True,
+                 theta: float = 10000.0) -> jnp.ndarray:
     """Rotary embedding; interleaved=True is the GPT-J rotate_every_two pair
     layout, False is the GPT-NeoX rotate_half half-split layout.
 
@@ -219,7 +256,7 @@ def apply_rotary(x: jnp.ndarray, positions: jnp.ndarray,
     rd = rotary_dim or hd
     if positions.ndim == 1:
         positions = positions[None, :]
-    inv_freq = 1.0 / (10000.0 ** (jnp.arange(0, rd, 2) / rd))
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, rd, 2) / rd))
     ang = positions[:, :, None].astype(jnp.float32) * inv_freq[None, None, :]
     sin = jnp.sin(ang)[:, None, :, :]                   # [B, 1, S, rd/2]
     cos = jnp.cos(ang)[:, None, :, :]
@@ -386,25 +423,42 @@ class Block(nn.Module):
         # TP-only (gathered) kernel layouts by name — the ZeRO axes are
         # deliberately absent: _TDense pins the kernel READ to this spec
         _KSPEC = {"attn_qkv": (None, "model"), "attn_proj": ("model", None),
-                  "mlp_fc": (None, "model"), "mlp_proj": ("model", None)}
+                  "mlp_fc": (None, "model"), "mlp_gate": (None, "model"),
+                  "mlp_proj": ("model", None)}
         dense = lambda feats, name, bias=None: _TDense(
             feats, kernel_spec=_KSPEC.get(name),
             use_bias=cfg.use_bias if bias is None else bias,
             dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
-        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps,
-                                       dtype=cfg.dtype,
-                                       param_dtype=jnp.float32, name=name)
+        if cfg.norm == "rmsnorm":
+            ln = lambda name: nn.RMSNorm(epsilon=cfg.layer_norm_eps,
+                                         dtype=cfg.dtype,
+                                         param_dtype=jnp.float32, name=name)
+        else:
+            ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                           dtype=cfg.dtype,
+                                           param_dtype=jnp.float32, name=name)
 
         # attention ----------------------------------------------------------
+        kv = cfg.kv_heads
+        if nh % kv != 0:
+            raise ValueError(f"num_heads {nh} not divisible by "
+                             f"num_kv_heads {kv}")
         h = x if cfg.post_ln else ln("ln1")(x)
-        qkv = dense(3 * H, "attn_qkv", bias=cfg.qkv_bias)(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        to_heads = lambda t: t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
-        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        # one fused qkv matmul even under GQA: [H, (nh + 2*kv) * hd]
+        qkv = dense((nh + 2 * kv) * hd, "attn_qkv", bias=cfg.qkv_bias)(h)
+        q, k, v = jnp.split(qkv, [nh * hd, (nh + kv) * hd], axis=-1)
+        to_heads = lambda t, n: t.reshape(B, S, n, hd).transpose(0, 2, 1, 3)
+        q, k, v = to_heads(q, nh), to_heads(k, kv), to_heads(v, kv)
         if cfg.pos_embed == "rotary":
             pos = positions if positions is not None else jnp.arange(S)
-            q = apply_rotary(q, pos, cfg.rotary_dim, cfg.rotary_interleaved)
-            k = apply_rotary(k, pos, cfg.rotary_dim, cfg.rotary_interleaved)
+            q = apply_rotary(q, pos, cfg.rotary_dim, cfg.rotary_interleaved,
+                             cfg.rope_theta)
+            k = apply_rotary(k, pos, cfg.rotary_dim, cfg.rotary_interleaved,
+                             cfg.rope_theta)
+        if kv != nh:
+            # grouped-query: each k/v head serves nh/kv query heads
+            k = jnp.repeat(k, nh // kv, axis=1)
+            v = jnp.repeat(v, nh // kv, axis=1)
         bias = None
         if cfg.pos_embed == "alibi":
             pos = positions if positions is not None else jnp.arange(S)
@@ -457,6 +511,12 @@ class Block(nn.Module):
                     eval_capacity_factor=cfg.moe_capacity_factor,
                     dtype=cfg.dtype,
                     name="moe")(h, train=train)
+            if cfg.gated_mlp:
+                # SwiGLU (Llama family): down(act(gate(x)) * up(x)); the
+                # gate/up matmuls fuse side by side on the MXU
+                g = act(dense(cfg.mlp_dim, "mlp_gate")(h))
+                h = g * dense(cfg.mlp_dim, "mlp_fc")(h)
+                return dense(H, "mlp_proj")(h), aux
             h = dense(cfg.mlp_dim, "mlp_fc")(h)
             h = act(h)
             h = dense(H, "mlp_proj")(h)
@@ -649,8 +709,9 @@ class Transformer(nn.Module):
 
         if not cfg.post_ln:
             # post-LN stacks (BERT) end already normalized by each block's ln2
-            x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
-                             param_dtype=jnp.float32, name="ln_f")(x)
+            norm_cls = nn.RMSNorm if cfg.norm == "rmsnorm" else nn.LayerNorm
+            x = norm_cls(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="ln_f")(x)
         if cfg.mlm_head:
             # BERT cls.predictions: transform (dense+act+LN) then decoder
             # (tied embedding + output bias)

@@ -439,3 +439,130 @@ def test_deepspeed_transformer_layer_mask_contract():
                               moe_experts=4, dtype=jnp.float32,
                               attention_impl="reference"))
         moe_layer.init(jax.random.PRNGKey(0), x)
+
+
+def _llama_tiny(**over):
+    kw = dict(vocab_size=96, hidden_size=32, intermediate_size=56,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, max_position_embeddings=64)
+    kw.update(over)
+    return transformers.LlamaForCausalLM(transformers.LlamaConfig(**kw)).eval()
+
+
+def test_hf_llama_parity():
+    """Llama family (EXCEEDS the reference's replace_policy list — v0.8.1
+    pre-dates Llama): RMSNorm, SwiGLU, grouped-query attention, rotate_half
+    rotary with config rope_theta."""
+    import dataclasses
+    hf = _llama_tiny(rope_theta=500000.0)
+    ids = np.random.default_rng(0).integers(0, 96, (2, 24))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    params, cfg = load_hf(hf)
+    assert cfg.norm == "rmsnorm" and cfg.gated_mlp and cfg.num_kv_heads == 2
+    assert cfg.rope_theta == 500000.0
+    model = Transformer(dataclasses.replace(cfg, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    ours = np.asarray(model.apply({"params": params},
+                                  {"input_ids": jnp.asarray(ids)}))
+    np.testing.assert_allclose(ours, ref, rtol=4e-3, atol=4e-3)
+
+
+def test_hf_mistral_parity():
+    """Mistral: the Llama block family + a uniform sliding window on every
+    layer (window smaller than the test seq so it actually binds)."""
+    import dataclasses
+    hf = transformers.MistralForCausalLM(transformers.MistralConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=56,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8)).eval()
+    ids = np.random.default_rng(1).integers(0, 96, (2, 24))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    params, cfg = load_hf(hf)
+    assert cfg.layer_windows == (8, 8, 8)
+    model = Transformer(dataclasses.replace(cfg, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    ours = np.asarray(model.apply({"params": params},
+                                  {"input_ids": jnp.asarray(ids)}))
+    np.testing.assert_allclose(ours, ref, rtol=4e-3, atol=4e-3)
+
+
+def test_hf_llama_greedy_generate_matches():
+    """KV-cache decode (RMSNorm + GQA + SwiGLU through the scan loop) is
+    token-exact vs HF greedy generate."""
+    import dataclasses
+    from deepspeed_tpu.models.generation import generate
+    hf = _llama_tiny()
+    params, cfg = load_hf(hf)
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32,
+                              attention_impl="reference")
+    ids = np.random.default_rng(2).integers(0, 96, (2, 10))
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids), max_new_tokens=8,
+                          do_sample=False).numpy()
+    ours = np.asarray(generate(cfg, params, jnp.asarray(ids), 8))
+    np.testing.assert_array_equal(ours, ref)
+
+
+def test_gqa_matches_mha_when_kv_heads_equal():
+    """num_kv_heads == num_heads must be numerically identical to the MHA
+    path (the GQA split/repeat degenerates away)."""
+    from deepspeed_tpu.models import build_model
+    kw = dict(hidden_size=64, num_layers=2, num_heads=4, vocab_size=128,
+              max_seq_len=32, dtype=jnp.float32, attention_impl="reference")
+    m1, _ = build_model("gpt2-tiny", **kw)
+    m2, _ = build_model("gpt2-tiny", num_kv_heads=4, **kw)
+    import jax
+    batch = {"input_ids": jnp.zeros((2, 16), jnp.int32)}
+    p = m1.init(jax.random.PRNGKey(0), batch)["params"]
+    np.testing.assert_array_equal(
+        np.asarray(m1.apply({"params": p}, batch)),
+        np.asarray(m2.apply({"params": p}, batch)))
+
+
+def test_hf_llama_attention_bias_parity():
+    """Qwen-style attention_bias=True: biased q/k/v/o projections map and
+    match HF; unsupported variants (scaled RoPE, decoupled head_dim,
+    mlp_bias) are REJECTED at load instead of decoding garbage."""
+    import dataclasses
+    hf = _llama_tiny(attention_bias=True)
+    ids = np.random.default_rng(3).integers(0, 96, (2, 20))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    params, cfg = load_hf(hf)
+    assert "bias" in params["blocks"]["attn_qkv"]
+    model = Transformer(dataclasses.replace(cfg, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    ours = np.asarray(model.apply({"params": params},
+                                  {"input_ids": jnp.asarray(ids)}))
+    np.testing.assert_allclose(ours, ref, rtol=4e-3, atol=4e-3)
+
+    for kw, pat in [
+            (dict(rope_scaling={"rope_type": "linear", "factor": 2.0}),
+             "rope_scaling"),
+            (dict(head_dim=16), "head_dim"),
+            (dict(mlp_bias=True), "mlp_bias")]:
+        with pytest.raises(NotImplementedError, match=pat):
+            load_hf(_llama_tiny(num_hidden_layers=1, **kw))
+
+
+def test_hf_gptneox_nonstandard_rotary_base_parity():
+    """NeoX checkpoints with rotary_emb_base != 10000 load with the right
+    angles now that apply_rotary takes theta (the old guard refused them)."""
+    import dataclasses
+    hf = transformers.GPTNeoXForCausalLM(transformers.GPTNeoXConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, rotary_emb_base=50000,
+        rotary_pct=0.5)).eval()
+    ids = np.random.default_rng(4).integers(0, 96, (2, 20))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    params, cfg = load_hf(hf)
+    assert cfg.rope_theta == 50000.0
+    model = Transformer(dataclasses.replace(cfg, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    ours = np.asarray(model.apply({"params": params},
+                                  {"input_ids": jnp.asarray(ids)}))
+    np.testing.assert_allclose(ours, ref, rtol=4e-3, atol=4e-3)
