@@ -799,10 +799,15 @@ def _llama_family_params(sd, prefix, L, attn_bias=False):
     return params, g
 
 
-def _load_hf_llama_family(model_or_state_dict, config, windows=None):
+def _load_hf_llama_family(model_or_state_dict, config,
+                          use_sliding_window=False):
     sd, config = _sd_and_config(model_or_state_dict, config)
     prefix = _prefix(sd, "model.")
     L = config.num_hidden_layers
+    windows = None
+    if use_sliding_window:
+        w = getattr(config, "sliding_window", None)
+        windows = ((int(w),) * L) if w else None
     kv = getattr(config, "num_key_value_heads", None) \
         or config.num_attention_heads
     tie = bool(getattr(config, "tie_word_embeddings", False))
@@ -849,12 +854,14 @@ def _load_hf_llama_family(model_or_state_dict, config, windows=None):
     )
     params, g = _llama_family_params(sd, prefix, L, attn_bias=attn_bias)
     if not tie:
-        lm_key = "lm_head.weight"
-        if lm_key in sd:                     # bare decoders lack the head
-            params["lm_head"] = {"kernel": _np(sd[lm_key]).T}
-        else:
-            params["lm_head"] = {
-                "kernel": g("embed_tokens.weight").T.copy()}
+        if "lm_head.weight" not in sd:
+            # fail loudly like every other CausalLM loader — fabricating a
+            # tied head for an untied checkpoint would decode garbage
+            raise KeyError(
+                "untied checkpoint (tie_word_embeddings=False) has no "
+                "lm_head.weight — is this a bare LlamaModel state dict? "
+                "Export the ForCausalLM model, or set tie_word_embeddings")
+        params["lm_head"] = {"kernel": _np(sd["lm_head.weight"]).T}
     return _to_f32(params), cfg
 
 
@@ -868,11 +875,8 @@ def load_hf_llama(model_or_state_dict, config=None):
 def load_hf_mistral(model_or_state_dict, config=None):
     """Mistral (HF MistralForCausalLM): the Llama block family plus a
     uniform sliding attention window on every layer."""
-    sd_cfg = (model_or_state_dict.config
-              if hasattr(model_or_state_dict, "config") else config)
-    w = getattr(sd_cfg, "sliding_window", None) if sd_cfg is not None else None
-    windows = ((int(w),) * sd_cfg.num_hidden_layers) if w else None
-    return _load_hf_llama_family(model_or_state_dict, config, windows=windows)
+    return _load_hf_llama_family(model_or_state_dict, config,
+                                 use_sliding_window=True)
 
 
 HF_POLICIES = {

@@ -121,6 +121,14 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
 
+    def __post_init__(self):
+        if self.gated_mlp and self.moe_experts > 0:
+            # the MoE ExpertMLP is the 2-matmul form; silently ignoring
+            # gated_mlp would also inflate the 6N FLOPs accounting 1.5x
+            raise NotImplementedError(
+                "gated_mlp (SwiGLU) + moe_experts is not implemented: MoE "
+                "experts use the 2-matmul MLP")
+
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
@@ -217,6 +225,14 @@ _PRESETS = {
     "gpt2-1.3b": dict(hidden_size=2048, num_layers=24, num_heads=16),
     "gpt2-2.7b": dict(hidden_size=2560, num_layers=32, num_heads=32),
     "gpt2-6.7b": dict(hidden_size=4096, num_layers=32, num_heads=32),
+    # TinyLlama-1.1B shapes: the modern-decoder leg (RMSNorm + SwiGLU +
+    # GQA 32q/4kv + rotary) of the perf table
+    "llama-1.1b": dict(hidden_size=2048, num_layers=22, num_heads=32,
+                       num_kv_heads=4, mlp_dim_override=5632,
+                       norm="rmsnorm", gated_mlp=True, activation="silu",
+                       pos_embed="rotary", rotary_interleaved=False,
+                       use_bias=False, tie_embeddings=False,
+                       vocab_size=32000, max_seq_len=2048),
     "bert-base": dict(hidden_size=768, num_layers=12, num_heads=12, causal=False,
                       vocab_size=30522, max_seq_len=512),
     "bert-large": dict(hidden_size=1024, num_layers=24, num_heads=16, causal=False,
@@ -728,12 +744,22 @@ class Transformer(nn.Module):
             # encoder use (CLIP text): final hidden states are the output
             return x.astype(jnp.float32)
         if cfg.fused_loss:
-            if not cfg.tie_embeddings:
-                raise ValueError("fused_loss requires tie_embeddings")
+            if cfg.tie_embeddings:
+                emb = wte.embedding
+            else:
+                # untied head (Llama family): declare the SAME lm_head/
+                # kernel param the non-fused nn.Dense path creates, so
+                # checkpoints and HF imports are layout-identical
+                if cfg.lm_head_bias:
+                    raise ValueError(
+                        "fused_loss with a BIASED untied lm_head is not "
+                        "supported (the chunked CE has no bias term)")
+                emb = _HeadKernel(cfg.vocab_size, cfg.hidden_size,
+                                  name="lm_head")().T
             labels = batch.get("labels", input_ids) if isinstance(batch, dict) \
                 else input_ids
             # encoder stacks (BERT bench path) predict in place: no shift
-            loss = _fused_causal_lm_loss(x, wte.embedding, labels,
+            loss = _fused_causal_lm_loss(x, emb, labels,
                                          cfg.loss_chunk,
                                          shift=1 if cfg.causal else 0)
             if cfg.moe_experts > 0:
@@ -749,6 +775,19 @@ class Transformer(nn.Module):
         if cfg.moe_experts > 0:
             return logits, aux_total
         return logits
+
+
+class _HeadKernel(nn.Module):
+    """Bare lm_head kernel for the fused-CE path: the param path/shape/init
+    match nn.Dense(name="lm_head") exactly, so fused and non-fused models
+    share checkpoints."""
+    vocab_size: int
+    hidden: int
+
+    @nn.compact
+    def __call__(self):
+        return self.param("kernel", nn.initializers.lecun_normal(),
+                          (self.hidden, self.vocab_size), jnp.float32)
 
 
 def _fused_causal_lm_loss(x, emb, labels, chunk: int, shift: int = 1):

@@ -566,3 +566,18 @@ def test_hf_gptneox_nonstandard_rotary_base_parity():
     ours = np.asarray(model.apply({"params": params},
                                   {"input_ids": jnp.asarray(ids)}))
     np.testing.assert_allclose(ours, ref, rtol=4e-3, atol=4e-3)
+
+
+def test_llama_untied_without_head_rejected_and_gated_moe_rejected():
+    """Fail-loud guards: a bare decoder state dict (no lm_head.weight,
+    untied) must not fabricate a tied head; gated_mlp + MoE is an
+    unimplemented combination and must not silently train the 2-matmul
+    experts while counting 3 in the FLOPs model."""
+    hf = _llama_tiny(num_hidden_layers=1)
+    sd = {k: v for k, v in hf.state_dict().items() if k != "lm_head.weight"}
+    with pytest.raises(KeyError, match="lm_head.weight"):
+        load_hf(sd, arch="llama", config=hf.config)
+
+    from deepspeed_tpu.models.transformer import get_config
+    with pytest.raises(NotImplementedError, match="gated_mlp"):
+        get_config("gpt2-tiny", gated_mlp=True, moe_experts=4)
