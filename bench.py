@@ -64,6 +64,15 @@ def main():
                                  verbose=False)
         gc.collect()
         jax.clear_caches()
+        # modern-decoder leg (round 4): TinyLlama-1.1B shapes — RMSNorm,
+        # SwiGLU, GQA 32q/4kv, rotary, untied head (docs/BENCHMARKS.md)
+        rll = run_training_bench("llama-1.1b", seq=1024, micro=2, gas=16,
+                                 steps=12, zero_stage=3, remat=True,
+                                 remat_policy="dots", fused_loss=True,
+                                 pure_bf16=True, grad_accum_dtype="bf16",
+                                 verbose=False)
+        gc.collect()
+        jax.clear_caches()
         # micro 4 x gas 64: found by the round-4 cold-start autotune
         # (scripts/autotune_350m.py) and confirmed at 12-step medians —
         # +4.5% over the round-3 hand-tuned micro 16 x gas 16 (the smaller
@@ -73,6 +82,7 @@ def main():
                                remat_policy="dots", fused_loss=True,
                                verbose=False)
         _emit(r, "gpt2_train_tflops_per_chip")
+        _emit(rll, "llama_1p1b_zero3_train_tflops_per_chip")
         _emit(r20, "gpt2_1p3b_seq2048_zero3_train_tflops_per_chip")
         _emit(r13, "gpt2_1p3b_zero3_train_tflops_per_chip")
     else:  # smoke path for CPU-only environments
