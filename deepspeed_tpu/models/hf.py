@@ -10,9 +10,10 @@ slices 1/tp_size by hand, containers/base.py:243).
 
 Policies implemented: GPT-2, GPT-Neo, GPT-NeoX, GPT-J, OPT, BLOOM, BERT,
 RoBERTa, DistilBERT, CLIP-text, Megatron-GPT — 11 arches covering the
-reference's replace_policy.py:18-32 list — plus Llama and Mistral
-(RMSNorm + SwiGLU + grouped-query attention + sliding window; EXCEEDS the
-reference, whose v0.8.1 policy list pre-dates them): 13 total. torch Linear weights are
+reference's replace_policy.py:18-32 list — plus Llama, Mistral, and
+Qwen2 (RMSNorm + SwiGLU + grouped-query attention + sliding window +
+qkv biases; EXCEEDS the reference, whose v0.8.1 policy list pre-dates
+them): 14 total. torch Linear weights are
 [out, in] and transpose into flax kernels; GPT-2's Conv1D is already
 [in, out].
 """
@@ -758,8 +759,10 @@ def _to_f32(params):
 
 
 # policy registry (reference: replace_policy.py replace_policies list)
-def _llama_family_params(sd, prefix, L, attn_bias=False):
-    """Shared Llama/Mistral block mapping: RMSNorm + GQA qkv + SwiGLU."""
+def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False):
+    """Shared Llama/Mistral/Qwen2 block mapping: RMSNorm + GQA qkv + SwiGLU.
+    Bias flags are PRESENCE-driven by the caller (Llama attention_bias has
+    q/k/v/o biases; Qwen2 has q/k/v only)."""
     g = lambda n: _np(sd[prefix + n])
     stack = _stacker(g, L)
 
@@ -768,19 +771,19 @@ def _llama_family_params(sd, prefix, L, attn_bias=False):
               for p in ("q", "k", "v")]
         return np.concatenate(ws, axis=1)     # [H, (nh + 2*kv) * hd]
 
-    def qkv_bias(i):
+    def qkv_b(i):
         return np.concatenate(
             [g(f"layers.{i}.self_attn.{p}_proj.bias") for p in ("q", "k", "v")])
 
     blocks = {
         "ln1": {"scale": stack(
             lambda i: g(f"layers.{i}.input_layernorm.weight"))},
-        "attn_qkv": ({"kernel": stack(qkv), "bias": stack(qkv_bias)}
-                     if attn_bias else {"kernel": stack(qkv)}),
+        "attn_qkv": ({"kernel": stack(qkv), "bias": stack(qkv_b)}
+                     if qkv_bias else {"kernel": stack(qkv)}),
         "attn_proj": ({"kernel": stack(
             lambda i: g(f"layers.{i}.self_attn.o_proj.weight").T),
             "bias": stack(lambda i: g(f"layers.{i}.self_attn.o_proj.bias"))}
-            if attn_bias else {"kernel": stack(
+            if o_bias else {"kernel": stack(
                 lambda i: g(f"layers.{i}.self_attn.o_proj.weight").T)}),
         "ln2": {"scale": stack(
             lambda i: g(f"layers.{i}.post_attention_layernorm.weight"))},
@@ -807,7 +810,14 @@ def _load_hf_llama_family(model_or_state_dict, config,
     windows = None
     if use_sliding_window:
         w = getattr(config, "sliding_window", None)
-        windows = ((int(w),) * L) if w else None
+        if use_sliding_window == "qwen2":
+            # Qwen2 gates the window behind use_sliding_window and leaves
+            # the first max_window_layers on full attention
+            if getattr(config, "use_sliding_window", False) and w:
+                mw = int(getattr(config, "max_window_layers", 0))
+                windows = tuple(0 if i < mw else int(w) for i in range(L))
+        elif w:                                  # Mistral: every layer
+            windows = (int(w),) * L
     kv = getattr(config, "num_key_value_heads", None) \
         or config.num_attention_heads
     tie = bool(getattr(config, "tie_word_embeddings", False))
@@ -826,9 +836,14 @@ def _load_hf_llama_family(model_or_state_dict, config,
             f"head_dim={hd_cfg} != hidden_size/num_heads "
             f"({config.hidden_size}/{config.num_attention_heads}): "
             "decoupled head_dim (Mistral-Nemo style) is not supported")
-    if getattr(config, "mlp_bias", False):
+    if getattr(config, "mlp_bias", False) \
+            or prefix + "layers.0.mlp.gate_proj.bias" in sd:
         raise NotImplementedError("mlp_bias=True is not supported")
-    attn_bias = bool(getattr(config, "attention_bias", False))
+    # bias flags are PRESENCE-driven (the config attr alone is a trap: a
+    # fresh Qwen2 carries zero-initialized q/k/v biases that a config-only
+    # check could drop while still passing random-init parity)
+    qkv_bias = prefix + "layers.0.self_attn.q_proj.bias" in sd
+    o_bias = prefix + "layers.0.self_attn.o_proj.bias" in sd
     cfg = TransformerConfig(
         vocab_size=config.vocab_size,
         max_seq_len=config.max_position_embeddings,
@@ -844,15 +859,16 @@ def _load_hf_llama_family(model_or_state_dict, config,
         rotary_interleaved=False,           # HF rotate_half layout
         rope_theta=float(getattr(config, "rope_theta", 10000.0)),
         use_bias=False,
-        # Qwen-style attention_bias=True: biased q/k/v/o, unbiased MLP
-        qkv_bias=attn_bias,
-        attn_out_bias=attn_bias,
+        # Llama attention_bias=True: q/k/v/o biased; Qwen2: q/k/v only
+        qkv_bias=qkv_bias,
+        attn_out_bias=o_bias,
         tie_embeddings=tie,
         layer_norm_eps=float(config.rms_norm_eps),
         layer_windows=windows,
         scan_layers=True,
     )
-    params, g = _llama_family_params(sd, prefix, L, attn_bias=attn_bias)
+    params, g = _llama_family_params(sd, prefix, L, qkv_bias=qkv_bias,
+                                     o_bias=o_bias)
     if not tie:
         if "lm_head.weight" not in sd:
             # fail loudly like every other CausalLM loader — fabricating a
@@ -879,11 +895,22 @@ def load_hf_mistral(model_or_state_dict, config=None):
                                  use_sliding_window=True)
 
 
+def load_hf_qwen2(model_or_state_dict, config=None):
+    """Qwen2/Qwen2.5 (HF Qwen2ForCausalLM): the Llama block family with
+    q/k/v biases (no o bias — detected from the state dict), optionally
+    tied embeddings, and a sliding window gated behind use_sliding_window
+    with the first max_window_layers on full attention."""
+    return _load_hf_llama_family(model_or_state_dict, config,
+                                 use_sliding_window="qwen2")
+
+
 HF_POLICIES = {
     "llama": load_hf_llama,
     "LlamaForCausalLM": load_hf_llama,
     "mistral": load_hf_mistral,
     "MistralForCausalLM": load_hf_mistral,
+    "qwen2": load_hf_qwen2,
+    "Qwen2ForCausalLM": load_hf_qwen2,
     "gptneo": load_hf_gpt_neo,
     "GPTNeoForCausalLM": load_hf_gpt_neo,
     "gptj": load_hf_gptj,

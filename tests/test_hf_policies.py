@@ -581,3 +581,59 @@ def test_llama_untied_without_head_rejected_and_gated_moe_rejected():
     from deepspeed_tpu.models.transformer import get_config
     with pytest.raises(NotImplementedError, match="gated_mlp"):
         get_config("gpt2-tiny", gated_mlp=True, moe_experts=4)
+
+
+def test_hf_qwen2_parity_nonzero_biases():
+    """Qwen2 (policy 14): Llama family with q/k/v biases but NO o bias —
+    mapping is presence-driven from the state dict. Biases are forced
+    NONZERO first: a fresh HF model zero-inits them, so a loader that
+    dropped them would still pass random-init parity (the trap this test
+    exists to close)."""
+    import dataclasses
+    hf = transformers.Qwen2ForCausalLM(transformers.Qwen2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=56,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=True)).eval()
+    torch.manual_seed(0)            # unseeded normal_ made this flaky
+    with torch.no_grad():
+        for layer in hf.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(std=0.2)
+    ids = np.random.default_rng(5).integers(0, 96, (2, 20))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    params, cfg = load_hf(hf)
+    assert "bias" in params["blocks"]["attn_qkv"]
+    assert "bias" not in params["blocks"]["attn_proj"]
+    assert cfg.tie_embeddings
+    model = Transformer(dataclasses.replace(cfg, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    ours = np.asarray(model.apply({"params": params},
+                                  {"input_ids": jnp.asarray(ids)}))
+    np.testing.assert_allclose(ours, ref, rtol=4e-3, atol=4e-3)
+
+
+def test_hf_qwen2_sliding_window_gating():
+    """Qwen2's window only engages when use_sliding_window=True, and the
+    first max_window_layers stay on full attention."""
+    mk = lambda **kw: transformers.Qwen2ForCausalLM(transformers.Qwen2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=56,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, **kw)).eval()
+    _, cfg_off = load_hf(mk(sliding_window=8, use_sliding_window=False))
+    assert cfg_off.layer_windows is None
+    hf = mk(sliding_window=8, use_sliding_window=True, max_window_layers=1)
+    _, cfg_on = load_hf(hf)
+    assert cfg_on.layer_windows == (0, 8, 8)
+    # and parity holds with the window binding (seq 20 > window 8)
+    import dataclasses
+    ids = np.random.default_rng(6).integers(0, 96, (2, 20))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    params, _ = load_hf(hf)
+    model = Transformer(dataclasses.replace(cfg_on, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    ours = np.asarray(model.apply({"params": params},
+                                  {"input_ids": jnp.asarray(ids)}))
+    np.testing.assert_allclose(ours, ref, rtol=4e-3, atol=4e-3)
