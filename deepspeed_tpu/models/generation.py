@@ -260,13 +260,20 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
         to_heads = lambda t, n: t.reshape(B, T_new, n, hd).transpose(
             0, 2, 1, 3)
         q, k, v = to_heads(q, nh), to_heads(k, kvh), to_heads(v, kvh)
+        if cfg.qk_norm:
+            # Qwen3: per-head RMSNorm on q/k before rotary
+            q = _layer_norm(q, p["q_norm"], cfg.layer_norm_eps, rms=True)
+            k = _layer_norm(k, p["k_norm"], cfg.layer_norm_eps, rms=True)
         if cfg.pos_embed == "rotary":
             # q_log: logical (pad-corrected) positions — [B, T] for ragged
             # left-padded batches, [T] otherwise (apply_rotary handles both)
+            # table covers the cache capacity (dynamic NTK stretches once;
+            # None = plain-theta table)
+            inv_freq = cfg.rope_inv_freq(max_len)
             q = apply_rotary(q, q_log, cfg.rotary_dim, cfg.rotary_interleaved,
-                             cfg.rope_theta)
+                             cfg.rope_theta, inv_freq=inv_freq)
             k = apply_rotary(k, q_log, cfg.rotary_dim, cfg.rotary_interleaved,
-                             cfg.rope_theta)
+                             cfg.rope_theta, inv_freq=inv_freq)
         if kvh != nh:
             # GQA: repeat kv to full heads BEFORE the cache write — the
             # cache stays [L, B, nh, len, hd], so the decode kernel and

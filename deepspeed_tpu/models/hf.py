@@ -759,10 +759,12 @@ def _to_f32(params):
 
 
 # policy registry (reference: replace_policy.py replace_policies list)
-def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False):
-    """Shared Llama/Mistral/Qwen2 block mapping: RMSNorm + GQA qkv + SwiGLU.
-    Bias flags are PRESENCE-driven by the caller (Llama attention_bias has
-    q/k/v/o biases; Qwen2 has q/k/v only)."""
+def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False,
+                         mlp_bias=False, qk_norm=False):
+    """Shared Llama/Mistral/Qwen2/Qwen3 block mapping: RMSNorm + GQA qkv +
+    SwiGLU. Bias flags are PRESENCE-driven by the caller (Llama
+    attention_bias has q/k/v/o biases; Qwen2 has q/k/v only; mlp_bias
+    biases gate/up/down; qk_norm adds Qwen3's per-head q/k RMSNorm)."""
     g = lambda n: _np(sd[prefix + n])
     stack = _stacker(g, L)
 
@@ -775,25 +777,28 @@ def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False):
         return np.concatenate(
             [g(f"layers.{i}.self_attn.{p}_proj.bias") for p in ("q", "k", "v")])
 
+    def proj(hf, biased):
+        p = {"kernel": stack(lambda i: g(f"layers.{i}.{hf}.weight").T)}
+        if biased:
+            p["bias"] = stack(lambda i: g(f"layers.{i}.{hf}.bias"))
+        return p
+
     blocks = {
         "ln1": {"scale": stack(
             lambda i: g(f"layers.{i}.input_layernorm.weight"))},
         "attn_qkv": ({"kernel": stack(qkv), "bias": stack(qkv_b)}
                      if qkv_bias else {"kernel": stack(qkv)}),
-        "attn_proj": ({"kernel": stack(
-            lambda i: g(f"layers.{i}.self_attn.o_proj.weight").T),
-            "bias": stack(lambda i: g(f"layers.{i}.self_attn.o_proj.bias"))}
-            if o_bias else {"kernel": stack(
-                lambda i: g(f"layers.{i}.self_attn.o_proj.weight").T)}),
+        "attn_proj": proj("self_attn.o_proj", o_bias),
         "ln2": {"scale": stack(
             lambda i: g(f"layers.{i}.post_attention_layernorm.weight"))},
-        "mlp_gate": {"kernel": stack(
-            lambda i: g(f"layers.{i}.mlp.gate_proj.weight").T)},
-        "mlp_fc": {"kernel": stack(
-            lambda i: g(f"layers.{i}.mlp.up_proj.weight").T)},
-        "mlp_proj": {"kernel": stack(
-            lambda i: g(f"layers.{i}.mlp.down_proj.weight").T)},
+        "mlp_gate": proj("mlp.gate_proj", mlp_bias),
+        "mlp_fc": proj("mlp.up_proj", mlp_bias),
+        "mlp_proj": proj("mlp.down_proj", mlp_bias),
     }
+    if qk_norm:
+        for name in ("q_norm", "k_norm"):
+            blocks[name] = {"scale": stack(
+                lambda i, n=name: g(f"layers.{i}.self_attn.{n}.weight"))}
     params = {
         "wte": {"embedding": g("embed_tokens.weight")},
         "blocks": blocks,
@@ -816,34 +821,59 @@ def _load_hf_llama_family(model_or_state_dict, config,
             if getattr(config, "use_sliding_window", False) and w:
                 mw = int(getattr(config, "max_window_layers", 0))
                 windows = tuple(0 if i < mw else int(w) for i in range(L))
+        elif use_sliding_window == "layer_types":
+            # Qwen3: per-layer attention kind in config.layer_types
+            lt = getattr(config, "layer_types", None)
+            if w and lt:
+                windows = tuple(int(w) if t == "sliding_attention" else 0
+                                for t in lt)
         elif w:                                  # Mistral: every layer
             windows = (int(w),) * L
     kv = getattr(config, "num_key_value_heads", None) \
         or config.num_attention_heads
     tie = bool(getattr(config, "tie_word_embeddings", False))
-    # refuse silently-wrong loads: scaled RoPE variants (Llama-3.1+) change
-    # the inv_freq table, and a non-standard head_dim changes every qkv
-    # shape — both must fail HERE, not decode garbage
-    scaling = getattr(config, "rope_scaling", None)
-    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
+    # scaled RoPE (Llama-3.1+ / linear PI / dynamic NTK): mapped onto the
+    # static rope_scaling_* config knobs (TransformerConfig.rope_inv_freq
+    # mirrors HF modeling_rope_utils token-exactly). Genuinely unsupported
+    # geometries (yarn / longrope) still fail HERE, not decode garbage.
+    scaling = getattr(config, "rope_scaling", None) or {}
+    rope_type = scaling.get("rope_type", scaling.get("type", "default"))
+    if rope_type not in ("default", "linear", "dynamic", "llama3"):
         raise NotImplementedError(
-            f"rope_scaling={scaling}: scaled RoPE variants (llama3 / "
-            "linear / dynamic) are not implemented; loading with plain "
-            "rope_theta would produce wrong frequencies")
+            f"rope_scaling type {rope_type!r} is not implemented "
+            "(yarn / longrope): loading with plain rope_theta would "
+            "produce wrong frequencies")
+    rope_kwargs = {}
+    if rope_type != "default":
+        # "factor" is mandatory for every scaled type (HF raises KeyError
+        # in modeling_rope_utils too) — a missing key must not quietly
+        # load as an unscaled table
+        rope_kwargs = dict(
+            rope_scaling_type=rope_type,
+            rope_scaling_factor=float(scaling["factor"]),
+            # dynamic NTK: HF ignores the dict's
+            # original_max_position_embeddings (explicit TODO there) and
+            # stretches relative to config.max_position_embeddings;
+            # llama3 reads the dict key. Mirror each exactly.
+            rope_original_max_position=int(
+                config.max_position_embeddings if rope_type != "llama3"
+                else scaling.get("original_max_position_embeddings",
+                                 config.max_position_embeddings)),
+        )
+        if rope_type == "llama3":
+            rope_kwargs.update(
+                rope_low_freq_factor=float(scaling["low_freq_factor"]),
+                rope_high_freq_factor=float(scaling["high_freq_factor"]))
+    # decoupled head_dim (Mistral-Nemo style): qkv projects to
+    # (nh + 2*kv) * head_dim independent of hidden_size/num_heads
     hd_cfg = getattr(config, "head_dim", None)
-    if hd_cfg and hd_cfg != config.hidden_size // config.num_attention_heads:
-        raise NotImplementedError(
-            f"head_dim={hd_cfg} != hidden_size/num_heads "
-            f"({config.hidden_size}/{config.num_attention_heads}): "
-            "decoupled head_dim (Mistral-Nemo style) is not supported")
-    if getattr(config, "mlp_bias", False) \
-            or prefix + "layers.0.mlp.gate_proj.bias" in sd:
-        raise NotImplementedError("mlp_bias=True is not supported")
     # bias flags are PRESENCE-driven (the config attr alone is a trap: a
     # fresh Qwen2 carries zero-initialized q/k/v biases that a config-only
     # check could drop while still passing random-init parity)
     qkv_bias = prefix + "layers.0.self_attn.q_proj.bias" in sd
     o_bias = prefix + "layers.0.self_attn.o_proj.bias" in sd
+    mlp_bias = prefix + "layers.0.mlp.gate_proj.bias" in sd
+    qk_norm = prefix + "layers.0.self_attn.q_norm.weight" in sd
     cfg = TransformerConfig(
         vocab_size=config.vocab_size,
         max_seq_len=config.max_position_embeddings,
@@ -858,17 +888,22 @@ def _load_hf_llama_family(model_or_state_dict, config,
         pos_embed="rotary",
         rotary_interleaved=False,           # HF rotate_half layout
         rope_theta=float(getattr(config, "rope_theta", 10000.0)),
+        head_dim_override=int(hd_cfg) if hd_cfg else None,
         use_bias=False,
         # Llama attention_bias=True: q/k/v/o biased; Qwen2: q/k/v only
         qkv_bias=qkv_bias,
         attn_out_bias=o_bias,
+        mlp_bias=mlp_bias,
+        qk_norm=qk_norm,
         tie_embeddings=tie,
         layer_norm_eps=float(config.rms_norm_eps),
         layer_windows=windows,
         scan_layers=True,
+        **rope_kwargs,
     )
     params, g = _llama_family_params(sd, prefix, L, qkv_bias=qkv_bias,
-                                     o_bias=o_bias)
+                                     o_bias=o_bias, mlp_bias=mlp_bias,
+                                     qk_norm=qk_norm)
     if not tie:
         if "lm_head.weight" not in sd:
             # fail loudly like every other CausalLM loader — fabricating a
@@ -904,6 +939,14 @@ def load_hf_qwen2(model_or_state_dict, config=None):
                                  use_sliding_window="qwen2")
 
 
+def load_hf_qwen3(model_or_state_dict, config=None):
+    """Qwen3 (policy 15): the Llama block family with per-head q/k RMSNorm
+    before rotary, a decoupled head_dim, no attention biases, and per-layer
+    sliding windows driven by config.layer_types."""
+    return _load_hf_llama_family(model_or_state_dict, config,
+                                 use_sliding_window="layer_types")
+
+
 HF_POLICIES = {
     "llama": load_hf_llama,
     "LlamaForCausalLM": load_hf_llama,
@@ -911,6 +954,8 @@ HF_POLICIES = {
     "MistralForCausalLM": load_hf_mistral,
     "qwen2": load_hf_qwen2,
     "Qwen2ForCausalLM": load_hf_qwen2,
+    "qwen3": load_hf_qwen3,
+    "Qwen3ForCausalLM": load_hf_qwen3,
     "gptneo": load_hf_gpt_neo,
     "GPTNeoForCausalLM": load_hf_gpt_neo,
     "gptj": load_hf_gptj,

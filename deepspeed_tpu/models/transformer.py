@@ -112,6 +112,25 @@ class TransformerConfig:
     # (None = MHA). num_heads % num_kv_heads must be 0.
     num_kv_heads: Optional[int] = None
     rope_theta: float = 10000.0    # rotary base (Llama-3 uses 500000)
+    # scaled RoPE (HF config.rope_scaling; llama3 per-frequency remap /
+    # linear position interpolation / dynamic NTK). All parameters are
+    # trace-time static, so the scaled inv_freq table costs nothing at run
+    # time. HF formula sources: transformers modeling_rope_utils
+    # _compute_{linear_scaling,dynamic_ntk,llama3}_parameters.
+    rope_scaling_type: Optional[str] = None   # "linear"|"dynamic"|"llama3"
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0         # llama3 only
+    rope_high_freq_factor: float = 4.0        # llama3 only
+    rope_original_max_position: int = 0       # 0 = max_seq_len
+    # decoupled head_dim (Mistral-Nemo/Gemma style): attention head width
+    # independent of hidden_size/num_heads; qkv projects to
+    # (nh + 2*kv) * head_dim and attn_proj maps nh*head_dim back to H
+    head_dim_override: Optional[int] = None
+    # biases on the gated-MLP projections (HF LlamaConfig.mlp_bias);
+    # None = use_bias
+    mlp_bias: Optional[bool] = None
+    # Qwen3: per-head RMSNorm on q and k (over head_dim) before rotary
+    qk_norm: bool = False
     # explicit MLP width when it is not ratio*H (Llama: 11008 at H=4096)
     mlp_dim_override: Optional[int] = None
     # MoE (reference: deepspeed/moe/*): >0 replaces every block's MLP with a
@@ -131,7 +150,61 @@ class TransformerConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.hidden_size // self.num_heads
+
+    def rope_inv_freq(self, seq_len: Optional[int] = None):
+        """Static inverse-frequency table for rotary embeddings with the
+        configured rope_scaling applied (mirrors HF modeling_rope_utils for
+        linear / dynamic / llama3). Returns None when no scaling is
+        configured so apply_rotary keeps its original in-trace table —
+        bit-identical to what every unscaled arch's token-exact parity was
+        validated against.
+
+        ``seq_len``: the target length the table must cover — dynamic NTK
+        stretches the base once this exceeds the original window (HF
+        recomputes per forward from max(position)+1; passing the static
+        trace-time S here matches that exactly). Decode passes the cache
+        capacity instead: one table for the whole planned generation,
+        where HF re-rotates nothing and lets keys cached under earlier
+        tables disagree — ours is the path-independent variant."""
+        t = self.rope_scaling_type
+        if t is None or t == "default":
+            return None
+        # float32 arithmetic end-to-end: HF computes these tables in
+        # torch.float32, and parity is checked token-exact
+        rd = self.rotary_dim or self.head_dim
+        inv = 1.0 / (self.rope_theta ** (np.arange(0, rd, 2,
+                                                   dtype=np.float32) / rd))
+        f = self.rope_scaling_factor
+        orig = self.rope_original_max_position or self.max_seq_len
+        if t == "linear":
+            inv = inv / f
+        elif t == "dynamic":
+            # NTK: the base stretches once positions exceed the original
+            # window; seq_len is static under jit, so the table for
+            # max_seq_len is the one HF would have converged to at that
+            # length (identical to default while max_seq_len <= orig)
+            eff = max(seq_len or self.max_seq_len, orig)
+            base = self.rope_theta * (
+                (f * eff / orig) - (f - 1)) ** (rd / (rd - 2))
+            inv = 1.0 / (base ** (np.arange(0, rd, 2,
+                                            dtype=np.float32) / rd))
+        elif t == "llama3":
+            lo, hi = self.rope_low_freq_factor, self.rope_high_freq_factor
+            low_wl, high_wl = orig / lo, orig / hi
+            wavelen = 2.0 * np.pi / inv
+            inv_l = np.where(wavelen > low_wl, inv / f, inv)
+            smooth = (orig / wavelen - lo) / (hi - lo)
+            smoothed = (1.0 - smooth) * inv_l / f + smooth * inv_l
+            is_medium = (wavelen >= high_wl) & (wavelen <= low_wl)
+            inv = np.where(is_medium, smoothed, inv_l)
+        else:
+            raise NotImplementedError(
+                f"rope_scaling type {t!r} is not implemented "
+                "(yarn / longrope are out of scope)")
+        return inv.astype(np.float32)
 
     @property
     def mlp_dim(self) -> int:
@@ -155,7 +228,7 @@ class TransformerConfig:
     def _attn_params(self) -> int:
         h = self.hidden_size
         return (self.num_heads + 2 * self.kv_heads) * self.head_dim * h \
-            + h * h                           # qkv (GQA-aware) + out proj
+            + self.num_heads * self.head_dim * h   # qkv (GQA) + out proj
 
     def _mlp_params(self) -> int:
         return (3 if self.gated_mlp else 2) * self.mlp_dim * self.hidden_size
@@ -259,20 +332,25 @@ _ACTIVATIONS = {
 
 def apply_rotary(x: jnp.ndarray, positions: jnp.ndarray,
                  rotary_dim: int = 0, interleaved: bool = True,
-                 theta: float = 10000.0) -> jnp.ndarray:
+                 theta: float = 10000.0, inv_freq=None) -> jnp.ndarray:
     """Rotary embedding; interleaved=True is the GPT-J rotate_every_two pair
     layout, False is the GPT-NeoX rotate_half half-split layout.
 
     x: [B, nh, S, hd]; positions: [B, S] or [S]. Only the first rotary_dim
     channels rotate (GPT-J: 64 of 256; NeoX: rotary_pct * hd); the rest pass
-    through. reference arch sources: HF GPTJAttention._apply_rotary_pos_emb,
-    HF GPTNeoXAttention (rotate_half).
+    through. ``inv_freq`` (a static [rd/2] table, e.g. from
+    TransformerConfig.rope_inv_freq for scaled-RoPE variants) overrides the
+    plain-theta table. reference arch sources: HF
+    GPTJAttention._apply_rotary_pos_emb, HF GPTNeoXAttention (rotate_half).
     """
     B, nh, S, hd = x.shape
     rd = rotary_dim or hd
     if positions.ndim == 1:
         positions = positions[None, :]
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, rd, 2) / rd))
+    if inv_freq is None:
+        inv_freq = 1.0 / (theta ** (jnp.arange(0, rd, 2) / rd))
+    else:
+        inv_freq = jnp.asarray(inv_freq, jnp.float32)
     ang = positions[:, :, None].astype(jnp.float32) * inv_freq[None, None, :]
     sin = jnp.sin(ang)[:, None, :, :]                   # [B, 1, S, rd/2]
     cos = jnp.cos(ang)[:, None, :, :]
@@ -465,12 +543,21 @@ class Block(nn.Module):
         q, k, v = jnp.split(qkv, [nh * hd, (nh + kv) * hd], axis=-1)
         to_heads = lambda t, n: t.reshape(B, S, n, hd).transpose(0, 2, 1, 3)
         q, k, v = to_heads(q, nh), to_heads(k, kv), to_heads(v, kv)
+        if cfg.qk_norm:
+            # Qwen3: RMSNorm over head_dim on q/k, before rotary (HF
+            # Qwen3Attention.q_norm/k_norm — per-head, scale-only)
+            qk_ln = lambda name: nn.RMSNorm(
+                epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                param_dtype=jnp.float32, name=name)
+            q = qk_ln("q_norm")(q)
+            k = qk_ln("k_norm")(k)
         if cfg.pos_embed == "rotary":
             pos = positions if positions is not None else jnp.arange(S)
+            inv_freq = cfg.rope_inv_freq(S)     # None = plain-theta table
             q = apply_rotary(q, pos, cfg.rotary_dim, cfg.rotary_interleaved,
-                             cfg.rope_theta)
+                             cfg.rope_theta, inv_freq=inv_freq)
             k = apply_rotary(k, pos, cfg.rotary_dim, cfg.rotary_interleaved,
-                             cfg.rope_theta)
+                             cfg.rope_theta, inv_freq=inv_freq)
         if kv != nh:
             # grouped-query: each k/v head serves nh/kv query heads
             k = jnp.repeat(k, nh // kv, axis=1)
@@ -506,7 +593,8 @@ class Block(nn.Module):
         # not a dot_general, and recomputing flash fwd in bwd costs ~2ms/layer
         from jax.ad_checkpoint import checkpoint_name
         out = checkpoint_name(out, "attn_out")
-        out = out.transpose(0, 2, 1, 3).reshape(B, S, H)
+        # nh*hd == H unless head_dim_override decouples them (Mistral-Nemo)
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
         out = dense(H, "attn_proj", bias=cfg.attn_out_bias)(out)
         if cfg.dropout > 0.0 and train:
             out = nn.Dropout(cfg.dropout)(out, deterministic=False)
@@ -530,9 +618,9 @@ class Block(nn.Module):
             if cfg.gated_mlp:
                 # SwiGLU (Llama family): down(act(gate(x)) * up(x)); the
                 # gate/up matmuls fuse side by side on the MXU
-                g = act(dense(cfg.mlp_dim, "mlp_gate")(h))
-                h = g * dense(cfg.mlp_dim, "mlp_fc")(h)
-                return dense(H, "mlp_proj")(h), aux
+                g = act(dense(cfg.mlp_dim, "mlp_gate", bias=cfg.mlp_bias)(h))
+                h = g * dense(cfg.mlp_dim, "mlp_fc", bias=cfg.mlp_bias)(h)
+                return dense(H, "mlp_proj", bias=cfg.mlp_bias)(h), aux
             h = dense(cfg.mlp_dim, "mlp_fc")(h)
             h = act(h)
             h = dense(H, "mlp_proj")(h)
