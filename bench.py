@@ -12,12 +12,13 @@ Baseline: the reference's headline sustained training throughput of
 50 TFLOPS/GPU (ZeRO-3 Offload on V100, docs/_posts/2021-03-08-zero3-offload.md:65;
 see BASELINE.md). vs_baseline = our model TFLOPs/chip / 50.
 
-Tuned configs (measured on v5e, rounds 2-3 — sweeps in scripts/perf_sweep.py):
-350m: micro 16 x gas 16, selective "dots" remat, fused chunked CE (256-token
-chunks), Pallas flash attention — ~76 TF/chip, at the H=1024 matmul-shape
-ceiling (sustained-matmul roofline measured in docs/BENCHMARKS.md).
-1.3b: micro 2 x gas 16, same remat/loss, pure-bf16 state — ~105 TF/chip
-(H=2048 shapes feed the MXU much better).
+Tuned configs (measured on v5e, rounds 2-5 — sweeps in scripts/perf_sweep.py
+and the round-5 gas-amortization sweep in docs/BENCHMARKS.md): every leg
+carries a fixed ~0.33 s/step optimizer+sync overhead, so raising gradient
+accumulation amortizes it — gas 16 -> 64 lifted the 1.3b north-star from
+~104 to ~111 TF/chip (60.6% MFU incl. attention). seq-2048 additionally
+switched to "full" remat, which frees enough HBM for micro 2 (the round-4
+micro-1 shape was the real ceiling there: 84.5 -> ~93 TF).
 """
 
 import json
@@ -46,38 +47,40 @@ def main():
         # the 1.3b legs need nearly the whole chip: run them FIRST (clean
         # HBM), free everything, then run the 350m leg; emit the north-star
         # 1.3b seq-1024 line LAST so the driver records it.
-        # 12 fenced per-step timings -> median + spread in detail (round-3
-        # Weak #1: 6 steps couldn't separate contention from regression);
-        # micro/remat sweep rationale in docs/BENCHMARKS.md (micro 4 and
-        # seq2048/micro2 exceed compile-able HBM; "full" remat loses ~5%).
-        r13 = run_training_bench("gpt2-1.3b", seq=1024, micro=2, gas=16,
-                                 steps=12, zero_stage=3, remat=True,
-                                 remat_policy="dots", fused_loss=True,
-                                 pure_bf16=True, grad_accum_dtype="bf16",
-                                 verbose=False)
-        gc.collect()
-        jax.clear_caches()
-        r20 = run_training_bench("gpt2-1.3b", seq=2048, micro=1, gas=16,
+        # Per-step timings are individually fenced (round-3 Weak #1); step
+        # counts are sized so every leg runs 45-90 s of timed steps at the
+        # round-5 gas settings. Config rationale: docs/BENCHMARKS.md
+        # round-5 sweep (fixed ~0.33 s/step overhead amortized by gas;
+        # "full" remat frees HBM for micro 2 at seq 2048).
+        r13 = run_training_bench("gpt2-1.3b", seq=1024, micro=2, gas=64,
                                  steps=8, zero_stage=3, remat=True,
                                  remat_policy="dots", fused_loss=True,
                                  pure_bf16=True, grad_accum_dtype="bf16",
                                  verbose=False)
         gc.collect()
         jax.clear_caches()
+        # seq 2048: "full" remat frees enough HBM for micro 2 (round 4's
+        # micro-1 was the binding constraint: 84.5 TF); gas 32 amortizes
+        # the fixed step overhead; 512-token CE chunks suit the longer seq
+        r20 = run_training_bench("gpt2-1.3b", seq=2048, micro=2, gas=32,
+                                 steps=6, zero_stage=3, remat=True,
+                                 remat_policy="full", fused_loss=True,
+                                 loss_chunk=512, pure_bf16=True,
+                                 grad_accum_dtype="bf16", verbose=False)
+        gc.collect()
+        jax.clear_caches()
         # modern-decoder leg (round 4): TinyLlama-1.1B shapes — RMSNorm,
         # SwiGLU, GQA 32q/4kv, rotary, untied head (docs/BENCHMARKS.md)
-        rll = run_training_bench("llama-1.1b", seq=1024, micro=2, gas=16,
-                                 steps=12, zero_stage=3, remat=True,
+        rll = run_training_bench("llama-1.1b", seq=1024, micro=2, gas=32,
+                                 steps=8, zero_stage=3, remat=True,
                                  remat_policy="dots", fused_loss=True,
                                  pure_bf16=True, grad_accum_dtype="bf16",
                                  verbose=False)
         gc.collect()
         jax.clear_caches()
-        # micro 4 x gas 64: found by the round-4 cold-start autotune
-        # (scripts/autotune_350m.py) and confirmed at 12-step medians —
-        # +4.5% over the round-3 hand-tuned micro 16 x gas 16 (the smaller
-        # live activation set beats the larger matmul batch at 350M)
-        r = run_training_bench("gpt2-350m", seq=1024, micro=4, gas=64,
+        # micro 4 (the round-4 cold-start autotune's pick over the hand
+        # micro 16) x gas 128 (round-5 amortization sweep)
+        r = run_training_bench("gpt2-350m", seq=1024, micro=4, gas=128,
                                steps=6, zero_stage=1, remat=True,
                                remat_policy="dots", fused_loss=True,
                                verbose=False)
