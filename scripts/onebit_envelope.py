@@ -1,0 +1,134 @@
+"""Quantify the 1-bit / 0/1-Adam state-memory envelope (VERDICT r4 item 7).
+
+Measures REAL per-device optimizer-state bytes (from each leaf's actual
+shards on an 8-virtual-device CPU mesh) for:
+
+  - AdamW + ZeRO-1            (the baseline the 1-bit family gives up)
+  - OneBitAdam  (zero_stage 1, past freeze_step — compression phase)
+  - ZeroOneAdam (zero_stage 1, past var_freeze_step — local-step phase)
+
+and extrapolates bytes/param/device to 1.3B scale. Run:
+    python scripts/onebit_envelope.py
+(re-execs itself onto the CPU mesh; prints a markdown table.)
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_13B = 1.314e9        # gpt2-1.3b param count the bench legs use
+
+
+def per_device_bytes(tree):
+    """Worst-device resident bytes of a pytree, from each leaf's REAL
+    shards (also imported by test_onebit.py's memory-model regression)."""
+    import jax
+    dev = {}
+    for leaf in jax.tree.leaves(tree):
+        for sh in leaf.addressable_shards:
+            dev[sh.device] = dev.get(sh.device, 0) + sh.data.nbytes
+    return max(dev.values()) if dev else 0
+
+
+def _measure():
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu as ds
+
+    n_dev = len(jax.devices())
+
+    def breakdown(state):
+        return {k: per_device_bytes(v) for k, v in state.items()
+                if k != "lrs"}
+
+    # plain MLP regressor: the 1-bit runners are pure-DP and own the whole
+    # step (the Transformer's internal sharding constraints are for the
+    # SPMD engine path); the state layout only depends on the param TREE,
+    # so any tree of realistic leaf shapes measures the envelope
+    H = 512
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, batch, train=False):
+            x = batch["x"]
+            for _ in range(4):
+                x = nn.tanh(nn.Dense(H)(x))
+            y = nn.Dense(1)(x)
+            return jnp.mean((y[:, 0] - batch["y"]) ** 2)
+
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((2 * n_dev, H)).astype(np.float32),
+             "y": rng.standard_normal((2 * n_dev,)).astype(np.float32)}
+    model = MLP()
+    n_params = 4 * (H * H + H) + H + 1
+
+    def run(opt_type, opt_params, steps):
+        config = {
+            "train_batch_size": 2 * n_dev,
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": opt_type, "params": opt_params},
+            "zero_optimization": {"stage": 1},
+            "seed": 5,
+        }
+        eng, *_ = ds.initialize(model=model, config=config,
+                                example_batch=batch)
+        for _ in range(steps):
+            eng.train_batch(batch)
+        return eng
+
+    rows = {}
+    eng = run("AdamW", {"lr": 1e-3}, steps=2)
+    rows["adamw_zero1"] = {"total": per_device_bytes(eng.state.opt_state)}
+    del eng
+
+    eng = run("OneBitAdam", {"lr": 1e-3, "freeze_step": 4}, steps=8)
+    st = eng.state.opt_state["onebit"]
+    rows["onebit_zero1_postfreeze"] = dict(breakdown(st),
+                                           total=per_device_bytes(st))
+    del eng
+
+    eng = run("ZeroOneAdam", {"lr": 1e-3, "var_freeze_step": 4,
+                              "var_update_scaler": 2,
+                              "local_step_scaler": 4,
+                              "local_step_clipper": 4}, steps=10)
+    st = eng.state.opt_state["onebit"]
+    rows["zeroone_zero1_localphase"] = dict(breakdown(st),
+                                            total=per_device_bytes(st))
+
+    print(json.dumps({"n_devices": n_dev, "n_params": n_params,
+                      "rows": rows}))
+
+
+def main():
+    from deepspeed_tpu.utils.respawn import clean_cpu_env
+    env = clean_cpu_env(8)
+    proc = subprocess.run(
+        [sys.executable, "-u", "-c",
+         f"import sys; sys.path.insert(0, {REPO!r}); "
+         "from scripts.onebit_envelope import _measure; _measure()"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=1800)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-4000:])
+        sys.exit(1)
+    data = json.loads(proc.stdout.strip().splitlines()[-1])
+    n, N = data["n_devices"], data["n_params"]
+    print(f"measured on {n} devices, model N = {N/1e6:.2f}M params\n")
+    print("| optimizer (ZeRO-1) | state bytes/param/device | at 1.3B "
+          "(GB/device, fp32) | breakdown (bytes/param) |")
+    print("|---|---|---|---|")
+    for name, row in data["rows"].items():
+        bpp = row["total"] / N
+        gb = bpp * N_13B / 2**30
+        det = ", ".join(f"{k} {v / N:.2f}" for k, v in sorted(row.items())
+                        if k != "total")
+        print(f"| {name} | {bpp:.2f} | {gb:.1f} | {det} |")
+
+
+if __name__ == "__main__":
+    main()

@@ -475,3 +475,34 @@ def test_overflow_does_not_consume_schedule_steps():
     # good step consumed slot 0; the overflow attempted slot 1 and was
     # skipped; the next good step must RETRY slot 1, not move to slot 2
     assert seen == [0, 1, 1], seen
+
+
+def test_zeroone_local_phase_state_memory_model():
+    """Post-freeze per-device state bytes must match the documented envelope
+    (docs/BENCHMARKS.md 1-bit table): m_local / u / w_err are one
+    full-model copy per DEVICE (stacked [n, ...] dim-0-sharded), v is
+    replicated by design (every local step reads it whole), m / s_err stay
+    ZeRO-1 sharded — ~17 B/param/device total. Round 5's measurement caught
+    the boundary program silently REPLICATING the reset drift u
+    (32 B/param/device) because its fresh zeros carried no sharding pin."""
+    engine, *_ = ds.initialize(model=_SmoothModel(),
+                               example_batch=random_batch(16),
+                               config=_zeroone_config(
+                                   var_freeze_step=2, var_update_scaler=2,
+                                   local_step_scaler=2, local_step_clipper=4))
+    for i in range(8):          # vstep/cstep, then boundary + local steps
+        engine.train_batch(random_batch(16, seed=i))
+    st = engine.state.opt_state["onebit"]
+    n_params = sum(x.size for x in jax.tree.leaves(engine.state.params))
+    # the same shard-byte accounting the envelope table was measured with
+    import pathlib, sys as _sys
+    _sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                            / "scripts"))
+    from onebit_envelope import per_device_bytes
+
+    for key, expect in [("u", 4.0), ("m_local", 4.0), ("w_err", 4.0)]:
+        got = per_device_bytes(st[key]) / n_params
+        assert got <= expect * 1.5, \
+            f"{key}: {got:.1f} B/param/device (stacked sharding lost?)"
+    total = per_device_bytes({k: v for k, v in st.items() if k != "lrs"})
+    assert total / n_params <= 17.0 * 1.3, total / n_params
