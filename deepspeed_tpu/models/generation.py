@@ -146,15 +146,22 @@ def _moe_mlp(cfg: TransformerConfig, p_moe, h):
     gating = top1_gating if cfg.moe_k == 1 else top2_gating
     _aux, combine, dispatch, _ = gating(gate_logits, capacity=B * T)
     disp = jnp.einsum("tec,th->ech", dispatch.astype(h.dtype), tokens)
-    fc = p_moe["experts"]["fc"]
-    hh = jnp.einsum("ech,ehm->ecm", disp, _kernel_of(fc, h.dtype))
-    if "bias" in fc:
-        hh = hh + fc["bias"][:, None].astype(h.dtype)
-    hh = jax.nn.gelu(hh)
-    proj = p_moe["experts"]["proj"]
-    out = jnp.einsum("ecm,emh->ech", hh, _kernel_of(proj, h.dtype))
-    if "bias" in proj:
-        out = out + proj["bias"][:, None].astype(h.dtype)
+
+    def edense(x, p, contract="ech,ehm->ecm"):
+        y = jnp.einsum(contract, x, _kernel_of(p, h.dtype))
+        if "bias" in p:
+            y = y + p["bias"][:, None].astype(h.dtype)
+        return y
+
+    if "gate" in p_moe["experts"]:
+        # SwiGLU experts (Mixtral family): proj(act(gate(x)) * fc(x))
+        from .transformer import _ACTIVATIONS
+        act = _ACTIVATIONS[cfg.activation]
+        g = act(edense(disp, p_moe["experts"]["gate"]))
+        hh = g * edense(disp, p_moe["experts"]["fc"])
+    else:
+        hh = jax.nn.gelu(edense(disp, p_moe["experts"]["fc"]))
+    out = edense(hh, p_moe["experts"]["proj"], "ecm,emh->ech")
     y = jnp.einsum("tec,ech->th", combine.astype(h.dtype), out)
     return y.reshape(B, T, H)
 

@@ -760,9 +760,11 @@ def _to_f32(params):
 
 # policy registry (reference: replace_policy.py replace_policies list)
 def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False,
-                         mlp_bias=False, qk_norm=False):
-    """Shared Llama/Mistral/Qwen2/Qwen3 block mapping: RMSNorm + GQA qkv +
-    SwiGLU. Bias flags are PRESENCE-driven by the caller (Llama
+                         mlp_bias=False, qk_norm=False, moe_experts=0):
+    """Shared Llama/Mistral/Qwen2/Qwen3/Mixtral block mapping: RMSNorm +
+    GQA qkv + SwiGLU (dense, or ``moe_experts`` SwiGLU experts behind a
+    router — HF block_sparse_moe w1/w3/w2 -> our moe.experts
+    gate/fc/proj). Bias flags are PRESENCE-driven by the caller (Llama
     attention_bias has q/k/v/o biases; Qwen2 has q/k/v only; mlp_bias
     biases gate/up/down; qk_norm adds Qwen3's per-head q/k RMSNorm)."""
     g = lambda n: _np(sd[prefix + n])
@@ -791,10 +793,30 @@ def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False,
         "attn_proj": proj("self_attn.o_proj", o_bias),
         "ln2": {"scale": stack(
             lambda i: g(f"layers.{i}.post_attention_layernorm.weight"))},
-        "mlp_gate": proj("mlp.gate_proj", mlp_bias),
-        "mlp_fc": proj("mlp.up_proj", mlp_bias),
-        "mlp_proj": proj("mlp.down_proj", mlp_bias),
     }
+    if moe_experts > 0:
+        E = moe_experts
+
+        def estack(w):
+            """[L, E, in, out] expert-stacked kernels (HF stores [out, in])."""
+            return stack(lambda i: np.stack(
+                [g(f"layers.{i}.block_sparse_moe.experts.{j}.{w}.weight").T
+                 for j in range(E)]))
+
+        blocks["moe"] = {
+            "gate": {"kernel": stack(
+                lambda i: g(f"layers.{i}.block_sparse_moe.gate.weight").T)},
+            # HF MixtralBlockSparseTop2MLP: w1 = gate, w3 = up, w2 = down
+            "experts": {"gate": {"kernel": estack("w1")},
+                        "fc": {"kernel": estack("w3")},
+                        "proj": {"kernel": estack("w2")}},
+        }
+    else:
+        blocks.update(
+            mlp_gate=proj("mlp.gate_proj", mlp_bias),
+            mlp_fc=proj("mlp.up_proj", mlp_bias),
+            mlp_proj=proj("mlp.down_proj", mlp_bias),
+        )
     if qk_norm:
         for name in ("q_norm", "k_norm"):
             blocks[name] = {"scale": stack(
@@ -808,10 +830,12 @@ def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False,
 
 
 def _load_hf_llama_family(model_or_state_dict, config,
-                          use_sliding_window=False):
+                          use_sliding_window=False, moe=False):
     sd, config = _sd_and_config(model_or_state_dict, config)
     prefix = _prefix(sd, "model.")
     L = config.num_hidden_layers
+    moe_experts = int(getattr(config, "num_local_experts", 0)) if moe else 0
+    moe_k = int(getattr(config, "num_experts_per_tok", 2)) if moe else 1
     windows = None
     if use_sliding_window:
         w = getattr(config, "sliding_window", None)
@@ -899,11 +923,21 @@ def _load_hf_llama_family(model_or_state_dict, config,
         layer_norm_eps=float(config.rms_norm_eps),
         layer_windows=windows,
         scan_layers=True,
+        # Mixtral: SwiGLU experts behind a top-k router. The capacity
+        # factor E/k makes the GShard queues drop-free (worst-case load is
+        # one queue slot per token per expert), matching HF's capacity-less
+        # routing exactly at eval
+        moe_experts=moe_experts,
+        moe_k=moe_k,
+        moe_capacity_factor=(float(moe_experts) / moe_k if moe_experts
+                             else 1.25),
+        moe_aux_weight=float(getattr(config, "router_aux_loss_coef", 0.01)),
         **rope_kwargs,
     )
     params, g = _llama_family_params(sd, prefix, L, qkv_bias=qkv_bias,
                                      o_bias=o_bias, mlp_bias=mlp_bias,
-                                     qk_norm=qk_norm)
+                                     qk_norm=qk_norm,
+                                     moe_experts=moe_experts)
     if not tie:
         if "lm_head.weight" not in sd:
             # fail loudly like every other CausalLM loader — fabricating a
@@ -947,6 +981,15 @@ def load_hf_qwen3(model_or_state_dict, config=None):
                                  use_sliding_window="layer_types")
 
 
+def load_hf_mixtral(model_or_state_dict, config=None):
+    """Mixtral (policy 16): the Mistral block family with the dense SwiGLU
+    MLP replaced by num_local_experts SwiGLU experts behind a
+    top-(num_experts_per_tok) router (HF block_sparse_moe gate + w1/w3/w2
+    experts -> moe/layer.MoE with GatedExpertMLP)."""
+    return _load_hf_llama_family(model_or_state_dict, config,
+                                 use_sliding_window=True, moe=True)
+
+
 HF_POLICIES = {
     "llama": load_hf_llama,
     "LlamaForCausalLM": load_hf_llama,
@@ -956,6 +999,8 @@ HF_POLICIES = {
     "Qwen2ForCausalLM": load_hf_qwen2,
     "qwen3": load_hf_qwen3,
     "Qwen3ForCausalLM": load_hf_qwen3,
+    "mixtral": load_hf_mixtral,
+    "MixtralForCausalLM": load_hf_mixtral,
     "gptneo": load_hf_gpt_neo,
     "GPTNeoForCausalLM": load_hf_gpt_neo,
     "gptj": load_hf_gptj,

@@ -340,6 +340,8 @@ class PipelinedTransformer:
             # lead swapped from None to 'pipe'
             r"blocks/.*experts/fc/kernel": block("expert", None, "model"),
             r"blocks/.*experts/fc/bias": block("expert", "model"),
+            r"blocks/.*experts/gate/kernel": block("expert", None, "model"),
+            r"blocks/.*experts/gate/bias": block("expert", "model"),
             r"blocks/.*experts/proj/kernel": block("expert", "model", None),
             r"blocks/.*experts/proj/bias": block("expert", None),
             r"blocks/.*moe/gate/kernel": block(),

@@ -141,12 +141,10 @@ class TransformerConfig:
     moe_aux_weight: float = 0.01
 
     def __post_init__(self):
-        if self.gated_mlp and self.moe_experts > 0:
-            # the MoE ExpertMLP is the 2-matmul form; silently ignoring
-            # gated_mlp would also inflate the 6N FLOPs accounting 1.5x
-            raise NotImplementedError(
-                "gated_mlp (SwiGLU) + moe_experts is not implemented: MoE "
-                "experts use the 2-matmul MLP")
+        # gated_mlp + moe_experts is the Mixtral family: SwiGLU experts
+        # (moe/layer.GatedExpertMLP); the 3-matmul count flows through
+        # _mlp_params so the 6N accounting stays honest
+        pass
 
     @property
     def head_dim(self) -> int:
@@ -280,8 +278,14 @@ class TransformerConfig:
             r"wte/embedding": P("model", None),
             r"lm_head/kernel": P(None, "model"),
             # MoE expert stacks: [.., E, in, out] — expert axis + row/col TP
+            # (gate = the SwiGLU expert's column-parallel gate projection,
+            # Mixtral family; the ROUTER at moe/gate is deliberately
+            # unmatched — _Gate pins it replicated)
             prefix + r".*experts/fc/kernel": block(("expert", None, "model")),
             prefix + r".*experts/fc/bias": block(("expert", "model")),
+            prefix + r".*experts/gate/kernel": block(("expert", None,
+                                                      "model")),
+            prefix + r".*experts/gate/bias": block(("expert", "model")),
             prefix + r".*experts/proj/kernel": block(("expert", "model", None)),
             prefix + r".*experts/proj/bias": block(("expert", None)),
         }
@@ -603,13 +607,21 @@ class Block(nn.Module):
 
         def mlp(h):
             if cfg.moe_experts > 0:
-                from ..moe.layer import ExpertMLP, MoE
+                from ..moe.layer import ExpertMLP, GatedExpertMLP, MoE
+                if cfg.gated_mlp:
+                    # Mixtral family: SwiGLU experts
+                    make_expert = lambda: GatedExpertMLP(
+                        H, cfg.mlp_dim, dtype=cfg.dtype,
+                        use_bias=cfg.use_bias, activation=cfg.activation,
+                        name="experts")
+                else:
+                    make_expert = lambda: ExpertMLP(
+                        H, cfg.mlp_dim, dtype=cfg.dtype,
+                        use_bias=cfg.use_bias, name="experts")
                 return MoE(
                     hidden_size=H,
                     num_experts=cfg.moe_experts,
-                    expert=lambda: ExpertMLP(H, cfg.mlp_dim, dtype=cfg.dtype,
-                                             use_bias=cfg.use_bias,
-                                             name="experts"),
+                    expert=make_expert,
                     k=cfg.moe_k,
                     capacity_factor=cfg.moe_capacity_factor,
                     eval_capacity_factor=cfg.moe_capacity_factor,

@@ -94,6 +94,28 @@ class ExpertMLP(nn.Module):
                         name="proj")(h)
 
 
+class GatedExpertMLP(nn.Module):
+    """SwiGLU expert (Mixtral-family: HF MixtralBlockSparseTop2MLP w1/w3/w2):
+    proj(act(gate(x)) * fc(x)) — the 3-matmul gated MLP as an expert body.
+    Param names mirror the dense block's mlp_gate/mlp_fc/mlp_proj roles."""
+    hidden_size: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    use_bias: bool = False
+    activation: str = "silu"
+
+    @nn.compact
+    def __call__(self, x):
+        from ..models.transformer import _ACTIVATIONS
+        act = _ACTIVATIONS[self.activation]
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=self.use_bias, dtype=self.dtype,
+            param_dtype=jnp.float32, name=name)
+        g = act(dense(self.mlp_dim, "gate")(x))
+        h = g * dense(self.mlp_dim, "fc")(x)
+        return dense(self.hidden_size, "proj")(h)
+
+
 class MoE(nn.Module):
     """Mixture-of-experts block: gate + dispatch + expert-parallel compute.
 

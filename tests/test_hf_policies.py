@@ -744,6 +744,46 @@ def test_hf_qwen3_parity_qk_norm_and_head_dim():
     np.testing.assert_allclose(oursw, refw, rtol=4e-3, atol=4e-3)
 
 
+def test_hf_mixtral_parity_and_greedy():
+    """Mixtral (policy 16): Mistral attention + SwiGLU EXPERTS behind a
+    top-2 router (HF block_sparse_moe gate/w1/w3/w2 -> moe.experts
+    gate/fc/proj). Logits parity and token-exact greedy decode vs HF —
+    the capacity factor E/k makes the GShard queues drop-free, so the
+    routing matches HF's capacity-less top-2 exactly at eval."""
+    import dataclasses
+    from deepspeed_tpu.models.generation import generate
+    torch.manual_seed(21)
+    hf = transformers.MixtralForCausalLM(transformers.MixtralConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=56,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64)).eval()
+    ids = np.random.default_rng(21).integers(0, 96, (2, 20))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    params, cfg = load_hf(hf)
+    assert cfg.moe_experts == 4 and cfg.moe_k == 2 and cfg.gated_mlp
+    assert cfg.moe_capacity_factor == 2.0          # E/k -> drop-free
+    # [L, E, H, I] expert-stacked SwiGLU kernels
+    assert params["blocks"]["moe"]["experts"]["gate"]["kernel"].shape == \
+        (2, 4, 32, 56)
+    model = Transformer(dataclasses.replace(cfg, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    ours, aux = model.apply({"params": params},
+                            {"input_ids": jnp.asarray(ids)})
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=4e-3, atol=4e-3)
+    assert np.isfinite(float(aux))
+    # token-exact greedy through the KV-cache decode path (_moe_mlp)
+    pids = np.random.default_rng(22).integers(0, 96, (2, 10))
+    with torch.no_grad():
+        gref = hf.generate(torch.tensor(pids), max_new_tokens=8,
+                           do_sample=False).numpy()
+    gcfg = dataclasses.replace(cfg, dtype=jnp.float32,
+                               attention_impl="reference")
+    np.testing.assert_array_equal(
+        np.asarray(generate(gcfg, params, jnp.asarray(pids), 8)), gref)
+
+
 def test_hf_llama_mlp_bias_parity():
     """mlp_bias=True: biased gate/up/down projections map and match HF.
     Biases forced NONZERO first (fresh HF zero-inits them — a loader that
@@ -791,19 +831,22 @@ def test_hf_gptneox_nonstandard_rotary_base_parity():
     np.testing.assert_allclose(ours, ref, rtol=4e-3, atol=4e-3)
 
 
-def test_llama_untied_without_head_rejected_and_gated_moe_rejected():
-    """Fail-loud guards: a bare decoder state dict (no lm_head.weight,
-    untied) must not fabricate a tied head; gated_mlp + MoE is an
-    unimplemented combination and must not silently train the 2-matmul
-    experts while counting 3 in the FLOPs model."""
+def test_llama_untied_without_head_rejected_and_gated_moe_params():
+    """Fail-loud guard: a bare decoder state dict (no lm_head.weight,
+    untied) must not fabricate a tied head. gated_mlp + MoE (the Mixtral
+    family, supported since round 5) must count the 3-matmul experts in
+    the FLOPs model."""
     hf = _llama_tiny(num_hidden_layers=1)
     sd = {k: v for k, v in hf.state_dict().items() if k != "lm_head.weight"}
     with pytest.raises(KeyError, match="lm_head.weight"):
         load_hf(sd, arch="llama", config=hf.config)
 
     from deepspeed_tpu.models.transformer import get_config
-    with pytest.raises(NotImplementedError, match="gated_mlp"):
-        get_config("gpt2-tiny", gated_mlp=True, moe_experts=4)
+    gated = get_config("gpt2-tiny", gated_mlp=True, moe_experts=4)
+    plain = get_config("gpt2-tiny", gated_mlp=False, moe_experts=4)
+    per_layer_mlp = 4 * gated.mlp_dim * gated.hidden_size
+    assert gated.num_params() - plain.num_params() == \
+        gated.num_layers * per_layer_mlp
 
 
 def test_hf_qwen2_parity_nonzero_biases():
