@@ -199,6 +199,8 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
 
     wte = params["wte"]["embedding"]
     x = wte.astype(cfg.dtype)[input_ids]
+    if cfg.embed_scale is not None:
+        x = x * jnp.asarray(cfg.embed_scale, x.dtype)
     q_abs = pos + jnp.arange(T_new)                 # cache-slot positions [T]
     pad = cache.get("pad")                          # [B] left-pad lengths
     # logical positions (rotary / learned-wpe / HF position_ids semantics):

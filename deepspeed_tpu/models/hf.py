@@ -760,7 +760,8 @@ def _to_f32(params):
 
 # policy registry (reference: replace_policy.py replace_policies list)
 def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False,
-                         mlp_bias=False, qk_norm=False, moe_experts=0):
+                         mlp_bias=False, qk_norm=False, moe_experts=0,
+                         norm_plus_one=False):
     """Shared Llama/Mistral/Qwen2/Qwen3/Mixtral block mapping: RMSNorm +
     GQA qkv + SwiGLU (dense, or ``moe_experts`` SwiGLU experts behind a
     router — HF block_sparse_moe w1/w3/w2 -> our moe.experts
@@ -769,6 +770,13 @@ def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False,
     biases gate/up/down; qk_norm adds Qwen3's per-head q/k RMSNorm)."""
     g = lambda n: _np(sd[prefix + n])
     stack = _stacker(g, L)
+    # Gemma stores RMSNorm weights as w with the forward computing
+    # x * (1 + w); folding the +1 into the stored scale makes the standard
+    # scale-multiply RMSNorm bit-equivalent — the fold happens in f32
+    # (like HF's `1.0 + weight.float()`), not the checkpoint's storage
+    # dtype, so fp16/bf16 state dicts don't round (1+w) prematurely
+    ln_w = ((lambda a: np.asarray(a, np.float32) + 1.0) if norm_plus_one
+            else (lambda a: a))
 
     def qkv(i):
         ws = [g(f"layers.{i}.self_attn.{p}_proj.weight").T
@@ -787,12 +795,12 @@ def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False,
 
     blocks = {
         "ln1": {"scale": stack(
-            lambda i: g(f"layers.{i}.input_layernorm.weight"))},
+            lambda i: ln_w(g(f"layers.{i}.input_layernorm.weight")))},
         "attn_qkv": ({"kernel": stack(qkv), "bias": stack(qkv_b)}
                      if qkv_bias else {"kernel": stack(qkv)}),
         "attn_proj": proj("self_attn.o_proj", o_bias),
         "ln2": {"scale": stack(
-            lambda i: g(f"layers.{i}.post_attention_layernorm.weight"))},
+            lambda i: ln_w(g(f"layers.{i}.post_attention_layernorm.weight")))},
     }
     if moe_experts > 0:
         E = moe_experts
@@ -824,13 +832,15 @@ def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False,
     params = {
         "wte": {"embedding": g("embed_tokens.weight")},
         "blocks": blocks,
-        "ln_f": {"scale": g("norm.weight")},
+        "ln_f": {"scale": ln_w(g("norm.weight"))},
     }
     return params, g
 
 
 def _load_hf_llama_family(model_or_state_dict, config,
-                          use_sliding_window=False, moe=False):
+                          use_sliding_window=False, moe=False,
+                          activation="silu", embed_scale=None,
+                          norm_plus_one=False):
     sd, config = _sd_and_config(model_or_state_dict, config)
     prefix = _prefix(sd, "model.")
     L = config.num_hidden_layers
@@ -908,7 +918,8 @@ def _load_hf_llama_family(model_or_state_dict, config,
         mlp_dim_override=config.intermediate_size,
         norm="rmsnorm",
         gated_mlp=True,
-        activation="silu",
+        activation=activation,
+        embed_scale=embed_scale,
         pos_embed="rotary",
         rotary_interleaved=False,           # HF rotate_half layout
         rope_theta=float(getattr(config, "rope_theta", 10000.0)),
@@ -937,7 +948,8 @@ def _load_hf_llama_family(model_or_state_dict, config,
     params, g = _llama_family_params(sd, prefix, L, qkv_bias=qkv_bias,
                                      o_bias=o_bias, mlp_bias=mlp_bias,
                                      qk_norm=qk_norm,
-                                     moe_experts=moe_experts)
+                                     moe_experts=moe_experts,
+                                     norm_plus_one=norm_plus_one)
     if not tie:
         if "lm_head.weight" not in sd:
             # fail loudly like every other CausalLM loader — fabricating a
@@ -981,6 +993,87 @@ def load_hf_qwen3(model_or_state_dict, config=None):
                                  use_sliding_window="layer_types")
 
 
+def load_hf_phi(model_or_state_dict, config=None):
+    """Phi-1/1.5/2 (policy 18, HF PhiForCausalLM): GPT-J-style parallel
+    residual with a SINGLE shared LayerNorm feeding both branches
+    (PhiDecoderLayer.forward: attn(ln(x)) + mlp(ln(x)) + x), partial
+    rotate_half rotary over partial_rotary_factor * head_dim channels,
+    biased q/k/v/dense and fc1/fc2, and a biased untied lm_head."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    prefix = _prefix(sd, "model.")
+    L = config.num_hidden_layers
+    if getattr(config, "qk_layernorm", False):
+        raise NotImplementedError(
+            "PhiConfig.qk_layernorm=True (per-head q/k LayerNorm with "
+            "biases) is not supported; loading without it would silently "
+            "change every attention score")
+    g = lambda n: _np(sd[prefix + n])
+    stack = _stacker(g, L)
+    qkv, qkv_b = _concat_qkv_linear(
+        g, "layers.{i}.self_attn.{p}_proj.weight")
+    nh = config.num_attention_heads
+    kv = getattr(config, "num_key_value_heads", None) or nh
+    hd = config.hidden_size // nh
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_position_embeddings,
+        hidden_size=config.hidden_size,
+        num_layers=L,
+        num_heads=nh,
+        num_kv_heads=kv,
+        mlp_dim_override=config.intermediate_size,
+        activation="gelu",                  # HF gelu_new = tanh approx
+        pos_embed="rotary",
+        rotary_dim=int(config.partial_rotary_factor * hd),
+        rotary_interleaved=False,           # rotate_half
+        rope_theta=float(getattr(config, "rope_theta", 10000.0)),
+        parallel_residual=True,             # shared ln1 feeds both branches
+        use_bias=True,
+        tie_embeddings=False,
+        lm_head_bias=True,
+        layer_norm_eps=float(config.layer_norm_eps),
+        scan_layers=True,
+    )
+    blocks = {
+        "ln1": {"scale": stack(
+            lambda i: g(f"layers.{i}.input_layernorm.weight")),
+            "bias": stack(
+            lambda i: g(f"layers.{i}.input_layernorm.bias"))},
+        "attn_qkv": {"kernel": stack(qkv), "bias": stack(qkv_b)},
+        "attn_proj": {"kernel": stack(
+            lambda i: g(f"layers.{i}.self_attn.dense.weight").T),
+            "bias": stack(lambda i: g(f"layers.{i}.self_attn.dense.bias"))},
+        "mlp_fc": {"kernel": stack(
+            lambda i: g(f"layers.{i}.mlp.fc1.weight").T),
+            "bias": stack(lambda i: g(f"layers.{i}.mlp.fc1.bias"))},
+        "mlp_proj": {"kernel": stack(
+            lambda i: g(f"layers.{i}.mlp.fc2.weight").T),
+            "bias": stack(lambda i: g(f"layers.{i}.mlp.fc2.bias"))},
+    }
+    params = {
+        "wte": {"embedding": g("embed_tokens.weight")},
+        "blocks": blocks,
+        "ln_f": {"scale": g("final_layernorm.weight"),
+                 "bias": g("final_layernorm.bias")},
+        "lm_head": {"kernel": _np(sd["lm_head.weight"]).T,
+                    "bias": _np(sd["lm_head.bias"])},
+    }
+    return _to_f32(params), cfg
+
+
+def load_hf_gemma(model_or_state_dict, config=None):
+    """Gemma (policy 17): the Llama block family with three deltas —
+    RMSNorm weights stored as w with forward x*(1+w) (folded into the
+    scale at load), token embeddings scaled by sqrt(hidden_size) in the
+    compute dtype, and a tanh-GELU gated MLP. head_dim is decoupled
+    (256 at 7B) and embeddings are always tied."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    return _load_hf_llama_family(
+        sd, config, activation="gelu",
+        embed_scale=float(config.hidden_size) ** 0.5,
+        norm_plus_one=True)
+
+
 def load_hf_mixtral(model_or_state_dict, config=None):
     """Mixtral (policy 16): the Mistral block family with the dense SwiGLU
     MLP replaced by num_local_experts SwiGLU experts behind a
@@ -1001,6 +1094,10 @@ HF_POLICIES = {
     "Qwen3ForCausalLM": load_hf_qwen3,
     "mixtral": load_hf_mixtral,
     "MixtralForCausalLM": load_hf_mixtral,
+    "gemma": load_hf_gemma,
+    "GemmaForCausalLM": load_hf_gemma,
+    "phi": load_hf_phi,
+    "PhiForCausalLM": load_hf_phi,
     "gptneo": load_hf_gpt_neo,
     "GPTNeoForCausalLM": load_hf_gpt_neo,
     "gptj": load_hf_gptj,

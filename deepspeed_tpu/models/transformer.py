@@ -131,6 +131,10 @@ class TransformerConfig:
     mlp_bias: Optional[bool] = None
     # Qwen3: per-head RMSNorm on q and k (over head_dim) before rotary
     qk_norm: bool = False
+    # Gemma: token embeddings scaled by sqrt(hidden_size), applied in the
+    # COMPUTE dtype (HF casts the normalizer to the hidden dtype, so bf16
+    # runs see the same rounding)
+    embed_scale: Optional[float] = None
     # explicit MLP width when it is not ratio*H (Llama: 11008 at H=4096)
     mlp_dim_override: Optional[int] = None
     # MoE (reference: deepspeed/moe/*): >0 replaces every block's MLP with a
@@ -684,6 +688,8 @@ class Transformer(nn.Module):
         if position_ids is None:
             position_ids = jnp.arange(S)[None, :]
         x = wte(input_ids)
+        if cfg.embed_scale is not None:
+            x = x * jnp.asarray(cfg.embed_scale, cfg.dtype)
         if cfg.pos_embed == "learned":
             wpe = nn.Embed(cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
                            param_dtype=jnp.float32, name="wpe")

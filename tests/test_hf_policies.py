@@ -784,6 +784,89 @@ def test_hf_mixtral_parity_and_greedy():
         np.asarray(generate(gcfg, params, jnp.asarray(pids), 8)), gref)
 
 
+def test_hf_gemma_parity_and_greedy():
+    """Gemma (policy 17): (1+w) RMSNorm scales folded at load, sqrt(H)
+    embedding scaling in the compute dtype, tanh-GELU gated MLP, decoupled
+    head_dim, tied embeddings. Norm scales are forced away from 0 first
+    (fresh HF zero-inits w, making 1+w == 1 — a loader that dropped the
+    +1 fold would still pass random-init parity). Logits parity and
+    token-exact greedy decode vs HF."""
+    import dataclasses
+    from deepspeed_tpu.models.generation import generate
+    torch.manual_seed(31)
+    hf = transformers.GemmaForCausalLM(transformers.GemmaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=56,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64)).eval()
+    with torch.no_grad():
+        for layer in hf.model.layers:
+            layer.input_layernorm.weight.normal_(std=0.3)
+            layer.post_attention_layernorm.weight.normal_(std=0.3)
+        hf.model.norm.weight.normal_(std=0.3)
+    ids = np.random.default_rng(31).integers(0, 96, (2, 20))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    params, cfg = load_hf(hf)
+    assert cfg.embed_scale == float(32) ** 0.5
+    assert cfg.activation == "gelu" and cfg.head_dim == 16
+    assert cfg.tie_embeddings
+    # the +1 fold really happened (HF stores w ~ N(0, 0.3); ours = 1 + w)
+    assert abs(float(np.mean(params["ln_f"]["scale"])) - 1.0) < 0.5
+    model = Transformer(dataclasses.replace(cfg, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    ours = np.asarray(model.apply({"params": params},
+                                  {"input_ids": jnp.asarray(ids)}))
+    np.testing.assert_allclose(ours, ref, rtol=4e-3, atol=4e-3)
+    pids = np.random.default_rng(32).integers(0, 96, (2, 10))
+    with torch.no_grad():
+        gref = hf.generate(torch.tensor(pids), max_new_tokens=8,
+                           do_sample=False).numpy()
+    gcfg = dataclasses.replace(cfg, dtype=jnp.float32,
+                               attention_impl="reference")
+    np.testing.assert_array_equal(
+        np.asarray(generate(gcfg, params, jnp.asarray(pids), 8)), gref)
+
+
+def test_hf_phi_parity_and_greedy():
+    """Phi (policy 18): parallel residual with a single shared LayerNorm,
+    partial rotate_half rotary (0.5 * head_dim), biased projections and
+    biased untied lm_head. Logits parity and token-exact greedy decode vs
+    HF; qk_layernorm configs are refused loudly."""
+    import dataclasses
+    from deepspeed_tpu.models.generation import generate
+    torch.manual_seed(41)
+    hf = transformers.PhiForCausalLM(transformers.PhiConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64)).eval()
+    ids = np.random.default_rng(41).integers(0, 96, (2, 20))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    params, cfg = load_hf(hf)
+    assert cfg.parallel_residual and not cfg.parallel_residual_dual_ln
+    assert cfg.rotary_dim == 4 and not cfg.rotary_interleaved
+    assert cfg.lm_head_bias and not cfg.tie_embeddings
+    model = Transformer(dataclasses.replace(cfg, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    ours = np.asarray(model.apply({"params": params},
+                                  {"input_ids": jnp.asarray(ids)}))
+    np.testing.assert_allclose(ours, ref, rtol=4e-3, atol=4e-3)
+    pids = np.random.default_rng(42).integers(0, 96, (2, 10))
+    with torch.no_grad():
+        gref = hf.generate(torch.tensor(pids), max_new_tokens=8,
+                           do_sample=False).numpy()
+    gcfg = dataclasses.replace(cfg, dtype=jnp.float32,
+                               attention_impl="reference")
+    np.testing.assert_array_equal(
+        np.asarray(generate(gcfg, params, jnp.asarray(pids), 8)), gref)
+    with pytest.raises(NotImplementedError, match="qk_layernorm"):
+        torch.manual_seed(42)
+        load_hf(transformers.PhiForCausalLM(transformers.PhiConfig(
+            vocab_size=96, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=4,
+            qk_layernorm=True)))
+
+
 def test_hf_llama_mlp_bias_parity():
     """mlp_bias=True: biased gate/up/down projections map and match HF.
     Biases forced NONZERO first (fresh HF zero-inits them — a loader that
