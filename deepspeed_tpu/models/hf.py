@@ -993,6 +993,61 @@ def load_hf_qwen3(model_or_state_dict, config=None):
                                  use_sliding_window="layer_types")
 
 
+def load_hf_gpt_bigcode(model_or_state_dict, config=None):
+    """GPT-BigCode / StarCoder (policy 19, HF GPTBigCodeForCausalLM): the
+    GPT-2 block family with MULTI-QUERY attention — one shared k/v head.
+    HF's fused c_attn is [H + 2*head_dim, H] with q first, then the single
+    k and v head: exactly our GQA qkv kernel layout at num_kv_heads=1, so
+    the kernel maps with only a transpose (nn.Linear, not GPT-2's Conv1D).
+    tanh-GELU MLP, learned positions, tied embeddings."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    prefix = _prefix(sd, "transformer.")
+    g = lambda n: _np(sd[prefix + n])
+    L = config.n_layer
+    if not getattr(config, "multi_query", True):
+        raise NotImplementedError(
+            "GPTBigCode with multi_query=False stores c_attn in the "
+            "interleaved per-head MHA layout; only the multi-query form "
+            "(StarCoder) is supported")
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.n_positions,
+        hidden_size=config.n_embd,
+        num_layers=L,
+        num_heads=config.n_head,
+        num_kv_heads=1,                       # MQA
+        mlp_dim_override=config.n_inner or 4 * config.n_embd,
+        activation="gelu",                    # gelu_pytorch_tanh
+        tie_embeddings=True,
+        scan_layers=True,
+        layer_norm_eps=float(config.layer_norm_epsilon),
+    )
+    _stk = _stacker(g, L)
+    stack = lambda name, t=True: _stk(
+        lambda i: g(f"h.{i}.{name}").T if t else g(f"h.{i}.{name}"))
+    blocks = {
+        "ln1": {"scale": stack("ln_1.weight", t=False),
+                "bias": stack("ln_1.bias", t=False)},
+        "attn_qkv": {"kernel": stack("attn.c_attn.weight"),
+                     "bias": stack("attn.c_attn.bias", t=False)},
+        "attn_proj": {"kernel": stack("attn.c_proj.weight"),
+                      "bias": stack("attn.c_proj.bias", t=False)},
+        "ln2": {"scale": stack("ln_2.weight", t=False),
+                "bias": stack("ln_2.bias", t=False)},
+        "mlp_fc": {"kernel": stack("mlp.c_fc.weight"),
+                   "bias": stack("mlp.c_fc.bias", t=False)},
+        "mlp_proj": {"kernel": stack("mlp.c_proj.weight"),
+                     "bias": stack("mlp.c_proj.bias", t=False)},
+    }
+    params = {
+        "wte": {"embedding": g("wte.weight")},
+        "wpe": {"embedding": g("wpe.weight")},
+        "blocks": blocks,
+        "ln_f": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+    }
+    return _to_f32(params), cfg
+
+
 def load_hf_phi(model_or_state_dict, config=None):
     """Phi-1/1.5/2 (policy 18, HF PhiForCausalLM): GPT-J-style parallel
     residual with a SINGLE shared LayerNorm feeding both branches
@@ -1098,6 +1153,8 @@ HF_POLICIES = {
     "GemmaForCausalLM": load_hf_gemma,
     "phi": load_hf_phi,
     "PhiForCausalLM": load_hf_phi,
+    "gpt_bigcode": load_hf_gpt_bigcode,
+    "GPTBigCodeForCausalLM": load_hf_gpt_bigcode,
     "gptneo": load_hf_gpt_neo,
     "GPTNeoForCausalLM": load_hf_gpt_neo,
     "gptj": load_hf_gptj,

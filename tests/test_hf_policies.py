@@ -867,6 +867,45 @@ def test_hf_phi_parity_and_greedy():
             qk_layernorm=True)))
 
 
+def test_hf_gpt_bigcode_mqa_parity_and_greedy():
+    """GPT-BigCode / StarCoder (policy 19): multi-query attention — the
+    fused c_attn [H + 2*head_dim, H] maps onto our GQA qkv kernel at
+    num_kv_heads=1. Logits parity and token-exact greedy decode vs HF;
+    the MHA (multi_query=False) layout is refused loudly."""
+    import dataclasses
+    from deepspeed_tpu.models.generation import generate
+    torch.manual_seed(51)
+    hf = transformers.GPTBigCodeForCausalLM(transformers.GPTBigCodeConfig(
+        vocab_size=96, n_embd=32, n_head=4, n_layer=2, n_positions=64,
+        n_inner=64)).eval()
+    ids = np.random.default_rng(51).integers(0, 96, (2, 20))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    params, cfg = load_hf(hf)
+    assert cfg.num_kv_heads == 1 and cfg.head_dim == 8
+    # [L, H, (nh + 2) * hd] = [2, 32, 48]
+    assert params["blocks"]["attn_qkv"]["kernel"].shape == (2, 32, 48)
+    model = Transformer(dataclasses.replace(cfg, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    ours = np.asarray(model.apply({"params": params},
+                                  {"input_ids": jnp.asarray(ids)}))
+    np.testing.assert_allclose(ours, ref, rtol=4e-3, atol=4e-3)
+    pids = np.random.default_rng(52).integers(0, 96, (2, 10))
+    with torch.no_grad():
+        gref = hf.generate(torch.tensor(pids), max_new_tokens=8,
+                           do_sample=False).numpy()
+    gcfg = dataclasses.replace(cfg, dtype=jnp.float32,
+                               attention_impl="reference")
+    np.testing.assert_array_equal(
+        np.asarray(generate(gcfg, params, jnp.asarray(pids), 8)), gref)
+    with pytest.raises(NotImplementedError, match="multi_query"):
+        torch.manual_seed(52)
+        load_hf(transformers.GPTBigCodeForCausalLM(
+            transformers.GPTBigCodeConfig(
+                vocab_size=96, n_embd=32, n_head=4, n_layer=1,
+                n_positions=64, multi_query=False)))
+
+
 def test_hf_llama_mlp_bias_parity():
     """mlp_bias=True: biased gate/up/down projections map and match HF.
     Biases forced NONZERO first (fresh HF zero-inits them — a loader that
