@@ -1017,7 +1017,11 @@ def load_hf_gpt_bigcode(model_or_state_dict, config=None):
         num_heads=config.n_head,
         num_kv_heads=1,                       # MQA
         mlp_dim_override=config.n_inner or 4 * config.n_embd,
-        activation="gelu",                    # gelu_pytorch_tanh
+        # strict mapping like the NeoX/BERT loaders: unknown activations
+        # fail at load, and HF "gelu" (exact erf) is NOT our tanh "gelu"
+        activation={"gelu_pytorch_tanh": "gelu", "gelu_new": "gelu",
+                    "gelu": "gelu_exact", "relu": "relu"}[
+            getattr(config, "activation_function", "gelu_pytorch_tanh")],
         tie_embeddings=True,
         scan_layers=True,
         layer_norm_eps=float(config.layer_norm_epsilon),

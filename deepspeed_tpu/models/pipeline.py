@@ -60,6 +60,23 @@ class PipelinedTransformer:
         if backward not in ("recompute", "store"):
             raise ValueError(f"backward must be recompute|store, "
                              f"got {backward!r}")
+        if cfg.layer_windows is not None:
+            # the stage body calls blocks without the per-layer window arg;
+            # silently running a Mistral-class model with GLOBAL attention
+            # would be a wrong answer, not a degraded one
+            raise NotImplementedError(
+                "pipelined model does not thread per-layer sliding "
+                "windows (layer_windows); run windowed models on the "
+                "non-pipelined engine")
+        for knob in ("embed_ln", "token_type_vocab", "mlm_head",
+                     "no_lm_head"):
+            # same fail-loud contract: the pipelined embed/head plumbing
+            # implements none of these, and running without them (BLOOM's
+            # ln_emb, BERT segments/MLM head) silently changes the math
+            if getattr(cfg, knob):
+                raise NotImplementedError(
+                    f"pipelined model does not support {knob}; run this "
+                    "architecture on the non-pipelined engine")
         self.cfg = cfg
         self.pp = pp
         self.n_micro = n_micro
@@ -70,8 +87,9 @@ class PipelinedTransformer:
             cfg if cfg.scan_layers else
             TransformerConfig(**{**cfg.__dict__, "scan_layers": True}))
         self._block = Block(cfg)
-        self._ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
-                                  param_dtype=jnp.float32, name="ln_f")
+        norm_cls = nn.RMSNorm if cfg.norm == "rmsnorm" else nn.LayerNorm
+        self._ln_f = norm_cls(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                              param_dtype=jnp.float32, name="ln_f")
 
     # -- engine model contract -----------------------------------------------
 
@@ -100,6 +118,47 @@ class PipelinedTransformer:
                 lambda i: jax.random.fold_in(rng, i))(
                     jnp.arange(self.n_micro))
         return extras
+
+    def _embed_micros(self, embed_inputs, ids_micros, S):
+        """[n_micro, mb, S] ids -> embedded activations. ``embed_inputs``
+        holds the raw embedding tables ({"wte": [V,H]} plus "wpe" for
+        learned positions) so the 1F1B path can jax.vjp through this
+        directly. Rotary/ALiBi positions need nothing here — the blocks
+        apply them internally from default arange positions."""
+        cfg = self.cfg
+        e = embed_inputs["wte"].astype(cfg.dtype)[ids_micros]
+        if cfg.embed_scale is not None:
+            e = e * jnp.asarray(cfg.embed_scale, cfg.dtype)
+        if cfg.pos_embed == "learned":
+            e = e + embed_inputs["wpe"].astype(cfg.dtype)[
+                jnp.arange(S)][None, None]
+        return e
+
+    def _embed_inputs(self, params):
+        out = {"wte": params["wte"]["embedding"]}
+        if self.cfg.pos_embed == "learned":
+            out["wpe"] = params["wpe"]["embedding"]
+        return out
+
+    def _head_logits(self, head_p, h):
+        """Final-norm'd hidden states -> logits; tied einsum against wte or
+        the untied (optionally biased) lm_head kernel."""
+        if self.cfg.tie_embeddings:
+            wte = head_p["wte"].astype(h.dtype)
+            return jnp.einsum("...sh,vh->...sv", h, wte)
+        k = head_p["lm_head"]["kernel"].astype(h.dtype)
+        logits = jnp.einsum("...sh,hv->...sv", h, k)
+        if "bias" in head_p["lm_head"]:
+            logits = logits + head_p["lm_head"]["bias"].astype(h.dtype)
+        return logits
+
+    def _head_params(self, params):
+        head = {"ln_f": params["ln_f"]}
+        if self.cfg.tie_embeddings:
+            head["wte"] = params["wte"]["embedding"]
+        else:
+            head["lm_head"] = params["lm_head"]
+        return head
 
     def _block_stage_fn(self, train):
         """stage_fn(block_stack, h, extra, stage) for both executors."""
@@ -156,15 +215,12 @@ class PipelinedTransformer:
         else:
             base_rng = rngs
 
-        wte = params["wte"]["embedding"]            # [V, H] fp32
-        wpe = params["wpe"]["embedding"]            # [T, H]
         # reshape the INTEGER ids to microbatches first: ids carry no
         # cotangent, so the data-axis reshard of the [B]->[n_micro, mb] split
         # never transposes into a low-precision collective (XLA SPMD miscompiles
         # bf16 resharding copies on some backends)
         ids_micros = input_ids.reshape(self.n_micro, B // self.n_micro, S)
-        micros = (wte.astype(cfg.dtype)[ids_micros] +
-                  wpe.astype(cfg.dtype)[jnp.arange(S)][None, None, :])
+        micros = self._embed_micros(self._embed_inputs(params), ids_micros, S)
         # pin the microbatched layout: micro dim replicated, the PER-MICRO
         # batch dim carries the (data, expert) sharding. Left to inference
         # the partitioner may split the micro dim instead (seen on the
@@ -187,8 +243,8 @@ class PipelinedTransformer:
         # head runs per-micro; only the fp32 logits are reshaped back to the
         # flat batch (fp32 resharding avoids the bf16 SPMD copy bug above)
         h = self._ln_f.apply({"params": params["ln_f"]}, outs)
-        logits = jnp.einsum("nbsh,vh->nbsv", h,
-                            wte.astype(cfg.dtype)).astype(jnp.float32)
+        logits = self._head_logits(self._head_params(params),
+                                   h).astype(jnp.float32)
         logits = logits.reshape((B, S, cfg.vocab_size))
         logits = _spec_constraint(logits, P(("data", "expert"), None, None))
         if moe:
@@ -230,18 +286,15 @@ class PipelinedTransformer:
         ids_micros = input_ids.reshape(self.n_micro, mb, S)
         lab_micros = labels.reshape(self.n_micro, mb, S)
 
-        def embed(wte, wpe):
-            return (wte.astype(cfg.dtype)[ids_micros] +
-                    wpe.astype(cfg.dtype)[jnp.arange(S)][None, None])
-
-        micros, embed_vjp = jax.vjp(embed, params["wte"]["embedding"],
-                                    params["wpe"]["embedding"])
+        micros, embed_vjp = jax.vjp(
+            lambda ep: self._embed_micros(ep, ids_micros, S),
+            self._embed_inputs(params))
         stage_params = stack_stage_params(params["blocks"], self.pp)
         extras = self._micro_extras(attention_mask, rng, train, B, S)
         stage_fn = self._block_stage_fn(train)
         moe = cfg.moe_experts > 0
 
-        head = {"ln_f": params["ln_f"], "wte": params["wte"]["embedding"]}
+        head = self._head_params(params)
 
         if loss_fn is None:
             # default causal-LM objective with GLOBAL token mean: the
@@ -255,8 +308,7 @@ class PipelinedTransformer:
 
             def head_loss(head_p, y, lab):
                 h = self._ln_f.apply({"params": head_p["ln_f"]}, y)
-                logits = jnp.einsum("bsh,vh->bsv", h,
-                                    head_p["wte"].astype(h.dtype))
+                logits = self._head_logits(head_p, h)
                 logits = logits[:, :-1].astype(jnp.float32)
                 tgt = lab[:, 1:]
                 valid = tgt != -100
@@ -288,9 +340,7 @@ class PipelinedTransformer:
 
             def head_loss(head_p, y, lab):
                 h = self._ln_f.apply({"params": head_p["ln_f"]}, y)
-                logits = jnp.einsum("bsh,vh->bsv", h,
-                                    head_p["wte"].astype(h.dtype))
-                out = logits.astype(jnp.float32)
+                out = self._head_logits(head_p, h).astype(jnp.float32)
                 return loss_fn(out, lab).astype(jnp.float32)
 
             head_labels = micro_batches
@@ -305,13 +355,19 @@ class PipelinedTransformer:
             aux_cotangent=(aux_w if moe else 0.0),
             loss_scale=loss_scale,
             store_outputs=(self.backward == "store"))
-        dwte_embed, dwpe = embed_vjp(dmicros)
+        (dembed,) = embed_vjp(dmicros)
+        dwte = dembed["wte"]
+        if cfg.tie_embeddings:
+            dwte = dwte + gh["wte"]           # head grad rides the tie
         grads = {
-            "wte": {"embedding": dwte_embed + gh["wte"]},
-            "wpe": {"embedding": dwpe},
+            "wte": {"embedding": dwte},
             "blocks": unstack_stage_params(gs),
             "ln_f": gh["ln_f"],
         }
+        if cfg.pos_embed == "learned":
+            grads["wpe"] = {"embedding": dembed["wpe"]}
+        if not cfg.tie_embeddings:
+            grads["lm_head"] = gh["lm_head"]
         if moe:
             # reported loss matches make_moe_loss: task + aux_weight * aux
             loss = loss + aux_w * aux

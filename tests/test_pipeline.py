@@ -609,3 +609,66 @@ def test_1f1b_moe_requires_marked_loss():
     losses = [float(engine.train_batch(
         _mk_batch(rng, cfg2.vocab_size, 16, 32))["loss"]) for _ in range(4)]
     assert losses[-1] < losses[0], losses
+
+
+def test_pipelined_llama_family_gpipe_and_1f1b():
+    require_devices(2)
+    """Modern-decoder (Llama/Gemma-class) models under BOTH pipeline
+    schedules: rotary positions (no wpe), RMSNorm final norm, untied
+    lm_head, embed_scale, GQA. Round 5: the pipelined embed/head plumbing
+    previously hardcoded learned positions and a tied head. gpipe logits
+    must match the dense Transformer; 1F1B must descend; windowed models
+    are refused loudly."""
+    kw = dict(hidden_size=64, num_layers=4, num_heads=4, num_kv_heads=2,
+              vocab_size=256, max_seq_len=64, norm="rmsnorm",
+              gated_mlp=True, activation="silu", use_bias=False,
+              pos_embed="rotary", rotary_interleaved=False,
+              tie_embeddings=False, embed_scale=8.0,
+              dtype=jnp.float32, attention_impl="reference")
+    plain, cfg = build_model("gpt2-tiny", **kw)
+    rng = np.random.default_rng(7)
+    batch = _mk_batch(rng, cfg.vocab_size, 32, 32)   # dp=4 x micro 2 x gas 4
+    config = {
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "pipeline": {"stages": 2},
+    }
+    piped, _ = build_pipelined_model(cfg, pp=2, n_micro=4)
+    engine, *_ = ds.initialize(model=piped, config=config,
+                               loss_fn=causal_lm_loss, example_batch=batch,
+                               rng=jax.random.PRNGKey(9),
+                               sharding_rules=piped.tp_rules())
+    params = jax.device_get(engine.state.params)
+    assert "wpe" not in params and "lm_head" in params
+    logits_pipe = engine.eval_batch(batch)
+    logits_plain = plain.apply({"params": params}, batch)
+    np.testing.assert_allclose(np.asarray(logits_pipe),
+                               np.asarray(logits_plain),
+                               rtol=2e-4, atol=2e-4)
+    # 1F1B: same model through the hand-scheduled executor, loss descends
+    # and the untied-head/embedding grads flow (step must change both)
+    f_cfg = dict(config)
+    f_cfg["pipeline"] = {"stages": 2, "schedule": "1f1b"}
+    feng, *_ = ds.initialize(model=build_pipelined_model(
+                                 cfg, pp=2, n_micro=4)[0],
+                             config=f_cfg, loss_fn=causal_lm_loss,
+                             example_batch=batch,
+                             rng=jax.random.PRNGKey(9))
+    head0 = np.asarray(feng.state.params["lm_head"]["kernel"])
+    wte0 = np.asarray(feng.state.params["wte"]["embedding"])
+    losses = [float(feng.train_batch(
+        _mk_batch(rng, cfg.vocab_size, 32, 32))["loss"]) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+    assert not np.allclose(
+        head0, np.asarray(feng.state.params["lm_head"]["kernel"]))
+    assert not np.allclose(
+        wte0, np.asarray(feng.state.params["wte"]["embedding"]))
+
+    with pytest.raises(NotImplementedError, match="sliding"):
+        build_pipelined_model(
+            "gpt2-tiny", pp=2, n_micro=2, hidden_size=64, num_layers=2,
+            num_heads=4, vocab_size=256, max_seq_len=64,
+            layer_windows=(8, 8))
