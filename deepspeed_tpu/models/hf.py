@@ -993,6 +993,116 @@ def load_hf_qwen3(model_or_state_dict, config=None):
                                  use_sliding_window="layer_types")
 
 
+def load_hf_falcon(model_or_state_dict, config=None):
+    """Falcon (policy 20, HF FalconForCausalLM), two supported variants:
+
+    * 7B-style (multi_query, parallel_attn, single input_layernorm):
+      GPT-J-style parallel residual with a shared LN, MQA (kv=1), fused
+      query_key_value already in q|k|v order.
+    * 40B-style (new_decoder_architecture): parallel residual with SEPARATE
+      ln_attn/ln_mlp (our parallel_residual_dual_ln), GQA, and the fused
+      qkv interleaved PER KV GROUP ([q_g0.., k0, v0, q_g1.., k1, v1]) —
+      de-interleaved here into the q|k|v kernel layout.
+
+    Both: rotate_half rotary, exact-erf GELU MLP, no biases except the
+    layernorms, tied embeddings. Legacy falcon-rw variants (alibi or
+    sequential blocks) are refused loudly."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    prefix = _prefix(sd, "transformer.")
+    g = lambda n: _np(sd[prefix + n])
+    L = config.num_hidden_layers
+    nh = config.num_attention_heads
+    H = config.hidden_size
+    hd = H // nh
+    new_arch = bool(getattr(config, "new_decoder_architecture", False))
+    if getattr(config, "alibi", False) or not (
+            new_arch or getattr(config, "parallel_attn", False)):
+        raise NotImplementedError(
+            "only the rotary parallel-attention Falcon variants are "
+            "supported (7B-style multi_query/parallel_attn or 40B-style "
+            "new_decoder_architecture); alibi / sequential falcon-rw "
+            "checkpoints would load with the wrong block math")
+    if new_arch:
+        kv = int(config.num_kv_heads)
+    elif getattr(config, "multi_query", True):
+        kv = 1
+    else:
+        raise NotImplementedError(
+            "Falcon multi_query=False (per-head-interleaved MHA qkv) is "
+            "not supported")
+    if getattr(config, "rope_scaling", None):
+        raise NotImplementedError(
+            f"Falcon rope_scaling={config.rope_scaling} is not wired into "
+            "this policy; loading with plain rope_theta would produce "
+            "wrong frequencies")
+    if prefix + "h.0.self_attention.query_key_value.bias" in sd:
+        raise NotImplementedError(
+            "Falcon config.bias=True checkpoints (biased linears) are not "
+            "supported; silently dropping the biases would change every "
+            "projection")
+    # Falcon2-11B: new_decoder_architecture with ONE shared layernorm
+    # (num_ln_in_parallel_attn=1) — presence-driven, like the bias flags
+    dual_ln = new_arch and prefix + "h.0.ln_attn.weight" in sd
+
+    def qkv(i):
+        w = g(f"h.{i}.self_attention.query_key_value.weight")
+        if new_arch:
+            # [(kv, nh/kv + 2, hd), H] groups -> contiguous q | k | v
+            w = w.reshape(kv, nh // kv + 2, hd, H)
+            q = w[:, :-2].reshape(nh * hd, H)
+            k = w[:, -2].reshape(kv * hd, H)
+            v = w[:, -1].reshape(kv * hd, H)
+            w = np.concatenate([q, k, v], axis=0)
+        return w.T                                  # [H, (nh + 2*kv) * hd]
+
+    cfg = TransformerConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=getattr(config, "max_position_embeddings", 2048),
+        hidden_size=H,
+        num_layers=L,
+        num_heads=nh,
+        num_kv_heads=kv,
+        mlp_dim_override=int(getattr(config, "ffn_hidden_size", None)
+                             or 4 * H),
+        # strict map (HF get_activation(config.activation); "gelu" = erf):
+        # unknown activations fail at load, not in apply
+        activation={"gelu": "gelu_exact", "gelu_pytorch_tanh": "gelu",
+                    "gelu_new": "gelu", "relu": "relu"}[
+            getattr(config, "activation", "gelu")],
+        pos_embed="rotary",
+        rotary_interleaved=False,                   # rotate_half
+        rope_theta=float(getattr(config, "rope_theta", 10000.0)),
+        parallel_residual=True,
+        parallel_residual_dual_ln=dual_ln,
+        use_bias=False,
+        tie_embeddings=True,
+        layer_norm_eps=float(config.layer_norm_epsilon),
+        scan_layers=True,
+    )
+    stack = _stacker(g, L)
+    ln1 = "ln_attn" if dual_ln else "input_layernorm"
+    blocks = {
+        "ln1": {"scale": stack(lambda i: g(f"h.{i}.{ln1}.weight")),
+                "bias": stack(lambda i: g(f"h.{i}.{ln1}.bias"))},
+        "attn_qkv": {"kernel": stack(qkv)},
+        "attn_proj": {"kernel": stack(
+            lambda i: g(f"h.{i}.self_attention.dense.weight").T)},
+        "mlp_fc": {"kernel": stack(
+            lambda i: g(f"h.{i}.mlp.dense_h_to_4h.weight").T)},
+        "mlp_proj": {"kernel": stack(
+            lambda i: g(f"h.{i}.mlp.dense_4h_to_h.weight").T)},
+    }
+    if dual_ln:
+        blocks["ln2"] = {"scale": stack(lambda i: g(f"h.{i}.ln_mlp.weight")),
+                         "bias": stack(lambda i: g(f"h.{i}.ln_mlp.bias"))}
+    params = {
+        "wte": {"embedding": g("word_embeddings.weight")},
+        "blocks": blocks,
+        "ln_f": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+    }
+    return _to_f32(params), cfg
+
+
 def load_hf_gpt_bigcode(model_or_state_dict, config=None):
     """GPT-BigCode / StarCoder (policy 19, HF GPTBigCodeForCausalLM): the
     GPT-2 block family with MULTI-QUERY attention — one shared k/v head.
@@ -1159,6 +1269,8 @@ HF_POLICIES = {
     "PhiForCausalLM": load_hf_phi,
     "gpt_bigcode": load_hf_gpt_bigcode,
     "GPTBigCodeForCausalLM": load_hf_gpt_bigcode,
+    "falcon": load_hf_falcon,
+    "FalconForCausalLM": load_hf_falcon,
     "gptneo": load_hf_gpt_neo,
     "GPTNeoForCausalLM": load_hf_gpt_neo,
     "gptj": load_hf_gptj,

@@ -906,6 +906,71 @@ def test_hf_gpt_bigcode_mqa_parity_and_greedy():
                 n_positions=64, multi_query=False)))
 
 
+def test_hf_falcon_parity_and_greedy():
+    """Falcon (policy 20), both supported variants. 7B-style: shared-LN
+    parallel residual + MQA. 40B-style: dual-LN parallel residual + GQA
+    with the per-kv-group interleaved fused qkv de-interleaved at load.
+    Logits parity and token-exact greedy decode vs HF each; legacy
+    alibi/sequential falcon-rw configs are refused loudly."""
+    import dataclasses
+    from deepspeed_tpu.models.generation import generate
+
+    def check(hfcfg, seed, kv_expect):
+        torch.manual_seed(seed)
+        hf = transformers.FalconForCausalLM(hfcfg).eval()
+        ids = np.random.default_rng(seed).integers(0, 96, (2, 20))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        params, cfg = load_hf(hf)
+        assert cfg.kv_heads == kv_expect and cfg.parallel_residual
+        model = Transformer(dataclasses.replace(
+            cfg, dtype=jnp.float32, attention_impl="reference"))
+        ours = np.asarray(model.apply({"params": params},
+                                      {"input_ids": jnp.asarray(ids)}))
+        np.testing.assert_allclose(ours, ref, rtol=4e-3, atol=4e-3)
+        pids = np.random.default_rng(seed + 1).integers(0, 96, (2, 10))
+        with torch.no_grad():
+            gref = hf.generate(torch.tensor(pids), max_new_tokens=8,
+                               do_sample=False).numpy()
+        gcfg = dataclasses.replace(cfg, dtype=jnp.float32,
+                                   attention_impl="reference")
+        np.testing.assert_array_equal(
+            np.asarray(generate(gcfg, params, jnp.asarray(pids), 8)), gref)
+        return cfg
+
+    cfg7 = check(transformers.FalconConfig(
+        vocab_size=96, hidden_size=32, num_attention_heads=4,
+        num_hidden_layers=2, new_decoder_architecture=False,
+        multi_query=True, parallel_attn=True, bias=False), 61, 1)
+    assert not cfg7.parallel_residual_dual_ln
+    cfg40 = check(transformers.FalconConfig(
+        vocab_size=96, hidden_size=32, num_attention_heads=4,
+        num_hidden_layers=2, new_decoder_architecture=True,
+        num_kv_heads=2), 63, 2)
+    assert cfg40.parallel_residual_dual_ln
+    # Falcon2-11B style: new_decoder_architecture with ONE shared LN
+    # (num_ln_in_parallel_attn=1) — detected from the state dict
+    cfg11 = check(transformers.FalconConfig(
+        vocab_size=96, hidden_size=32, num_attention_heads=4,
+        num_hidden_layers=2, new_decoder_architecture=True,
+        num_kv_heads=2, num_ln_in_parallel_attn=1,
+        parallel_attn=True), 67, 2)
+    assert not cfg11.parallel_residual_dual_ln
+
+    with pytest.raises(NotImplementedError, match="alibi"):
+        torch.manual_seed(65)
+        load_hf(transformers.FalconForCausalLM(transformers.FalconConfig(
+            vocab_size=96, hidden_size=32, num_attention_heads=4,
+            num_hidden_layers=1, new_decoder_architecture=False,
+            multi_query=False, parallel_attn=False, alibi=True)))
+    with pytest.raises(NotImplementedError, match="bias"):
+        torch.manual_seed(66)
+        load_hf(transformers.FalconForCausalLM(transformers.FalconConfig(
+            vocab_size=96, hidden_size=32, num_attention_heads=4,
+            num_hidden_layers=1, new_decoder_architecture=False,
+            multi_query=True, parallel_attn=True, bias=True)))
+
+
 def test_hf_llama_mlp_bias_parity():
     """mlp_bias=True: biased gate/up/down projections map and match HF.
     Biases forced NONZERO first (fresh HF zero-inits them — a loader that
