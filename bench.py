@@ -15,8 +15,8 @@ see BASELINE.md). vs_baseline = our model TFLOPs/chip / 50.
 Tuned configs (measured on v5e, rounds 2-5 — sweeps in scripts/perf_sweep.py
 and the round-5 gas-amortization sweep in docs/BENCHMARKS.md): every leg
 carries a fixed ~0.33 s/step optimizer+sync overhead, so raising gradient
-accumulation amortizes it — gas 16 -> 64 lifted the 1.3b north-star from
-~104 to ~111 TF/chip (60.6% MFU incl. attention). seq-2048 additionally
+accumulation amortizes it — gas 16 -> 128 lifted the 1.3b north-star from
+~104 to ~113 TF/chip (62.0% MFU incl. attention). seq-2048 additionally
 switched to "full" remat, which frees enough HBM for micro 2 (the round-4
 micro-1 shape was the real ceiling there: 84.5 -> ~93 TF).
 """
@@ -52,8 +52,8 @@ def main():
         # round-5 gas settings. Config rationale: docs/BENCHMARKS.md
         # round-5 sweep (fixed ~0.33 s/step overhead amortized by gas;
         # "full" remat frees HBM for micro 2 at seq 2048).
-        r13 = run_training_bench("gpt2-1.3b", seq=1024, micro=2, gas=64,
-                                 steps=8, zero_stage=3, remat=True,
+        r13 = run_training_bench("gpt2-1.3b", seq=1024, micro=2, gas=128,
+                                 steps=5, zero_stage=3, remat=True,
                                  remat_policy="dots", fused_loss=True,
                                  pure_bf16=True, grad_accum_dtype="bf16",
                                  verbose=False)
@@ -71,8 +71,8 @@ def main():
         jax.clear_caches()
         # modern-decoder leg (round 4): TinyLlama-1.1B shapes — RMSNorm,
         # SwiGLU, GQA 32q/4kv, rotary, untied head (docs/BENCHMARKS.md)
-        rll = run_training_bench("llama-1.1b", seq=1024, micro=2, gas=32,
-                                 steps=8, zero_stage=3, remat=True,
+        rll = run_training_bench("llama-1.1b", seq=1024, micro=2, gas=64,
+                                 steps=6, zero_stage=3, remat=True,
                                  remat_policy="dots", fused_loss=True,
                                  pure_bf16=True, grad_accum_dtype="bf16",
                                  verbose=False)
