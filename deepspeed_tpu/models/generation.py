@@ -250,7 +250,8 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
     use_kernel = ((cfg.attention_impl == "flash"
                    or (cfg.attention_impl == "auto" and prefer_kernel))
                   and jax.default_backend() == "tpu" and ali is None
-                  and pad is None and not quant_kv)
+                  and pad is None and not quant_kv
+                  and not cfg.attn_softcap)   # no softcap kernel path
 
     def layer(carry, xs):
         # the FULL [L, ...] caches ride in the carry so the per-token write
@@ -329,6 +330,9 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
                 v_cache = (v_cache.astype(jnp.float32) * v_sc).astype(q.dtype)
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache).astype(jnp.float32)
             s = s * sm_scale
+            if cfg.attn_softcap:
+                from ..ops.attention import apply_softcap
+                s = apply_softcap(s, cfg.attn_softcap)
             if ali is not None:
                 s = s + ali[None]
             m = mask
@@ -343,6 +347,10 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
             o = jnp.einsum("bhqk,bhkd->bhqd", prob, v_cache)
         o = o.transpose(0, 2, 1, 3).reshape(B, T_new, nh * hd)
         attn_out = _dense(o, p["attn_proj"])
+        if cfg.post_block_norms:
+            # Gemma-2 sandwich: norm each branch output pre-residual
+            attn_out = _layer_norm(attn_out, p["post_attn_norm"],
+                                   cfg.layer_norm_eps, rms)
 
         def mlp(hin):
             if cfg.moe_experts > 0:
@@ -360,7 +368,11 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
         else:
             x_mid = x + attn_out
             h2 = _layer_norm(x_mid, p["ln2"], cfg.layer_norm_eps, rms)
-            x_out = x_mid + mlp(h2)
+            m = mlp(h2)
+            if cfg.post_block_norms:
+                m = _layer_norm(m, p["post_mlp_norm"],
+                                cfg.layer_norm_eps, rms)
+            x_out = x_mid + m
         if quant_kv:
             return (x_out, k_all, v_all, ks_all, vs_all), None
         return (x_out, k_all, v_all), None
@@ -378,6 +390,11 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
         logits = jnp.einsum("bth,vh->btv", x, wte.astype(x.dtype))
     else:
         logits = _dense(x, params["lm_head"])
+    if cfg.final_logit_softcap:
+        # stay f32: the return below casts to f32 anyway, and a bf16
+        # round-trip of the capped logits could flip near-tie argmaxes
+        from ..ops.attention import apply_softcap
+        logits = apply_softcap(logits, cfg.final_logit_softcap)
     new_cache = {"k": k_new, "v": v_new, "pos": pos + T_new}
     if quant_kv:
         new_cache["k_scale"] = ks_new

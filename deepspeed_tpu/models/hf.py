@@ -761,7 +761,7 @@ def _to_f32(params):
 # policy registry (reference: replace_policy.py replace_policies list)
 def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False,
                          mlp_bias=False, qk_norm=False, moe_experts=0,
-                         norm_plus_one=False):
+                         norm_plus_one=False, sandwich_norms=False):
     """Shared Llama/Mistral/Qwen2/Qwen3/Mixtral block mapping: RMSNorm +
     GQA qkv + SwiGLU (dense, or ``moe_experts`` SwiGLU experts behind a
     router — HF block_sparse_moe w1/w3/w2 -> our moe.experts
@@ -793,6 +793,11 @@ def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False,
             p["bias"] = stack(lambda i: g(f"layers.{i}.{hf}.bias"))
         return p
 
+    # Gemma-2 sandwich layout: post_attention_layernorm is the POST-attn
+    # branch norm and pre_feedforward_layernorm takes the pre-MLP (ln2)
+    # slot; everyone else's post_attention_layernorm IS the pre-MLP norm
+    ln2_src = ("pre_feedforward_layernorm" if sandwich_norms
+               else "post_attention_layernorm")
     blocks = {
         "ln1": {"scale": stack(
             lambda i: ln_w(g(f"layers.{i}.input_layernorm.weight")))},
@@ -800,8 +805,13 @@ def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False,
                      if qkv_bias else {"kernel": stack(qkv)}),
         "attn_proj": proj("self_attn.o_proj", o_bias),
         "ln2": {"scale": stack(
-            lambda i: ln_w(g(f"layers.{i}.post_attention_layernorm.weight")))},
+            lambda i: ln_w(g(f"layers.{i}.{ln2_src}.weight")))},
     }
+    if sandwich_norms:
+        for ours, hfn in (("post_attn_norm", "post_attention_layernorm"),
+                          ("post_mlp_norm", "post_feedforward_layernorm")):
+            blocks[ours] = {"scale": stack(
+                lambda i, n=hfn: ln_w(g(f"layers.{i}.{n}.weight")))}
     if moe_experts > 0:
         E = moe_experts
 
@@ -840,7 +850,7 @@ def _llama_family_params(sd, prefix, L, qkv_bias=False, o_bias=False,
 def _load_hf_llama_family(model_or_state_dict, config,
                           use_sliding_window=False, moe=False,
                           activation="silu", embed_scale=None,
-                          norm_plus_one=False):
+                          norm_plus_one=False, gemma2=False):
     sd, config = _sd_and_config(model_or_state_dict, config)
     prefix = _prefix(sd, "model.")
     L = config.num_hidden_layers
@@ -943,13 +953,24 @@ def _load_hf_llama_family(model_or_state_dict, config,
         moe_capacity_factor=(float(moe_experts) / moe_k if moe_experts
                              else 1.25),
         moe_aux_weight=float(getattr(config, "router_aux_loss_coef", 0.01)),
+        # Gemma-2: sandwich norms, tanh softcapping on attention scores and
+        # final logits, and the query_pre_attn_scalar attention scale
+        post_block_norms=gemma2,
+        attn_softcap=(float(getattr(config, "attn_logit_softcapping", 0)
+                            or 0) if gemma2 else 0.0),
+        final_logit_softcap=(float(getattr(config,
+                                           "final_logit_softcapping", 0)
+                                   or 0) if gemma2 else 0.0),
+        attn_scale=(float(config.query_pre_attn_scalar) ** -0.5
+                    if gemma2 else None),
         **rope_kwargs,
     )
     params, g = _llama_family_params(sd, prefix, L, qkv_bias=qkv_bias,
                                      o_bias=o_bias, mlp_bias=mlp_bias,
                                      qk_norm=qk_norm,
                                      moe_experts=moe_experts,
-                                     norm_plus_one=norm_plus_one)
+                                     norm_plus_one=norm_plus_one,
+                                     sandwich_norms=gemma2)
     if not tie:
         if "lm_head.weight" not in sd:
             # fail loudly like every other CausalLM loader — fabricating a
@@ -1243,6 +1264,19 @@ def load_hf_gemma(model_or_state_dict, config=None):
         norm_plus_one=True)
 
 
+def load_hf_gemma2(model_or_state_dict, config=None):
+    """Gemma-2 (policy 21): Gemma's deltas plus sandwich norms (each branch
+    output normed again before its residual), tanh softcapping on attention
+    scores (routes attention to the exact reference impl) and final logits,
+    query_pre_attn_scalar attention scaling, and alternating
+    sliding/full-attention layers via config.layer_types."""
+    sd, config = _sd_and_config(model_or_state_dict, config)
+    return _load_hf_llama_family(
+        sd, config, use_sliding_window="layer_types", activation="gelu",
+        embed_scale=float(config.hidden_size) ** 0.5,
+        norm_plus_one=True, gemma2=True)
+
+
 def load_hf_mixtral(model_or_state_dict, config=None):
     """Mixtral (policy 16): the Mistral block family with the dense SwiGLU
     MLP replaced by num_local_experts SwiGLU experts behind a
@@ -1265,6 +1299,8 @@ HF_POLICIES = {
     "MixtralForCausalLM": load_hf_mixtral,
     "gemma": load_hf_gemma,
     "GemmaForCausalLM": load_hf_gemma,
+    "gemma2": load_hf_gemma2,
+    "Gemma2ForCausalLM": load_hf_gemma2,
     "phi": load_hf_phi,
     "PhiForCausalLM": load_hf_phi,
     "gpt_bigcode": load_hf_gpt_bigcode,

@@ -135,6 +135,15 @@ class TransformerConfig:
     # COMPUTE dtype (HF casts the normalizer to the hidden dtype, so bf16
     # runs see the same rounding)
     embed_scale: Optional[float] = None
+    # Gemma-2 "sandwich" norms: each branch output is normed AGAIN before
+    # its residual add (post_attn_norm / post_mlp_norm; the pre-MLP norm
+    # keeps the ln2 slot)
+    post_block_norms: bool = False
+    # Gemma-2 logit softcapping: tanh(x/cap)*cap on attention scores
+    # (routes attention to the exact reference impl — no kernel path) and
+    # on the final LM logits; 0 = off
+    attn_softcap: float = 0.0
+    final_logit_softcap: float = 0.0
     # explicit MLP width when it is not ratio*H (Llama: 11008 at H=4096)
     mlp_dim_override: Optional[int] = None
     # MoE (reference: deepspeed/moe/*): >0 replaces every block's MLP with a
@@ -148,7 +157,12 @@ class TransformerConfig:
         # gated_mlp + moe_experts is the Mixtral family: SwiGLU experts
         # (moe/layer.GatedExpertMLP); the 3-matmul count flows through
         # _mlp_params so the 6N accounting stays honest
-        pass
+        if self.post_block_norms and self.parallel_residual:
+            # the parallel-residual paths return before the sandwich
+            # norms; silently skipping them would diverge train vs decode
+            raise NotImplementedError(
+                "post_block_norms (Gemma-2 sandwich) + parallel_residual "
+                "is not implemented")
 
     @property
     def head_dim(self) -> int:
@@ -596,7 +610,7 @@ class Block(nn.Module):
                         sm_scale=cfg.attn_scale,
                         dropout_rate=cfg.dropout if train else 0.0,
                         dropout_rng=drop_rng, impl=cfg.attention_impl,
-                        window=win)
+                        window=win, softcap=cfg.attn_softcap)
         # tag so the "dots" remat policy keeps it: the Pallas kernel output is
         # not a dot_general, and recomputing flash fwd in bwd costs ~2ms/layer
         from jax.ad_checkpoint import checkpoint_name
@@ -658,8 +672,13 @@ class Block(nn.Module):
                 m = nn.Dropout(cfg.dropout)(m, deterministic=False)
             return _batch_constraint(ln("ln2")(x + m)), aux
 
+        if cfg.post_block_norms:
+            # Gemma-2 sandwich: norm each branch OUTPUT before its residual
+            out = ln("post_attn_norm")(out)
         x = _batch_constraint(x + out)
         m, aux = mlp(ln("ln2")(x))
+        if cfg.post_block_norms:
+            m = ln("post_mlp_norm")(m)
         if cfg.dropout > 0.0 and train:
             m = nn.Dropout(cfg.dropout)(m, deterministic=False)
         return _batch_constraint(x + m), aux
@@ -850,6 +869,11 @@ class Transformer(nn.Module):
             # encoder use (CLIP text): final hidden states are the output
             return x.astype(jnp.float32)
         if cfg.fused_loss:
+            if cfg.final_logit_softcap:
+                raise ValueError(
+                    "fused_loss with final_logit_softcap is not supported "
+                    "(the chunked CE has no softcap term); disable "
+                    "fused_loss for Gemma-2-class models")
             if cfg.tie_embeddings:
                 emb = wte.embedding
             else:
@@ -878,6 +902,9 @@ class Transformer(nn.Module):
                               dtype=cfg.dtype,
                               param_dtype=jnp.float32, name="lm_head")(x)
         logits = logits.astype(jnp.float32)
+        if cfg.final_logit_softcap:
+            from ..ops.attention import apply_softcap
+            logits = apply_softcap(logits, cfg.final_logit_softcap)
         if cfg.moe_experts > 0:
             return logits, aux_total
         return logits

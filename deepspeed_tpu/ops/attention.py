@@ -28,6 +28,13 @@ def causal_mask(q_len: int, k_len: int) -> jnp.ndarray:
     return k_pos <= q_pos + offset
 
 
+def apply_softcap(x, cap: float):
+    """Gemma-2 logit softcapping: tanh(x / cap) * cap, computed in f32.
+    Single definition — used for attention scores (here and the decode
+    path) and final LM logits (transformer head, decode head)."""
+    return (jnp.tanh(x.astype(jnp.float32) / cap) * cap)
+
+
 def mha_reference(q: jnp.ndarray,
                   k: jnp.ndarray,
                   v: jnp.ndarray,
@@ -37,7 +44,8 @@ def mha_reference(q: jnp.ndarray,
                   mask: Optional[jnp.ndarray] = None,
                   sm_scale: Optional[float] = None,
                   dropout_rate: float = 0.0,
-                  dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
+                  dropout_rng: Optional[jax.Array] = None,
+                  softcap: float = 0.0) -> jnp.ndarray:
     """Multi-head attention, jnp reference. q,k,v: [batch, heads, seq, head_dim].
 
     The numerics oracle every Pallas kernel is tested against (mirrors the
@@ -49,6 +57,10 @@ def mha_reference(q: jnp.ndarray,
     k_len = k.shape[-2]
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(head_dim)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if softcap:
+        # Gemma-2 attention-logit softcapping, BEFORE mask/softmax (HF
+        # Gemma2Attention eager path); logits are already f32 here
+        logits = apply_softcap(logits, softcap)
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
     neg = jnp.asarray(-1e30, jnp.float32)
@@ -100,7 +112,8 @@ def attention(q: jnp.ndarray,
               impl: str = "auto",
               block_q: int = 1024,
               block_k: int = 1024,
-              window: int = 0) -> jnp.ndarray:
+              window: int = 0,
+              softcap: float = 0.0) -> jnp.ndarray:
     """Dispatching attention entry point. Shapes: [batch, heads, seq, head_dim].
 
     ``window`` > 0 (with causal=True, no mask/bias/dropout) routes to the
@@ -108,7 +121,10 @@ def attention(q: jnp.ndarray,
     python int for the kernel route — model paths that trace it (the
     scanned-layers transformer, whose per-layer window is a scan element)
     compose it into the dense mask instead; windows <= 0 mean global."""
-    needs_reference = bias is not None or mask is not None or dropout_rate > 0.0
+    # softcap has no flash/block-skip kernel path: honor it on the exact
+    # reference impl rather than silently dropping it
+    needs_reference = (bias is not None or mask is not None
+                       or dropout_rate > 0.0 or softcap > 0.0)
     window = 0 if window is None or window <= 0 else window
     if window and causal and not needs_reference and \
             jax.default_backend() == "tpu" and impl in ("auto", "flash"):
@@ -152,4 +168,4 @@ def attention(q: jnp.ndarray,
                                    block_q=block_q, block_k=block_k)
     return mha_reference(q, k, v, causal=causal, bias=bias, mask=mask,
                          sm_scale=sm_scale, dropout_rate=dropout_rate,
-                         dropout_rng=dropout_rng)
+                         dropout_rng=dropout_rng, softcap=softcap)
