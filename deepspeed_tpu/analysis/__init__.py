@@ -1,0 +1,21 @@
+"""graftlint — JAX/TPU-aware static analysis that gates the hot path.
+
+AST-only (never imports the linted code), so a full-package pass is
+CI-cheap. Rules TPU001–TPU007 target the bug classes that silently
+regress the gas-amortized train step: host syncs, retraces, trace-time
+side effects, dtype leaks, missing donation, tracer branches and PRNG
+key reuse. See docs/LINT.md for the catalog and workflow.
+
+Programmatic use::
+
+    from deepspeed_tpu.analysis import lint_paths, RULES
+    findings = lint_paths(["deepspeed_tpu/"])
+"""
+
+from . import rules as _rules  # noqa: F401  (registers TPU001–TPU007)
+from .baseline import Baseline, DEFAULT_BASELINE
+from .cli import main
+from .core import Finding, ModuleInfo, Rule, RULES, Severity, lint_paths
+
+__all__ = ["Baseline", "DEFAULT_BASELINE", "Finding", "ModuleInfo", "Rule",
+           "RULES", "Severity", "lint_paths", "main"]

@@ -1,0 +1,88 @@
+"""Checked-in baseline: accepted findings that don't gate the build.
+
+Every entry carries a one-line ``justification`` — a baseline is a debt
+ledger, not a mute button. Matching is positional-churn-proof: entries
+bind to (rule, path, enclosing symbol, normalized line text), not line
+numbers, so reformatting elsewhere in the file doesn't invalidate them.
+Stale entries (matching nothing anymore) are reported so the ledger
+shrinks as debts are paid.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding
+
+DEFAULT_BASELINE = ".graftlint.json"
+
+
+class Baseline:
+    def __init__(self, entries: Optional[List[dict]] = None,
+                 path: Optional[str] = None):
+        self.path = path
+        self.entries = entries or []
+        self._index: Dict[Tuple[str, str, str, str], dict] = {}
+        for e in self.entries:
+            self._index[self._entry_key(e)] = e
+        self._matched: set = set()
+
+    @staticmethod
+    def _entry_key(e: dict) -> Tuple[str, str, str, str]:
+        return (e.get("rule", ""), e.get("path", ""), e.get("symbol", ""),
+                " ".join(e.get("line_text", "").split()))
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(path=path)
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(entries=data.get("findings", []), path=path)
+
+    def apply(self, findings: List[Finding]) -> None:
+        """Mark findings covered by the baseline (and remember which
+        entries matched, for staleness reporting)."""
+        for f in findings:
+            e = self._index.get(f.key())
+            if e is not None:
+                f.baselined = True
+                f.justification = e.get("justification", "")
+                self._matched.add(self._entry_key(e))
+
+    def stale_entries(self) -> List[dict]:
+        return [e for e in self.entries
+                if self._entry_key(e) not in self._matched]
+
+    @staticmethod
+    def write(path: str, findings: List[Finding]) -> int:
+        """Snapshot the current gating findings as the new baseline.
+        Existing justifications are preserved for entries that survive."""
+        old = Baseline.load(path)
+        kept: List[dict] = []
+        seen = set()
+        for f in findings:
+            if f.suppressed or f.severity.name == "INFO":
+                continue
+            key = f.key()
+            if key in seen:
+                continue
+            seen.add(key)
+            prev = old._index.get(key, {})
+            kept.append({
+                "rule": f.rule,
+                "path": f.path,
+                "symbol": f.symbol,
+                "line_text": f.line_text,
+                "justification": prev.get(
+                    "justification",
+                    "TODO: justify or fix (added by --write-baseline)"),
+            })
+        kept.sort(key=lambda e: (e["path"], e["rule"], e["symbol"]))
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"version": 1, "tool": "graftlint",
+                       "findings": kept}, f, indent=2)
+            f.write("\n")
+        return len(kept)

@@ -1,0 +1,118 @@
+"""graftlint CLI: ``python -m deepspeed_tpu.analysis`` / ``bin/graftlint``.
+
+Exit codes: 0 clean (or fully baselined/suppressed), 1 gating findings,
+2 usage error. The baseline defaults to ``.graftlint.json`` next to the
+linted tree's repo root (first ancestor of the first path that has one),
+so CI and a bare ``bin/graftlint deepspeed_tpu/`` agree on what's
+accepted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import rules as _rules  # noqa: F401  (imports register the rules)
+from .baseline import Baseline, DEFAULT_BASELINE
+from .core import RULES, Severity, lint_paths
+from .reporters import report_json, report_rules, report_text
+
+
+def _find_baseline(paths: List[str]) -> Optional[str]:
+    start = os.path.abspath(paths[0]) if paths else os.getcwd()
+    cur = start if os.path.isdir(start) else os.path.dirname(start)
+    while True:
+        cand = os.path.join(cur, DEFAULT_BASELINE)
+        if os.path.exists(cand):
+            return cand
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def _parse_codes(s: str) -> set:
+    codes = {c.strip().upper() for c in s.split(",") if c.strip()}
+    unknown = codes - set(RULES)
+    if unknown:
+        raise argparse.ArgumentTypeError(
+            f"unknown rule(s): {', '.join(sorted(unknown))} "
+            f"(known: {', '.join(sorted(RULES))})")
+    return codes
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="JAX/TPU-aware static analysis for deepspeed_tpu")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories (default: deepspeed_tpu/)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", metavar="PATH",
+                   help=f"baseline file (default: nearest {DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept current findings into the baseline and exit")
+    p.add_argument("--select", type=_parse_codes, metavar="CODES",
+                   help="run only these rules (comma-separated)")
+    p.add_argument("--ignore", type=_parse_codes, metavar="CODES",
+                   help="skip these rules")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print suppressed/baselined findings")
+    p.add_argument("--strict", action="store_true",
+                   help="INFO findings gate too")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        report_rules()
+        return 0
+
+    paths = args.paths
+    if not paths:
+        default = os.path.join(os.getcwd(), "deepspeed_tpu")
+        paths = [default] if os.path.isdir(default) else ["."]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"graftlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    # finding paths must be relative to the baseline's directory (the repo
+    # root), not the cwd — otherwise running graftlint from elsewhere
+    # breaks every baseline match
+    baseline_path = args.baseline or _find_baseline(paths)
+    root = os.path.dirname(os.path.abspath(baseline_path)) \
+        if baseline_path else os.getcwd()
+    findings = lint_paths(paths, select=args.select, ignore=args.ignore,
+                          root=root)
+
+    if args.write_baseline:
+        target = args.baseline or baseline_path or DEFAULT_BASELINE
+        n = Baseline.write(target, [f for f in findings if f.gating])
+        print(f"graftlint: wrote {n} entries to {target} "
+              "(fill in the justifications)", file=sys.stderr)
+        return 0
+
+    stale: List[dict] = []
+    if baseline_path and not args.no_baseline:
+        bl = Baseline.load(baseline_path)
+        bl.apply(findings)
+        stale = bl.stale_entries()
+
+    if args.format == "json":
+        report_json(findings, stale)
+    else:
+        report_text(findings, stale, show_suppressed=args.show_suppressed)
+
+    gate = [f for f in findings if f.gating]
+    if args.strict:
+        gate += [f for f in findings
+                 if f.severity == Severity.INFO
+                 and not f.suppressed and not f.baselined]
+    return 1 if gate else 0

@@ -1,0 +1,292 @@
+"""graftlint core: findings, rule registry, suppressions, module model.
+
+The linter is AST-based and import-free: it never imports the code it
+checks (no JAX import, no device initialization), so a full-package pass
+is fast enough for CI and pre-commit hooks.
+
+Vocabulary
+----------
+Rule       a check with a stable code (TPU001..), a default severity and a
+           ``check(module)`` generator yielding Findings.
+Finding    one violation at (path, line); carries the enclosing function's
+           qualname and the stripped source line so baselines survive
+           unrelated line-number churn.
+Suppression ``# graftlint: disable=TPU001[,TPU002]`` on the offending line
+           (or ``disable=all``); ``# graftlint: disable-file=...`` anywhere
+           in the file applies file-wide.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import enum
+import hashlib
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    @property
+    def label(self) -> str:
+        return self.name.lower()
+
+    @classmethod
+    def parse(cls, s: str) -> "Severity":
+        return cls[s.upper()]
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    severity: Severity
+    path: str                      # relative, forward slashes
+    line: int
+    col: int
+    message: str
+    symbol: str = "<module>"       # enclosing function qualname
+    line_text: str = ""            # stripped source of the offending line
+    suppressed: bool = False
+    baselined: bool = False
+    justification: str = ""        # from the matching baseline entry
+
+    def key(self) -> Tuple[str, str, str, str]:
+        """Identity used for baseline matching: stable across pure
+        line-number churn (only rule, file, enclosing symbol and the
+        normalized line text participate)."""
+        return (self.rule, self.path, self.symbol,
+                " ".join(self.line_text.split()))
+
+    def fingerprint(self) -> str:
+        return hashlib.sha1("\x1f".join(self.key()).encode()).hexdigest()[:12]
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity.label,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "symbol": self.symbol,
+            "line_text": self.line_text,
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+            "fingerprint": self.fingerprint(),
+        }
+
+    @property
+    def gating(self) -> bool:
+        """Does this finding fail the run? Suppressed/baselined findings and
+        INFO-level notes never gate (INFO can be promoted via --strict)."""
+        return (not self.suppressed and not self.baselined
+                and self.severity >= Severity.WARNING)
+
+
+class Rule:
+    """Base class; subclasses set ``code``/``name``/``severity``/``summary``
+    and implement ``check``. Register with the ``@register`` decorator."""
+
+    code: str = ""
+    name: str = ""
+    severity: Severity = Severity.WARNING
+    summary: str = ""
+
+    def check(self, module: "ModuleInfo") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: "ModuleInfo", node: ast.AST, message: str,
+                severity: Optional[Severity] = None) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=self.code,
+            severity=self.severity if severity is None else severity,
+            path=module.rel_path,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            symbol=module.enclosing_qualname(node),
+            line_text=module.line_text(line),
+        )
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    if not cls.code:
+        raise ValueError(f"rule {cls.__name__} has no code")
+    if cls.code in RULES:
+        raise ValueError(f"duplicate rule code {cls.code}")
+    RULES[cls.code] = cls()
+    return cls
+
+
+# --------------------------------------------------------------- suppressions
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+def parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """Returns (per-line {lineno: {codes}}, file-wide {codes}); the token
+    ``all`` suppresses every rule. A trailing comment suppresses its own
+    line; a standalone comment line suppresses the line below it."""
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        codes = {c.strip().upper() for c in m.group(2).split(",") if c.strip()}
+        if m.group(1) == "disable-file":
+            file_wide |= codes
+        else:
+            target = i + 1 if line.lstrip().startswith("#") else i
+            per_line.setdefault(target, set()).update(codes)
+    return per_line, file_wide
+
+
+# ---------------------------------------------------------------- module model
+
+class ModuleInfo:
+    """One parsed source file plus everything rules need: parent links,
+    qualnames, suppression map, and the jit-scope analysis (attached by the
+    runner to avoid a circular import)."""
+
+    def __init__(self, path: str, source: str, rel_path: str):
+        self.path = path
+        self.rel_path = rel_path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # one pass: parent links, node -> enclosing-function map, and
+        # per-function node lists (rules query all three per node; raw
+        # ast.walk per rule per function was the lint's dominant cost on
+        # a full-package run)
+        self._encl: Dict[ast.AST, Optional[ast.AST]] = {}
+        self.nodes_by_fn: Dict[Optional[ast.AST], List[ast.AST]] = {None: []}
+        self.fn_children: Dict[Optional[ast.AST], List[ast.AST]] = {None: []}
+        self.all_nodes: List[ast.AST] = []
+        self.all_calls: List[ast.Call] = []
+        _FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        stack: List[Tuple[ast.AST, Optional[ast.AST]]] = [(self.tree, None)]
+        while stack:
+            parent, encl = stack.pop()
+            child_encl = parent if isinstance(parent, _FN) else encl
+            for child in ast.iter_child_nodes(parent):
+                child._gl_parent = parent  # type: ignore[attr-defined]
+                self._encl[child] = child_encl
+                self.all_nodes.append(child)
+                if isinstance(child, ast.Call):
+                    self.all_calls.append(child)
+                self.nodes_by_fn.setdefault(child_encl, []).append(child)
+                if isinstance(child, _FN):
+                    self.nodes_by_fn.setdefault(child, [])
+                    self.fn_children.setdefault(child, [])
+                    self.fn_children.setdefault(child_encl, []).append(child)
+                stack.append((child, child_encl))
+        self.line_suppressions, self.file_suppressions = \
+            parse_suppressions(source)
+        from .jitscope import JitScope
+        self.scope = JitScope(self)
+
+    # -- navigation -----------------------------------------------------------
+
+    @staticmethod
+    def parent(node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_gl_parent", None)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._encl.get(node)
+
+    def fn_nodes(self, fn: Optional[ast.AST],
+                 subtree: bool = False) -> Iterator[ast.AST]:
+        """Nodes directly owned by ``fn`` (no nested-function bodies); with
+        ``subtree=True``, nested-function bodies too."""
+        yield from self.nodes_by_fn.get(fn, ())
+        if subtree:
+            # nested def nodes themselves are direct nodes of the parent;
+            # only their bodies need the recursion
+            for child in self.fn_children.get(fn, ()):
+                yield from self.fn_nodes(child, subtree=True)
+
+    def enclosing_qualname(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                parts.append(cur.name)
+            elif isinstance(cur, ast.Lambda):
+                parts.append("<lambda>")
+            elif isinstance(cur, ast.ClassDef):
+                parts.append(cur.name)
+            cur = self.parent(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        if finding.rule in self.file_suppressions or \
+                "ALL" in self.file_suppressions:
+            return True
+        codes = self.line_suppressions.get(finding.line, set())
+        return finding.rule in codes or "ALL" in codes
+
+
+# --------------------------------------------------------------------- runner
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    skip_dirs = {".git", "__pycache__", ".pytest_cache", "node_modules",
+                 "build", "dist", ".eggs"}
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in skip_dirs)
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def lint_paths(paths: Iterable[str],
+               select: Optional[Set[str]] = None,
+               ignore: Optional[Set[str]] = None,
+               root: Optional[str] = None) -> List[Finding]:
+    """Lint every .py under ``paths``. Returns ALL findings — including
+    suppressed ones (marked) so reporters can count them; baseline matching
+    happens in the CLI layer."""
+    root = root or os.getcwd()
+    rules = [r for code, r in sorted(RULES.items())
+             if (select is None or code in select)
+             and (ignore is None or code not in ignore)]
+    findings: List[Finding] = []
+    for fpath in iter_python_files(paths):
+        try:
+            with open(fpath, "r", encoding="utf-8") as f:
+                source = f.read()
+            rel = os.path.relpath(os.path.abspath(fpath), root)
+            module = ModuleInfo(fpath, source, rel)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                rule="GL000", severity=Severity.ERROR,
+                path=fpath.replace(os.sep, "/"),
+                line=getattr(e, "lineno", 1) or 1, col=0,
+                message=f"could not parse: {e.__class__.__name__}: {e}"))
+            continue
+        for rule in rules:
+            for finding in rule.check(module):
+                finding.suppressed = module.is_suppressed(finding)
+                findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

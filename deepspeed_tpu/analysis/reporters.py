@@ -1,0 +1,74 @@
+"""Text and JSON reporters for graftlint findings."""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List
+
+from .core import Finding, RULES, Severity
+
+
+def summarize(findings: List[Finding]) -> dict:
+    gating = [f for f in findings if f.gating]
+    return {
+        "total": len(findings),
+        "gating": len(gating),
+        "errors": sum(1 for f in gating if f.severity == Severity.ERROR),
+        "warnings": sum(1 for f in gating if f.severity == Severity.WARNING),
+        "info": sum(1 for f in findings
+                    if f.severity == Severity.INFO and not f.suppressed),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "baselined": sum(1 for f in findings if f.baselined),
+    }
+
+
+def report_text(findings: List[Finding], stale: List[dict],
+                show_suppressed: bool = False, stream=None) -> None:
+    out = stream or sys.stdout
+    shown = [f for f in findings
+             if show_suppressed or not (f.suppressed or f.baselined)]
+    last_path = None
+    for f in shown:
+        if f.path != last_path:
+            if last_path is not None:
+                print(file=out)
+            print(f.path, file=out)
+            last_path = f.path
+        tag = ""
+        if f.suppressed:
+            tag = " [suppressed]"
+        elif f.baselined:
+            tag = f" [baselined: {f.justification}]"
+        print(f"  {f.line}:{f.col} {f.rule} {f.severity.label}: "
+              f"{f.message} ({f.symbol}){tag}", file=out)
+    s = summarize(findings)
+    if shown:
+        print(file=out)
+    for e in stale:
+        print(f"stale baseline entry: {e['rule']} {e['path']} "
+              f"({e['symbol']}) — fixed? remove it from the baseline",
+              file=out)
+    print(f"graftlint: {s['gating']} gating "
+          f"({s['errors']} error, {s['warnings']} warning), "
+          f"{s['info']} info, {s['baselined']} baselined, "
+          f"{s['suppressed']} suppressed", file=out)
+
+
+def report_json(findings: List[Finding], stale: List[dict],
+                stream=None) -> None:
+    out = stream or sys.stdout
+    json.dump({
+        "version": 1,
+        "summary": summarize(findings),
+        "findings": [f.to_dict() for f in findings],
+        "stale_baseline_entries": stale,
+    }, out, indent=2)
+    out.write("\n")
+
+
+def report_rules(stream=None) -> None:
+    out = stream or sys.stdout
+    for code, rule in sorted(RULES.items()):
+        print(f"{code} [{rule.severity.label}] {rule.name}: {rule.summary}",
+              file=out)

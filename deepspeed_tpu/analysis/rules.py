@@ -1,0 +1,681 @@
+"""graftlint rules TPU001–TPU007.
+
+Each rule targets one class of bug that regresses the gas-amortized train
+step silently: the bench still runs, just slower (host syncs, retraces)
+or subtly wrong (dtype leaks, key reuse). Rules lean on the per-module
+JitScope (see jitscope.py) to know which code runs under a trace and
+which code is the host-side step path.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional, Set
+
+from .core import Finding, ModuleInfo, Rule, Severity, register
+
+# identifiers that smell like device values when they appear inside a
+# float()/int()/bool() pull on the host step path
+_DEVICEISH = re.compile(
+    r"loss|grad|norm|metric|logit|scale|overflow|state|tensor|array", re.I)
+
+_F64_NAMES = {"jax.numpy.float64", "numpy.float64", "jax.numpy.complex128",
+              "numpy.complex128"}
+_F32_NAMES = {"jax.numpy.float32", "numpy.float32"}
+_HALF_NAMES = {"jax.numpy.bfloat16", "jax.numpy.float16",
+               "numpy.float16", "ml_dtypes.bfloat16"}
+
+
+def _qual(module: ModuleInfo, node: ast.AST) -> Optional[str]:
+    return module.scope.imports.qualify(node)
+
+
+def _is_literal(node: ast.AST) -> bool:
+    return isinstance(node, (ast.Constant, ast.JoinedStr)) or (
+        isinstance(node, (ast.Tuple, ast.List))
+        and all(_is_literal(e) for e in node.elts)) or (
+        isinstance(node, ast.UnaryOp) and _is_literal(node.operand))
+
+
+def _mentions_deviceish(module: ModuleInfo, node: ast.AST) -> bool:
+    """Does the expression reference something that plausibly lives on
+    device — a jnp/jax call (other than device_get) or an identifier /
+    string key matching the device-ish vocabulary?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            q = _qual(module, n.func)
+            if q and q.startswith(("jax.numpy.", "jax.lax.")):
+                return True
+            if q and q.startswith("jax.") and not q.endswith("device_get"):
+                return True
+        elif isinstance(n, ast.Name) and _DEVICEISH.search(n.id):
+            return True
+        elif isinstance(n, ast.Attribute) and _DEVICEISH.search(n.attr):
+            return True
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and _DEVICEISH.search(n.value):
+            return True
+    return False
+
+
+def _walk_functions(module: ModuleInfo, traced_only: bool = True):
+    for fn in module.scope._defs:
+        if traced_only and not module.scope.fn_traced(fn):
+            continue
+        yield fn
+
+
+@register
+class HostSyncRule(Rule):
+    """TPU001 — host↔device synchronization in a jitted or step path.
+
+    Inside traced code any host pull (.item(), float(tracer),
+    np.asarray(tracer), device_get, .tolist(), block_until_ready) either
+    fails at trace time on a rarely-exercised branch or, worse, silently
+    constant-folds a value that should be dynamic. On the host step path
+    (train_batch/step/forward/backward or ``# graftlint: hotpath``), an
+    implicit pull stalls async dispatch — the exact overhead gas
+    amortization exists to hide. Explicit ``jax.device_get`` on the step
+    path is the sanctioned idiom (one acknowledged transfer) and is not
+    flagged there.
+    """
+
+    code = "TPU001"
+    name = "host-sync"
+    severity = Severity.ERROR
+    summary = "host-device sync in a jitted/step path"
+
+    _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+    _NP_PULLS = {"numpy.asarray", "numpy.array", "numpy.float32",
+                 "numpy.float64", "numpy.int32", "numpy.int64"}
+    _CASTS = {"float", "int", "bool"}
+
+    @staticmethod
+    def _host_names(module: ModuleInfo, fn) -> Set[str]:
+        """Locals assigned from jax.device_get(...) — already host-side, so
+        casting them is free."""
+        names: Set[str] = set()
+        if fn is None:
+            return names
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) and _qual(
+                    module, node.value.func) == "jax.device_get":
+                for t in node.targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            names.add(leaf.id)
+        return names
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        scope = module.scope
+        host_names_cache = {}
+        for node in module.all_calls:
+            traced = scope.in_traced(node)
+            hot = scope.in_hot(node)
+            if not traced and not hot:
+                continue
+            sev = Severity.ERROR if traced else Severity.WARNING
+            where = "traced code" if traced else "the host step path"
+            f = node.func
+            # .item() / .tolist() / .block_until_ready()
+            if isinstance(f, ast.Attribute) and f.attr in self._SYNC_METHODS \
+                    and not node.args:
+                yield self.finding(
+                    module, node,
+                    f".{f.attr}() forces a device sync in {where}",
+                    severity=sev)
+                continue
+            q = _qual(module, f)
+            # np.asarray / np.array on a non-literal in traced/hot code
+            if q in self._NP_PULLS and node.args \
+                    and not _is_literal(node.args[0]) \
+                    and (traced or _mentions_deviceish(module, node.args[0])):
+                yield self.finding(
+                    module, node,
+                    f"{q}(...) materializes a device value on host in "
+                    f"{where}; keep math in jnp or device_get explicitly",
+                    severity=sev)
+                continue
+            # device_get / block_until_ready inside traced code only
+            if traced and q in ("jax.device_get", "jax.block_until_ready"):
+                yield self.finding(
+                    module, node,
+                    f"{q} inside traced code breaks the trace "
+                    "(move it outside the compiled step)", severity=sev)
+                continue
+            # float()/int()/bool() pulls — device-ish evidence required in
+            # both tiers (casting a closed-over python int under trace is
+            # harmless; casting anything named loss/grad/norm/... is not)
+            if isinstance(f, ast.Name) and f.id in self._CASTS \
+                    and len(node.args) == 1:
+                arg = node.args[0]
+                if _is_literal(arg):
+                    continue
+                if isinstance(arg, ast.Call):
+                    aq = _qual(module, arg.func)
+                    if aq in ("jax.device_get", "len", "float", "int",
+                              "numpy.prod", "math.prod"):
+                        continue
+                encl = module.enclosing_function(node)
+                if encl not in host_names_cache:
+                    host_names_cache[encl] = self._host_names(module, encl)
+                base = arg
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    base = base.value
+                if isinstance(base, ast.Name) and \
+                        base.id in host_names_cache[encl]:
+                    continue
+                if _mentions_deviceish(module, arg):
+                    yield self.finding(
+                        module, node,
+                        f"{f.id}(...) blocks on a device value in {where}; "
+                        "batch scalars into one jax.device_get",
+                        severity=sev)
+
+
+@register
+class RetraceRule(Rule):
+    """TPU002 — retrace risk: jit wrappers rebuilt per call.
+
+    ``jax.jit`` keyed by function object identity: constructing the wrapper
+    inside a loop (or constructing-and-immediately-calling it inside any
+    function) makes every execution a cache miss — a full retrace+compile
+    that shows up as a multi-second stall per step instead of a bench
+    number.
+    """
+
+    code = "TPU002"
+    name = "retrace-risk"
+    severity = Severity.ERROR
+    summary = "jit wrapper constructed per call (retrace risk)"
+
+    def _fresh_object(self, module: ModuleInfo, node: ast.Call) -> bool:
+        """Is the wrapped callable a fresh object on every execution of
+        this line? jit's trace cache is keyed by function identity:
+        module-level defs are stable (measured: 1 trace across repeated
+        ``jax.jit(f)(x)``), while lambdas, bound-method attribute reads
+        and nested closures produce a new object — and a retrace — per
+        pass."""
+        if not node.args:
+            return False
+        arg = node.args[0]
+        if isinstance(arg, (ast.Lambda, ast.Attribute, ast.Call)):
+            return True
+        if isinstance(arg, ast.Name):
+            target = module.scope.resolve_local_def(arg)
+            if target is None:
+                return True     # unresolved (e.g. a function parameter)
+            # nested def => closure rebuilt per call of the enclosing fn
+            return module.enclosing_function(target) is not None
+        return False
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        scope = module.scope
+        for node in module.all_calls:
+            if not scope.is_jit_call(node):
+                continue
+            if not self._fresh_object(module, node):
+                continue
+            # under an outer trace the inner jit is inlined once per outer
+            # trace — not a per-step cost
+            if scope.in_traced(node):
+                continue
+            # (a) jit(<fresh fn>) under a loop
+            cur = module.parent(node)
+            in_loop = False
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+                    in_loop = True
+                    break
+                cur = module.parent(cur)
+            if in_loop:
+                yield self.finding(
+                    module, node,
+                    "jit over a per-iteration callable inside a loop: "
+                    "every iteration is a fresh trace cache")
+            else:
+                # (b) jit(<fresh fn>)(args) immediately invoked inside a
+                # function — a second pass through this code retraces
+                parent = module.parent(node)
+                if isinstance(parent, ast.Call) and parent.func is node \
+                        and module.enclosing_function(node) is not None:
+                    yield self.finding(
+                        module, node,
+                        "jit-then-call over a lambda/bound-method/closure "
+                        "retraces on every pass; hoist a stable jitted "
+                        "callable", severity=Severity.WARNING)
+            # (c) unhashable static default: list/dict/set passed to a
+            # static arg in the wrapper call
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnames"):
+                    continue
+                if isinstance(kw.value, (ast.List, ast.Dict, ast.Set)):
+                    yield self.finding(
+                        module, kw.value,
+                        f"unhashable {type(kw.value).__name__.lower()} "
+                        f"literal for jit option '{kw.arg}' defeats the "
+                        "jit cache", severity=Severity.WARNING)
+
+
+@register
+class ImpureJitRule(Rule):
+    """TPU003 — side effects inside traced functions.
+
+    A traced function runs ONCE at trace time; ``self.x = ...``, ``global``
+    writes, or mutating a closed-over container happen during tracing and
+    never again — the classic "my counter only incremented once" bug, or a
+    silent leak of tracers into host state that poisons later steps.
+    """
+
+    code = "TPU003"
+    name = "impure-jit"
+    severity = Severity.ERROR
+    summary = "mutation of external state under trace"
+
+    _MUTATORS = {"append", "extend", "add", "update", "insert", "pop",
+                 "setdefault", "remove", "clear"}
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        scope = module.scope
+        for fn in _walk_functions(module, traced_only=True):
+            # names local to this fn or to a traced ancestor are
+            # trace-local — mutating them is not a side effect
+            local_names = _local_names(module, fn)
+            anc = module.enclosing_function(fn)
+            while anc is not None:
+                if scope.fn_traced(anc):
+                    local_names |= _local_names(module, anc)
+                anc = module.enclosing_function(anc)
+            for node in module.fn_nodes(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        for leaf in ast.walk(t):
+                            if isinstance(leaf, ast.Attribute) and isinstance(
+                                    leaf.value, ast.Name) and \
+                                    leaf.value.id == "self":
+                                yield self.finding(
+                                    module, node,
+                                    f"assignment to self.{leaf.attr} inside "
+                                    "a traced function runs once at trace "
+                                    "time, not per step")
+                elif isinstance(node, ast.Global):
+                    yield self.finding(
+                        module, node,
+                        "'global' write inside a traced function is a "
+                        "trace-time side effect")
+                elif isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) and \
+                        node.func.attr in self._MUTATORS and isinstance(
+                        node.func.value, ast.Name) and \
+                        node.func.value.id not in local_names:
+                    yield self.finding(
+                        module, node,
+                        f"mutating closed-over '{node.func.value.id}."
+                        f"{node.func.attr}(...)' inside a traced function "
+                        "is a trace-time side effect",
+                        severity=Severity.WARNING)
+
+
+def _local_names(module: ModuleInfo, fn: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (args.args + args.posonlyargs + args.kwonlyargs):
+            names.add(a.arg)
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+    for node in module.fn_nodes(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name):
+                        names.add(leaf.id)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            t = node.target
+            for leaf in ast.walk(t):
+                if isinstance(leaf, ast.Name):
+                    names.add(leaf.id)
+        elif isinstance(node, (ast.withitem,)) and node.optional_vars:
+            for leaf in ast.walk(node.optional_vars):
+                if isinstance(leaf, ast.Name):
+                    names.add(leaf.id)
+    return names
+
+
+@register
+class DtypeDisciplineRule(Rule):
+    """TPU004 — dtype discipline in the bf16 hot path.
+
+    f64 anywhere under trace is either silently demoted (x64 off) or a
+    catastrophic MXU bypass; downcasting losses/logits to 16-bit destroys
+    the numerics headroom the fp32-softmax/fp32-loss convention exists
+    for. Explicit f32 scalar construction in traced code is reported at
+    INFO level — an f32-typed scalar upcasts every bf16 operand it
+    touches, but intentional f32 islands (grad norms, loss) are common
+    and correct.
+    """
+
+    code = "TPU004"
+    name = "dtype-discipline"
+    severity = Severity.ERROR
+    summary = "f64 under trace / loss-logit downcast / f32 scalar leak"
+
+    _LOSSY = re.compile(r"loss|logit", re.I)
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        scope = module.scope
+        for node in module.all_nodes:
+            if not scope.in_traced(node):
+                continue
+            q = _qual(module, node) if isinstance(
+                node, (ast.Attribute, ast.Name)) else None
+            if q in _F64_NAMES:
+                yield self.finding(
+                    module, node,
+                    f"{q} under trace: f64 is demoted (jax_enable_x64 off) "
+                    "or falls off the MXU; use f32/bf16")
+                continue
+            if isinstance(node, ast.Constant) and node.value in (
+                    "float64", "complex128") and scope.in_traced(node):
+                yield self.finding(
+                    module, node,
+                    "dtype string 'float64' under trace; use f32/bf16")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            # x.astype(half) / jnp.asarray(x, half) on loss/logit values
+            half_target = None
+            value_expr = None
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "astype" and node.args:
+                dq = _qual(module, node.args[0])
+                if dq in _HALF_NAMES or (
+                        isinstance(node.args[0], ast.Constant)
+                        and node.args[0].value in ("bfloat16", "float16")):
+                    half_target = dq or node.args[0].value
+                    value_expr = node.func.value
+            elif _qual(module, node.func) in _HALF_NAMES and node.args:
+                half_target = _qual(module, node.func)
+                value_expr = node.args[0]
+            if half_target is not None and value_expr is not None:
+                src = ast.unparse(value_expr)
+                if self._LOSSY.search(src):
+                    yield self.finding(
+                        module, node,
+                        f"downcast of '{src}' to 16-bit: losses/logits "
+                        "must stay f32 (softmax/CE numerics)",
+                        severity=Severity.WARNING)
+                continue
+            # f32 scalar construction (INFO): upcasts bf16 operands
+            fq = _qual(module, node.func)
+            if fq in _F32_NAMES and node.args and _is_literal(node.args[0]):
+                yield self.finding(
+                    module, node,
+                    f"{fq} scalar under trace upcasts bf16 operands; a "
+                    "weak Python scalar keeps the compute dtype",
+                    severity=Severity.INFO)
+
+
+@register
+class DonationRule(Rule):
+    """TPU005 — step state passed through jit without donation.
+
+    A train step that takes the full TrainState but doesn't donate it
+    doubles peak HBM (old + new state live across the step) — the
+    difference between fitting the 113-TFLOPs config and OOMing at
+    compile. Flagged only when the wrapped function resolvably takes a
+    parameter named like the step state.
+    """
+
+    code = "TPU005"
+    name = "missing-donation"
+    severity = Severity.WARNING
+    summary = "jit over step state without donate_argnums"
+
+    _STATEY = {"state", "train_state", "opt_state", "carry_state"}
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        scope = module.scope
+        for node in module.all_calls:
+            if not scope.is_jit_call(node):
+                continue
+            if any(kw.arg in ("donate_argnums", "donate_argnames")
+                   for kw in node.keywords):
+                continue
+            if not node.args:
+                continue
+            target = scope.resolve_local_def(node.args[0])
+            if target is None:
+                continue
+            args = getattr(target, "args", None)
+            if args is None:
+                continue
+            statey = [a.arg for a in args.args if a.arg in self._STATEY]
+            if statey:
+                yield self.finding(
+                    module, node,
+                    f"jit over '{getattr(target, 'name', '<lambda>')}' "
+                    f"takes step state ({', '.join(statey)}) without "
+                    "donate_argnums: old and new state coexist, doubling "
+                    "peak HBM")
+
+
+@register
+class TracerBranchRule(Rule):
+    """TPU006 — Python control flow on tracer values.
+
+    ``if``/``while`` on a traced array concretizes it: TracerBoolConversion
+    at best, a silently trace-time-frozen branch at worst. Branching on
+    static python config is ubiquitous and fine, so the check demands
+    dataflow evidence that the condition is an array: either the test
+    itself calls into jnp/lax, or it references a local that was assigned
+    from a jnp/jax call in the same function. ``x is None`` /
+    ``isinstance`` guards are structural and exempt.
+    """
+
+    code = "TPU006"
+    name = "tracer-branch"
+    severity = Severity.ERROR
+    summary = "Python branch on a traced value"
+
+    # jax calls whose results are static python values, not tracers
+    _STATIC_RESULTS = {"jax.numpy.ndim", "jax.numpy.shape", "jax.numpy.size",
+                       "jax.eval_shape", "jax.devices", "jax.device_count",
+                       "jax.local_device_count", "jax.default_backend",
+                       "jax.tree.structure", "jax.tree_util.tree_structure"}
+
+    def _is_array_call(self, module: ModuleInfo, call: ast.Call) -> bool:
+        q = _qual(module, call.func)
+        return bool(q) and q.startswith(("jax.numpy.", "jax.lax.",
+                                         "jax.random.", "jax.nn.")) \
+            and q not in self._STATIC_RESULTS
+
+    def _arrayish_locals(self, module: ModuleInfo, fn) -> Set[str]:
+        names: Set[str] = set()
+        for node in module.fn_nodes(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) and \
+                    self._is_array_call(module, node.value):
+                for t in node.targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            names.add(leaf.id)
+        return names
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for fn in _walk_functions(module, traced_only=True):
+            if isinstance(fn, ast.Lambda):
+                continue
+            arrayish = self._arrayish_locals(module, fn)
+            for node in module.fn_nodes(fn):
+                if isinstance(node, (ast.If, ast.While, ast.IfExp,
+                                     ast.Assert)):
+                    test = node.test
+                else:
+                    continue
+                bad = self._tracer_evidence(module, test, arrayish)
+                if bad:
+                    kind = type(node).__name__.lower()
+                    yield self.finding(
+                        module, node,
+                        f"python {kind} on traced value {bad} concretizes "
+                        "it at trace time; use lax.cond / jnp.where")
+
+    def _tracer_evidence(self, module: ModuleInfo, test: ast.AST,
+                         arrayish: Set[str]) -> Optional[str]:
+        # `a is None or <tracer>` still concretizes the tracer — the
+        # structural-guard exemption applies per boolean operand, not to
+        # the whole condition
+        if isinstance(test, ast.BoolOp):
+            for operand in test.values:
+                bad = self._tracer_evidence(module, operand, arrayish)
+                if bad:
+                    return bad
+            return None
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._tracer_evidence(module, test.operand, arrayish)
+        for n in ast.walk(test):
+            # structural guards are fine
+            if isinstance(n, ast.Compare) and any(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+                return None
+            if isinstance(n, ast.Call):
+                cf = n.func
+                if isinstance(cf, ast.Name) and cf.id in (
+                        "isinstance", "hasattr", "len", "callable"):
+                    return None
+        for n in ast.walk(test):
+            if isinstance(n, ast.Call) and self._is_array_call(module, n):
+                return f"'{ast.unparse(n)}'"
+            if isinstance(n, ast.Name) and n.id in arrayish:
+                return f"'{n.id}'"
+        return None
+
+
+@register
+class PRNGReuseRule(Rule):
+    """TPU007 — PRNG key reuse.
+
+    Passing one key to two sampling calls correlates the streams (same
+    bits), and sampling with a loop-invariant key repeats the draw every
+    iteration — both are silent statistical bugs, not crashes. Keys are
+    consumed once; thread new ones with split/fold_in.
+    """
+
+    code = "TPU007"
+    name = "prng-reuse"
+    severity = Severity.ERROR
+    summary = "PRNG key consumed more than once"
+
+    _NONCONSUMING = {"split", "fold_in", "PRNGKey", "key", "clone",
+                     "key_data", "wrap_key_data", "key_impl"}
+    _KEYISH = re.compile(r"rng|key|prng", re.I)
+
+    def _consuming_key_arg(self, module: ModuleInfo,
+                           call: ast.Call) -> Optional[str]:
+        q = _qual(module, call.func)
+        if not q or not q.startswith("jax.random."):
+            return None
+        if q.rsplit(".", 1)[1] in self._NONCONSUMING:
+            return None
+        for arg in call.args[:1]:
+            if isinstance(arg, ast.Name) and self._KEYISH.search(arg.id):
+                return arg.id
+        return None
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for fn in module.scope._defs:
+            if isinstance(fn, ast.Lambda):
+                continue
+            yield from self._check_body(module, fn)
+
+    @staticmethod
+    def _branch_path(module: ModuleInfo, node: ast.AST):
+        """(if-node, arm) pairs on the ancestor chain — used to recognize
+        mutually exclusive if/else arms."""
+        arms = []
+        child, cur = node, module.parent(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if isinstance(cur, ast.If):
+                if any(child is s for s in cur.body):
+                    arms.append((id(cur), "body"))
+                elif any(child is s for s in cur.orelse):
+                    arms.append((id(cur), "orelse"))
+            child, cur = cur, module.parent(cur)
+        return arms
+
+    @classmethod
+    def _exclusive(cls, module: ModuleInfo, a: ast.AST, b: ast.AST) -> bool:
+        pa = dict(cls._branch_path(module, a))
+        return any(pa.get(if_id) not in (None, arm)
+                   for if_id, arm in cls._branch_path(module, b))
+
+    def _check_body(self, module: ModuleInfo, fn) -> Iterator[Finding]:
+        flagged = set()         # nodes already reported (sequential + loop
+                                # checks can overlap on the same call)
+        consumed = {}           # key name -> first consuming node
+        events = []             # (lineno, kind, name, node) in source order
+        for node in module.fn_nodes(fn):
+            if isinstance(node, ast.Call):
+                k = self._consuming_key_arg(module, node)
+                if k:
+                    events.append((node.lineno, "use", k, node))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            events.append(
+                                (node.lineno, "bind", leaf.id, node))
+        for lineno, kind, name, node in sorted(
+                events, key=lambda e: (e[0],
+                                       0 if e[1] == "bind" else 1)):
+            if kind == "bind":
+                consumed.pop(name, None)
+            elif name in consumed and not self._exclusive(
+                    module, consumed[name], node):
+                flagged.add(node)
+                yield self.finding(
+                    module, node,
+                    f"PRNG key '{name}' already consumed at line "
+                    f"{consumed[name].lineno}; split/fold_in a fresh key "
+                    "(reuse correlates the random streams)")
+            elif name not in consumed:
+                consumed[name] = node
+        # loop-invariant key: consumed inside a loop body with no rebinding
+        # of the key anywhere in that loop
+        for node in module.fn_nodes(fn):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            bound_in_loop = set()
+            for n in ast.walk(node):
+                if isinstance(n, (ast.Assign, ast.AugAssign)):
+                    ts = n.targets if isinstance(n, ast.Assign) \
+                        else [n.target]
+                    for t in ts:
+                        for leaf in ast.walk(t):
+                            if isinstance(leaf, ast.Name):
+                                bound_in_loop.add(leaf.id)
+            if isinstance(node, ast.For):
+                for leaf in ast.walk(node.target):
+                    if isinstance(leaf, ast.Name):
+                        bound_in_loop.add(leaf.id)
+            for n in ast.walk(node):
+                if isinstance(n, ast.Call) and n not in flagged:
+                    k = self._consuming_key_arg(module, n)
+                    if k and k not in bound_in_loop:
+                        flagged.add(n)
+                        yield self.finding(
+                            module, n,
+                            f"PRNG key '{k}' is loop-invariant: every "
+                            "iteration draws the same bits; fold_in the "
+                            "loop index")

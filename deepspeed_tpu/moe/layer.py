@@ -265,4 +265,5 @@ def expert_parallel_apply(apply_fn: Callable,
     )
     # partial-auto shard_map requires a jit context (its eager trace path
     # rejects specs over auto axes); calling under jit is also the fast path
+    # graftlint: disable=TPU002 (called under the model's outer jit: one construction per outer trace)
     return jax.jit(mapped)(expert_params, dispatched)

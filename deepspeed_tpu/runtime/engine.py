@@ -354,7 +354,7 @@ class DeepSpeedEngine:
             master = ()
         elif self.keep_master:
             master = jax.device_put(params_f32, self.master_shardings)
-            params = jax.jit(
+            params = jax.jit(  # graftlint: disable=TPU002 (engine init: one trace per engine)
                 lambda m: jax.tree.map(lambda x: x.astype(self.compute_dtype), m),
                 out_shardings=self.param_shardings)(master)
         else:
@@ -374,16 +374,22 @@ class DeepSpeedEngine:
         else:
             self.opt_shardings = self._opt_state_shardings(params_f32)
             if self.optimizer is not None:
-                opt_state = jax.jit(self.optimizer.init,
+                opt_state = jax.jit(self.optimizer.init,  # graftlint: disable=TPU002 (engine init: one trace per engine)
                                     out_shardings=self.opt_shardings)(
                                         master if self.keep_master else params)
+        # scalars placed REPLICATED ON THE MESH, matching the canonical
+        # sharding the compiled step emits for its outputs — a
+        # SingleDeviceSharding here is a different jit cache key and cost a
+        # spurious retrace of the whole program on the second step
+        rep = NamedSharding(self.mesh, P())
         self.state = TrainState(
-            step=jnp.asarray(0, jnp.int32),
+            step=jax.device_put(jnp.asarray(0, jnp.int32), rep),
             params=params,
             master=master,
             opt_state=opt_state,
-            scale=self.loss_scaler.init(),
-            skipped_steps=jnp.asarray(0, jnp.int32))
+            scale=jax.tree.map(lambda x: jax.device_put(x, rep),
+                               self.loss_scaler.init()),
+            skipped_steps=jax.device_put(jnp.asarray(0, jnp.int32), rep))
 
         # compiled fns -------------------------------------------------------
         if self.offload is not None:
@@ -517,7 +523,7 @@ class DeepSpeedEngine:
         # jit: abstract init is faster and partial-auto shard_map regions in
         # the model (ring attention, explicit-a2a MoE) require a jit context
         example_batch = jax.tree.map(jnp.asarray, example_batch)
-        variables = jax.jit(
+        variables = jax.jit(  # graftlint: disable=TPU002 (param init: one trace per engine)
             lambda rng, batch: self.module.init(rng, batch, **kwargs)
         )(init_rng, example_batch)
         return variables["params"]
@@ -1014,12 +1020,15 @@ class DeepSpeedEngine:
         if self.offload is not None:
             grads = self._accum_grads
             overflow = LossScaler.has_overflow(grads)
-            sq = sum(float(jnp.sum(jnp.square(g)))
+            # norm stays on device: float() per leaf was one blocking D2H
+            # transfer per param tensor per step (graftlint TPU001); the
+            # single sync happens in _apply_offload_update's device_get
+            sq = sum(jnp.sum(jnp.square(g))
                      for g in jax.tree.leaves(grads))
             metrics = self._apply_offload_update(
                 grads, float(self._micro_count),
                 jnp.mean(jnp.stack(self._accum_losses)),
-                jnp.sqrt(jnp.asarray(sq)), overflow)
+                jnp.sqrt(sq), overflow)
             self._accum_grads = None
             self._accum_losses = []
             self._micro_count = 0
@@ -1035,23 +1044,31 @@ class DeepSpeedEngine:
         self._after_step(metrics)
         return metrics
 
-    def _after_step(self, metrics):
+    def _after_step(self, metrics):  # graftlint: hotpath
         self.global_steps += 1
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self._last_metrics = metrics
-        if self.monitor.enabled and self.global_steps % self.config.steps_per_print == 0:
-            events = [("Train/Samples/train_loss", float(metrics["loss"]),
-                       self.global_steps),
-                      ("Train/Samples/lr", float(metrics["lr"]), self.global_steps)]
-            if self.loss_scaler.enabled:
-                events.append(("Train/Samples/loss_scale",
-                               float(metrics["loss_scale"]), self.global_steps))
-            self.monitor.write_events(events)
         if self.global_steps % self.config.steps_per_print == 0:
-            log_dist(f"step={self.global_steps} loss={float(metrics['loss']):.4f} "
-                     f"lr={float(metrics['lr']):.3e} "
-                     f"grad_norm={float(metrics['grad_norm']):.3f}", ranks=[0])
+            # one batched D2H pull for every scalar the logging tier reads
+            # (graftlint TPU001: per-scalar float() here was 3-4 separate
+            # blocking transfers per print step)
+            host = jax.device_get({k: metrics[k] for k in
+                                   ("loss", "lr", "grad_norm", "loss_scale")
+                                   if k in metrics})
+            if self.monitor.enabled:
+                events = [("Train/Samples/train_loss", float(host["loss"]),
+                           self.global_steps),
+                          ("Train/Samples/lr", float(host["lr"]),
+                           self.global_steps)]
+                if self.loss_scaler.enabled:
+                    events.append(("Train/Samples/loss_scale",
+                                   float(host["loss_scale"]),
+                                   self.global_steps))
+                self.monitor.write_events(events)
+            log_dist(f"step={self.global_steps} loss={float(host['loss']):.4f} "
+                     f"lr={float(host['lr']):.3e} "
+                     f"grad_norm={float(host['grad_norm']):.3f}", ranks=[0])
         self._autotuning_hook()
 
     def _autotuning_hook(self):

@@ -39,6 +39,33 @@ def _axes_size(mesh_shape: dict, axes: Tuple[str, ...]) -> int:
     return size
 
 
+def canonicalize_spec(spec: P, mesh_shape: dict) -> P:
+    """Normalize a PartitionSpec to the compiler's canonical output form:
+    drop mesh axes of size 1, unwrap single-name tuples, strip trailing
+    Nones. A spec naming a size-1 axis denotes the SAME sharding but is a
+    DIFFERENT jit cache key than what XLA emits for the step's outputs —
+    the mismatch cost one spurious retrace of the whole train program on
+    the second step (caught by test_train_step_compiles_once_across_steps)."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+            continue
+        names = (entry,) if isinstance(entry, str) else tuple(entry)
+        # drop only KNOWN size-1 axes; an unknown (typo'd) axis must stay
+        # so NamedSharding still raises instead of silently replicating
+        names = tuple(n for n in names if mesh_shape.get(n, 0) != 1)
+        if not names:
+            out.append(None)
+        elif len(names) == 1:
+            out.append(names[0])
+        else:
+            out.append(names)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
 def insert_zero_axes(shape: Tuple[int, ...],
                      tp_spec: Optional[P],
                      zero_axes: Tuple[str, ...],
@@ -204,8 +231,9 @@ class ZeroShardingPolicy:
             is_expert = bool(expert_fn and expert_fn(path))
             shape = np.shape(leaf)
             pstr = "/".join(str(getattr(k, "key", k)) for k in path)
-            out.append(NamedSharding(self.mesh,
-                                     spec_fn(shape, tp, is_expert, pstr)))
+            spec = canonicalize_spec(spec_fn(shape, tp, is_expert, pstr),
+                                     self.mm.shape)
+            out.append(NamedSharding(self.mesh, spec))
         return jax.tree_util.tree_unflatten(treedef, out)
 
     def param_shardings(self, params, tp_specs=None, expert_fn=None):

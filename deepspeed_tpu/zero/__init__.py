@@ -65,6 +65,7 @@ class Init(contextlib.AbstractContextManager):
         shardings = policy.tree_shardings(
             jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), shapes),
             policy.param_spec)
+        # graftlint: disable=TPU002 (model init API: one trace per model construction)
         return jax.jit(init_fn, out_shardings=shardings)(*args, **kwargs)
 
 
@@ -181,7 +182,9 @@ class OnDevice(contextlib.AbstractContextManager):
             sharding = jax.sharding.SingleDeviceSharding(dev)
             out_sh = jax.tree.map(lambda _: sharding, shapes)
             with jax.default_device(dev):
+                # graftlint: disable=TPU002 (model init API: one trace per model construction)
                 return jax.jit(casted, out_shardings=out_sh)(*args, **kwargs)
+        # graftlint: disable=TPU002 (model init API: one trace per model construction)
         return jax.jit(casted)(*args, **kwargs)
 
 

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# graftlint CI entrypoint: machine-readable lint over the package.
+#
+#   scripts/lint.sh                 # JSON report on stdout, exit 1 on gating findings
+#   scripts/lint.sh --format text   # human-readable
+#   scripts/lint.sh path/to/file.py # lint a subset
+#
+# The checked-in baseline (.graftlint.json) is applied automatically; a
+# finding not in the baseline and not suppressed inline fails the run.
+# See docs/LINT.md for the rule catalog and workflows.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+FORMAT="json"
+ARGS=()
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --format) FORMAT="$2"; shift 2 ;;
+    *) ARGS+=("$1"); shift ;;
+  esac
+done
+
+exec python -m deepspeed_tpu.analysis "${ARGS[@]:-deepspeed_tpu}" --format "$FORMAT"

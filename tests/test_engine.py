@@ -168,6 +168,56 @@ def test_micro_api_flops_within_fused_budget():
     engine.step()
 
 
+def test_train_step_no_implicit_host_transfers():
+    """The compiled hot loop must do ZERO implicit host<->device transfers:
+    jax.transfer_guard("disallow") raises on any implicit pull (a stray
+    .item()/float() sneaking into the step would fail here long before it
+    shows up as a BENCH delta). Inputs are explicitly placed outside the
+    guard; the guarded region is exactly one compiled train step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.parallel.mesh import BATCH_AXES
+
+    engine = make_engine(stage=2)
+    gas = engine.config.gradient_accumulation_steps
+    micro_sharding = NamedSharding(engine.mesh, P(None, BATCH_AXES))
+    micros = jax.tree.map(
+        lambda x: jax.device_put(
+            jnp.asarray(x).reshape((gas, x.shape[0] // gas) + x.shape[1:]),
+            micro_sharding),
+        random_batch(32))
+    # every input explicitly placed: uncommitted scalars would be
+    # implicitly replicated across the mesh inside the call
+    rep = NamedSharding(engine.mesh, P())
+    lr = jax.device_put(engine._current_lr(), rep)
+    # warmup: compile outside the guard
+    engine.state, _ = engine._train_step(
+        engine.state, micros, jax.device_put(engine.next_rng(), rep), lr)
+    rng = jax.device_put(engine.next_rng(), rep)
+    with jax.transfer_guard("disallow"):
+        engine.state, metrics = engine._train_step(engine.state, micros,
+                                                   rng, lr)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_train_step_compiles_once_across_steps():
+    """Retrace regression gate: 3 train_batch calls must hit ONE compiled
+    program. A silent retrace (unstable closure, fresh jit wrapper, python
+    value drifting into the trace) multiplies step wall time by compile
+    time — this fails CI instead of surfacing as a BENCH delta."""
+    engine = make_engine(stage=1)
+    cache_size = getattr(engine._train_step, "_cache_size", None)
+    if cache_size is None:
+        pytest.skip("jax build has no PjitFunction._cache_size")
+    stream = batch_stream(engine.config.train_batch_size)
+    for _ in range(3):
+        engine.train_batch(next(stream))
+    assert cache_size() == 1, (
+        f"train step traced {cache_size()}x across 3 identical steps")
+
+
 def test_overflow_skips_step():
     """Inf grads must skip the update and shrink the loss scale.
 
