@@ -1,4 +1,4 @@
-"""graftlint rules TPU001–TPU007.
+"""graftlint rules TPU001–TPU007, TPU010.
 
 Each rule targets one class of bug that regresses the gas-amortized train
 step silently: the bench still runs, just slower (host syncs, retraces)
@@ -557,6 +557,59 @@ class TracerBranchRule(Rule):
             if isinstance(n, ast.Name) and n.id in arrayish:
                 return f"'{n.id}'"
         return None
+
+
+@register
+class NamedScopeRule(Rule):
+    """TPU010 — Pallas kernel launch without a jax.named_scope.
+
+    A ``pl.pallas_call`` not wrapped in ``jax.named_scope`` shows up in
+    profiler traces as an anonymous custom-call: the hottest hand-written
+    regions in the program become unsearchable exactly where attribution
+    matters most. The scope must be LEXICALLY visible at the launch site
+    (a ``with jax.named_scope(...)`` in the same function, or the enclosing
+    function decorated with it) — a caller's scope doesn't survive
+    refactors that re-export the launcher.
+    """
+
+    code = "TPU010"
+    name = "missing-named-scope"
+    severity = Severity.WARNING
+    summary = "pallas_call outside any jax.named_scope"
+
+    _SCOPES = {"jax.named_scope", "jax.profiler.TraceAnnotation",
+               "jax.profiler.StepTraceAnnotation"}
+
+    def _scoped(self, module: ModuleInfo, node: ast.AST) -> bool:
+        cur = module.parent(node)
+        while cur is not None:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    ctx = item.context_expr
+                    target = ctx.func if isinstance(ctx, ast.Call) else ctx
+                    if _qual(module, target) in self._SCOPES:
+                        return True
+            elif isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in cur.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _qual(module, target) == "jax.named_scope":
+                        return True
+                return False        # scope must be lexical within the launcher
+            cur = module.parent(cur)
+        return False
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in module.all_calls:
+            if _qual(module, node.func) != \
+                    "jax.experimental.pallas.pallas_call":
+                continue
+            if self._scoped(module, node):
+                continue
+            yield self.finding(
+                module, node,
+                "pl.pallas_call without jax.named_scope: the kernel is "
+                "anonymous in profiler traces; wrap the launch in "
+                "jax.named_scope('<kernel-name>')")
 
 
 @register

@@ -45,6 +45,7 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
                        remat_policy: str = "dots", fused_loss=None,
                        pure_bf16: bool = False,
                        grad_accum_dtype=None,
+                       masked=None,
                        verbose: bool = True,
                        **model_kw):
     """Measure sustained train-step model TFLOPs/chip for a preset.
@@ -53,6 +54,13 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
     ``moe_experts``, ``moe_k``, …) so long-context and MoE variants run
     through the same timing loop. Returns the result dict (also printed as
     one JSON line when verbose).
+
+    ``masked`` (default: True for BERT presets): batches carry a ragged
+    attention_mask — sample lengths uniform in [seq/4, seq], the layout real
+    padded-batch training sees. The mask rides the Pallas flash kernel
+    in-kernel, so this leg times the representative path instead of the
+    maskless upper bound (a maskless encoder leg never exercises the mask
+    plumbing the reference's fused softmax kernels exist for).
     """
     import jax
     import deepspeed_tpu as ds
@@ -89,10 +97,17 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
     if grad_accum_dtype:
         config["data_types"] = {"grad_accum_dtype": grad_accum_dtype}
     rng = np.random.default_rng(0)
+    if masked is None:
+        masked = not causal
 
     def make_batch():
-        return {"input_ids": rng.integers(0, cfg.vocab_size,
-                                          size=(batch_size, seq))}
+        b = {"input_ids": rng.integers(0, cfg.vocab_size,
+                                       size=(batch_size, seq))}
+        if masked:
+            lens = rng.integers(max(seq // 4, 1), seq + 1, size=(batch_size,))
+            b["attention_mask"] = (np.arange(seq)[None, :]
+                                   < lens[:, None]).astype(np.int32)
+        return b
 
     # fused_loss models return the scalar loss (BERT variant predicts in
     # place — same cost profile as the reference's MLM objective); plain
@@ -146,6 +161,7 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
                       if cfg.moe_experts > 0 else {}),
                    **({"attention_impl": cfg.attention_impl}
                       if cfg.attention_impl != "auto" else {}),
+                   "masked": bool(masked),
                    "zero_stage": zero_stage, "remat": remat,
                    "remat_policy": remat_policy if remat else None,
                    "pure_bf16": pure_bf16,
@@ -184,10 +200,16 @@ def main(argv=None):
     fl.add_argument("--no-fused-loss", dest="fused_loss",
                     action="store_false",
                     help="force the plain [B,S,V]-logits loss")
+    mk = p.add_mutually_exclusive_group()
+    mk.add_argument("--masked", dest="masked", default=None,
+                    action="store_true",
+                    help="ragged attention_mask batches (default for BERT)")
+    mk.add_argument("--no-masked", dest="masked", action="store_false",
+                    help="maskless batches (the pre-round-6 upper bound)")
     a = p.parse_args(argv)
     run_training_bench(a.preset, a.seq, a.micro, a.gas, a.steps, a.zero,
                        a.remat, remat_policy=a.remat_policy,
-                       fused_loss=a.fused_loss)
+                       fused_loss=a.fused_loss, masked=a.masked)
 
 
 if __name__ == "__main__":

@@ -146,7 +146,10 @@ class ActivationCheckpointingConfig(DeepSpeedConfigModel):
 
 
 class SparseAttentionConfig(DeepSpeedConfigModel):
-    """reference: runtime/config.py:270-453; modes map onto our block-sparse mask builders."""
+    """reference: runtime/config.py:270-453; modes map onto our block-sparse
+    mask builders. CONSUMED by engine.wire_attention_config: the section is
+    wired into the in-tree model's attention_impl="sparse" (unknown modes
+    raise at initialize)."""
     mode: str = "fixed"
     block: int = 16
     different_layout_per_head: bool = False
@@ -269,7 +272,10 @@ class TensorParallelConfig(DeepSpeedConfigModel):
 
 
 class SequenceParallelConfig(DeepSpeedConfigModel):
-    """TPU-native addition: ring-attention / Ulysses-style context parallelism over ICI."""
+    """TPU-native addition: ring-attention / Ulysses-style context parallelism
+    over ICI. ``mode`` is CONSUMED by engine.wire_attention_config: with
+    sp_size > 1 it selects the in-tree model's ring vs ulysses
+    attention_impl (hand-set conflicting impls raise)."""
     sp_size: int = 1
     mode: str = "ring"   # ring | ulysses
 

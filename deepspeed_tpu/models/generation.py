@@ -168,12 +168,21 @@ def _moe_mlp(cfg: TransformerConfig, p_moe, h):
 
 def forward_with_cache(cfg: TransformerConfig, params: PyTree,
                        input_ids: jnp.ndarray, cache: Dict,
-                       prefer_kernel: Optional[bool] = None
+                       prefer_kernel: Optional[bool] = None,
+                       prefill_flash=False
                        ) -> Tuple[jnp.ndarray, Dict]:
     """Run T_new tokens at positions [cache.pos, cache.pos+T_new) against the
     cache. Returns (logits [B, T_new, V], updated cache). Params must be the
     scan-layers layout (blocks leaves [L, ...]) — use ensure_scan_layout to
     restack a per-layer tree.
+
+    ``prefill_flash``: the caller guarantees the cache is EMPTY (pos == 0) —
+    the prefill attention then runs the Pallas flash kernel over the fresh
+    K/V (causal, with in-kernel alibi slopes / softcap / uniform sliding
+    window) instead of masking the whole preallocated cache, so prefill cost
+    scales with the prompt, not max_len. TPU only (pass "interpret" to force
+    the interpreted kernel in tests); ragged (left-padded), int8-cache, and
+    mixed-per-layer-window models keep the jnp path.
 
     Covers the policy architectures: rotary/alibi positions, parallel
     residual (GPT-J), per-layer local windows (GPT-Neo), relu/gelu
@@ -251,7 +260,21 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
                    or (cfg.attention_impl == "auto" and prefer_kernel))
                   and jax.default_backend() == "tpu" and ali is None
                   and pad is None and not quant_kv
-                  and not cfg.attn_softcap)   # no softcap kernel path
+                  and not cfg.attn_softcap)   # decode kernel has no softcap
+
+    # prefill on the flash kernel (empty cache — caller's contract): alibi,
+    # softcap and a UNIFORM static window all run in-kernel; mixed per-layer
+    # windows trace through one scan body, so they stay on the jnp path
+    uw = cfg.uniform_window()
+    uniform_ok = uw is not None
+    uniform_window = uw or 0
+    flash_interp = prefill_flash == "interpret"
+    use_prefill_flash = (bool(prefill_flash) and T_new > 1 and pad is None
+                         and not quant_kv and uniform_ok
+                         and cfg.attention_impl in ("auto", "flash")
+                         and (jax.default_backend() == "tpu" or flash_interp))
+    prefill_slopes = (jnp.asarray(alibi_slopes(nh), jnp.float32)
+                      if cfg.pos_embed == "alibi" else None)
 
     def layer(carry, xs):
         # the FULL [L, ...] caches ride in the carry so the per-token write
@@ -303,7 +326,17 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
         v_all = jax.lax.dynamic_update_slice(v_all, v[None],
                                              (li, 0, 0, pos, 0))
         o = None
-        if use_kernel:
+        if use_prefill_flash:
+            from ..ops.pallas.flash_attention import flash_attention
+            # empty cache: attention over the FRESH k/v is exactly the
+            # causal prefill; alibi distances from arange positions match
+            # q_abs because pos == 0
+            o = flash_attention(q, k, v, causal=True, sm_scale=sm_scale,
+                                window=uniform_window,
+                                softcap=cfg.attn_softcap,
+                                alibi_slopes=prefill_slopes,
+                                interpret=flash_interp)
+        if o is None and use_kernel:
             from ..ops.pallas.decode_attention import decode_attention
             try:
                 # stacked form: the kernel indexes layer li out of the
@@ -539,8 +572,11 @@ def _generate(cfg: TransformerConfig,
     # (most of the preallocated cache dead through the run) is its regime
     prefer_kernel = (B >= 2 and padded_len >= 4 * 512
                      and T_in <= padded_len // 2)
+    # the first forward runs against the freshly-initialized (empty) cache:
+    # prefill attention rides the flash kernel where eligible
     logits, cache = forward_with_cache(cfg, params, input_ids, cache,
-                                       prefer_kernel=prefer_kernel)
+                                       prefer_kernel=prefer_kernel,
+                                       prefill_flash=True)
 
     rep = repetition_penalty is not None and repetition_penalty != 1.0
     if rep:

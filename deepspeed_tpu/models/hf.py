@@ -10,12 +10,13 @@ slices 1/tp_size by hand, containers/base.py:243).
 
 Policies implemented: GPT-2, GPT-Neo, GPT-NeoX, GPT-J, OPT, BLOOM, BERT,
 RoBERTa, DistilBERT, CLIP-text, Megatron-GPT — 11 arches covering the
-reference's replace_policy.py:18-32 list — plus Llama, Mistral, and
-Qwen2 (RMSNorm + SwiGLU + grouped-query attention + sliding window +
-qkv biases; EXCEEDS the reference, whose v0.8.1 policy list pre-dates
-them): 14 total. torch Linear weights are
-[out, in] and transpose into flax kernels; GPT-2's Conv1D is already
-[in, out].
+reference's replace_policy.py:18-32 list — plus the modern-decoder family
+(EXCEEDS the reference, whose v0.8.1 policy list pre-dates them): Llama,
+Mistral, Qwen2, Qwen3, Falcon (7B/40B/RW), GPT-BigCode/StarCoder, Phi,
+Gemma, Gemma-2, and Mixtral — RMSNorm + SwiGLU + grouped-query attention,
+sliding windows, qkv biases, scaled RoPE, softcapping, MoE: 21 total.
+torch Linear weights are [out, in] and transpose into flax kernels;
+GPT-2's Conv1D is already [in, out].
 """
 
 from __future__ import annotations

@@ -142,14 +142,21 @@ class PipelinedTransformer:
 
     def _head_logits(self, head_p, h):
         """Final-norm'd hidden states -> logits; tied einsum against wte or
-        the untied (optionally biased) lm_head kernel."""
+        the untied (optionally biased) lm_head kernel. Applies the Gemma-2
+        final-logit softcap (returns f32 then — every caller casts to f32
+        anyway, and a bf16 round-trip of capped logits can flip near-tie
+        argmaxes)."""
         if self.cfg.tie_embeddings:
             wte = head_p["wte"].astype(h.dtype)
-            return jnp.einsum("...sh,vh->...sv", h, wte)
-        k = head_p["lm_head"]["kernel"].astype(h.dtype)
-        logits = jnp.einsum("...sh,hv->...sv", h, k)
-        if "bias" in head_p["lm_head"]:
-            logits = logits + head_p["lm_head"]["bias"].astype(h.dtype)
+            logits = jnp.einsum("...sh,vh->...sv", h, wte)
+        else:
+            k = head_p["lm_head"]["kernel"].astype(h.dtype)
+            logits = jnp.einsum("...sh,hv->...sv", h, k)
+            if "bias" in head_p["lm_head"]:
+                logits = logits + head_p["lm_head"]["bias"].astype(h.dtype)
+        if self.cfg.final_logit_softcap:
+            from ..ops.attention import apply_softcap
+            logits = apply_softcap(logits, self.cfg.final_logit_softcap)
         return logits
 
     def _head_params(self, params):

@@ -64,7 +64,11 @@ class TransformerConfig:
     # logits are never materialized (HBM: ~3GB saved at 350M/bs8/seq1024)
     fused_loss: bool = False
     loss_chunk: int = 128
-    attention_impl: str = "auto"   # "auto" | "flash" | "reference"
+    # "auto" | "flash" | "reference" | "ring" | "ulysses" | "sparse"
+    # (ring/ulysses: sequence parallelism, wired by the engine from the
+    # sequence_parallel config section; sparse: block-sparse layouts from
+    # the sparse_attention section — see the sparse_attention field)
+    attention_impl: str = "auto"
     layer_norm_eps: float = 1e-5
     # -- architecture knobs covering the HF import policies (models/hf.py;
     #    reference: module_inject/replace_policy.py's per-arch policies) -----
@@ -140,8 +144,8 @@ class TransformerConfig:
     # keeps the ln2 slot)
     post_block_norms: bool = False
     # Gemma-2 logit softcapping: tanh(x/cap)*cap on attention scores
-    # (routes attention to the exact reference impl — no kernel path) and
-    # on the final LM logits; 0 = off
+    # (applied IN-KERNEL on the Pallas flash path; exact reference impl
+    # elsewhere) and on the final LM logits; 0 = off
     attn_softcap: float = 0.0
     final_logit_softcap: float = 0.0
     # explicit MLP width when it is not ratio*H (Llama: 11008 at H=4096)
@@ -152,6 +156,12 @@ class TransformerConfig:
     moe_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # block-sparse attention layout (ds_config "sparse_attention" section;
+    # the engine wires it here and sets attention_impl="sparse"): a hashable
+    # tuple of (key, value) items — lists as tuples — so the frozen config
+    # stays usable as a jit static argument. Keys mirror
+    # config.SparseAttentionConfig ("mode", "block", "num_local_blocks", ...).
+    sparse_attention: Optional[Tuple[Tuple[str, Any], ...]] = None
 
     def __post_init__(self):
         # gated_mlp + moe_experts is the Mixtral family: SwiGLU experts
@@ -169,6 +179,17 @@ class TransformerConfig:
         if self.head_dim_override is not None:
             return self.head_dim_override
         return self.hidden_size // self.num_heads
+
+    def uniform_window(self) -> Optional[int]:
+        """The single static window every layer shares, when layer_windows
+        is uniform: 0 for no/global windows, the window size otherwise;
+        None when layers MIX windows (per-layer routing must stay dynamic).
+        Shared by the training path (keeps the window static under nn.scan)
+        and the generation prefill (flash-kernel eligibility)."""
+        if self.layer_windows is None:
+            return 0
+        vals = {max(int(w), 0) for w in self.layer_windows}
+        return vals.pop() if len(vals) == 1 else None
 
     def rope_inv_freq(self, seq_len: Optional[int] = None):
         """Static inverse-frequency table for rotary embeddings with the
@@ -414,6 +435,52 @@ def alibi_bias(num_heads: int, q_pos: jnp.ndarray, k_pos: jnp.ndarray
     return slopes[None, :, None, None] * dist[:, None]
 
 
+def _sparse_block_attention(cfg, q, k, v, *, mask, bias, slopes, window,
+                            sm_scale, dropout_rate, dropout_rng):
+    """attention_impl == "sparse": execute the ds_config-selected block-sparse
+    layout (engine wires the parsed section into cfg.sparse_attention).
+
+    Clean calls (no mask/bias/dropout/softcap/window) run the Pallas
+    layout-skip kernel via ops.sparse_attention.sparse_attention — FLOPs
+    scale with layout density. Anything extra composes the layout into a
+    dense mask over the exact jnp reference instead: the configured sparsity
+    is still honored bit-exactly, only the FLOP scaling is lost. Unknown
+    modes raise here (and in the engine wiring) — never silently dense.
+    """
+    import dataclasses as _dc
+
+    from ..ops.attention import alibi_bias_from_slopes, mha_reference
+    from ..ops.sparse_attention import (SPARSITY_CONFIGS, layout_to_dense_mask,
+                                        sparse_attention)
+    B, H, S, D = q.shape
+    kwargs = {key: (list(val) if isinstance(val, tuple) else val)
+              for key, val in (cfg.sparse_attention or ())}
+    mode = kwargs.pop("mode", "fixed")
+    if mode not in SPARSITY_CONFIGS:
+        raise ValueError(f"unknown sparse attention mode '{mode}'; "
+                         f"have {sorted(SPARSITY_CONFIGS)}")
+    cls = SPARSITY_CONFIGS[mode]
+    allowed = {f.name for f in _dc.fields(cls)} - {"num_heads"}
+    sp_cfg = cls(num_heads=H,
+                 **{key: val for key, val in kwargs.items()
+                    if key in allowed and val is not None})
+    clean = (mask is None and bias is None and slopes is None
+             and dropout_rate == 0.0 and not window and not cfg.attn_softcap)
+    if clean:
+        return sparse_attention(q, k, v, sp_cfg, causal=cfg.causal,
+                                sm_scale=sm_scale)
+    if slopes is not None:
+        bias = alibi_bias_from_slopes(slopes, S, S)
+    lmask = layout_to_dense_mask(sp_cfg.make_layout(S), sp_cfg.block)[None]
+    mask = lmask if mask is None else mask & lmask
+    if window:
+        from ..ops.attention import window_mask
+        mask = mask & window_mask(S, S, window)
+    return mha_reference(q, k, v, causal=cfg.causal, bias=bias, mask=mask,
+                         sm_scale=sm_scale, dropout_rate=dropout_rate,
+                         dropout_rng=dropout_rng, softcap=cfg.attn_softcap)
+
+
 def _spec_constraint(x, spec: P):
     """Sharding constraint that works both under plain ``jax.jit`` and
     inside a shard_map.
@@ -426,8 +493,14 @@ def _spec_constraint(x, spec: P):
     Manual-'pipe' context) a full-mesh NamedSharding is REJECTED — there the
     bare spec is exactly right: it resolves against the context mesh and
     ignores the manual axes (our specs never name 'pipe')."""
-    ctx = jax.sharding.get_abstract_mesh()
-    if ctx is not None and not ctx.empty:
+    # jax-version compat: get_abstract_mesh moved under jax.sharding only in
+    # newer releases; older trees keep it in jax._src.mesh (and lack
+    # sharding-in-types entirely — see the typeof probe below)
+    _get_ctx = getattr(jax.sharding, "get_abstract_mesh", None)
+    ctx = _get_ctx() if _get_ctx is not None else None
+    # old jax: no public accessor (jax._src.mesh's same-named thread-local
+    # has different semantics) — getattr below treats ctx as absent
+    if getattr(ctx, "empty", None) is False:
         return jax.lax.with_sharding_constraint(x, spec)
     from ..parallel.mesh import get_global_mesh
     mm = get_global_mesh()
@@ -450,8 +523,12 @@ def _spec_constraint(x, spec: P):
     # DSTPU_FORCE_MESH_CONSTRAINTS=1 restores the always-constrain
     # behavior for that idiom (documented in docs/USAGE.md)
     import os
-    if os.environ.get("DSTPU_FORCE_MESH_CONSTRAINTS") != "1":
-        aval_mesh = getattr(getattr(jax.typeof(x), "sharding", None),
+    _typeof = getattr(jax, "typeof", None)
+    if os.environ.get("DSTPU_FORCE_MESH_CONSTRAINTS") != "1" \
+            and _typeof is not None:
+        # jax without sharding-in-types (no typeof) predates the empty-aval
+        # -mesh scope hazard: constrain unconditionally there
+        aval_mesh = getattr(getattr(_typeof(x), "sharding", None),
                             "mesh", None)
         if aval_mesh is None or getattr(aval_mesh, "empty", False):
             return x
@@ -585,9 +662,17 @@ class Block(nn.Module):
             k = jnp.repeat(k, nh // kv, axis=1)
             v = jnp.repeat(v, nh // kv, axis=1)
         bias = None
+        slopes = None
         if cfg.pos_embed == "alibi":
-            pos = positions if positions is not None else jnp.arange(S)
-            bias = alibi_bias(nh, pos, pos)
+            if positions is None:
+                # default arange positions: pass the per-head slopes so the
+                # flash kernel rebuilds the bias from block indices — no
+                # [B, H, S, S] materialization on the kernel path
+                slopes = jnp.asarray(alibi_slopes(nh), jnp.float32)
+            else:
+                # packed / per-sample position ids: the distance matrix is
+                # genuinely data-dependent, materialize it
+                bias = alibi_bias(nh, positions, positions)
         mask = attn_mask
         win = 0
         if window is not None:
@@ -606,11 +691,18 @@ class Block(nn.Module):
                         else mask & wmask[None, None])
         drop_rng = (self.make_rng("dropout")
                     if train and cfg.dropout > 0.0 else None)
-        out = attention(q, k, v, causal=cfg.causal, mask=mask, bias=bias,
-                        sm_scale=cfg.attn_scale,
-                        dropout_rate=cfg.dropout if train else 0.0,
-                        dropout_rng=drop_rng, impl=cfg.attention_impl,
-                        window=win, softcap=cfg.attn_softcap)
+        if cfg.attention_impl == "sparse":
+            out = _sparse_block_attention(
+                cfg, q, k, v, mask=mask, bias=bias, slopes=slopes,
+                window=win, sm_scale=cfg.attn_scale,
+                dropout_rate=cfg.dropout if train else 0.0,
+                dropout_rng=drop_rng)
+        else:
+            out = attention(q, k, v, causal=cfg.causal, mask=mask, bias=bias,
+                            alibi_slopes=slopes, sm_scale=cfg.attn_scale,
+                            dropout_rate=cfg.dropout if train else 0.0,
+                            dropout_rng=drop_rng, impl=cfg.attention_impl,
+                            window=win, softcap=cfg.attn_softcap)
         # tag so the "dots" remat policy keeps it: the Pallas kernel output is
         # not a dot_general, and recomputing flash fwd in bwd costs ~2ms/layer
         from jax.ad_checkpoint import checkpoint_name
@@ -704,6 +796,11 @@ class Transformer(nn.Module):
                              "subset changes layer shapes per depth)")
         wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                        param_dtype=jnp.float32, name="wte")
+        # blocks receive the USER's position_ids only (None for the default
+        # arange): rotary rebuilds arange internally, and alibi with default
+        # positions rides the flash kernel's slope path instead of a
+        # materialized [B, H, S, S] bias
+        user_positions = position_ids
         if position_ids is None:
             position_ids = jnp.arange(S)[None, :]
         x = wte(input_ids)
@@ -764,8 +861,14 @@ class Transformer(nn.Module):
             # sliding-window kernel routing in the unrolled path
             block = nn.remat(Block, static_argnums=(3, 4),
                              policy=policies[cfg.remat_policy])
+        # uniform windows (Mistral-class): keep the window a STATIC python
+        # int even under nn.scan so attention() can route to the
+        # sliding-window / flash kernels; MIXED per-layer windows scan a
+        # traced window that can only compose into the dense mask
+        uw = cfg.uniform_window()
+        static_window = uw or None
         windows = (jnp.asarray(cfg.layer_windows, jnp.int32)
-                   if cfg.layer_windows is not None else None)
+                   if uw is None else None)
         pld_on = cfg.pld and train and self.has_rng("pld")
         theta = jnp.asarray(1.0, jnp.float32)
         if pld_on and isinstance(batch, dict) and \
@@ -787,7 +890,9 @@ class Transformer(nn.Module):
             if pld_on:
                 def body(mdl, carry, xs):
                     w, li = xs
-                    out, aux = mdl(carry, attn_mask, train, w, position_ids)
+                    out, aux = mdl(carry, attn_mask, train,
+                                   static_window if w is None else w,
+                                   user_positions)
                     out, aux = pld_gate(mdl.make_rng("pld"), carry, out, aux,
                                         li.astype(jnp.float32))
                     return out, aux
@@ -797,7 +902,9 @@ class Transformer(nn.Module):
                          "pld": True}
             else:
                 def body(mdl, carry, w):
-                    return mdl(carry, attn_mask, train, w, position_ids)
+                    return mdl(carry, attn_mask, train,
+                               static_window if w is None else w,
+                               user_positions)
 
                 xs = windows
                 split = {"params": True, "dropout": True, "gating": True}
@@ -842,7 +949,7 @@ class Transformer(nn.Module):
                                    jnp.take(position_ids, idx, axis=1))
                     x = x.at[:, idx].set(out)
                 else:
-                    x, aux = blk(x, attn_mask, train, w, position_ids)
+                    x, aux = blk(x, attn_mask, train, w, user_positions)
                 if pld_on:
                     x, aux = pld_gate(self.make_rng("pld"), x_in, x, aux,
                                       float(i))

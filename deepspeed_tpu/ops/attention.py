@@ -9,6 +9,11 @@ Capability slot of the reference's attention kernel families:
   "reference" — pure jnp (always available, used as the parity oracle in tests)
   "flash"     — Pallas TPU flash-attention kernel (ops/pallas/flash_attention.py)
   "auto"      — flash on TPU, reference elsewhere
+
+The flash kernel handles boolean masks (padding and full tiles), ALiBi via
+per-head slopes, causal sliding windows, and logit softcap IN-KERNEL (fwd and
+bwd), so those regimes ride the flash path. Attention dropout and generic
+additive biases are the documented fallbacks to the jnp reference.
 """
 
 from __future__ import annotations
@@ -33,6 +38,30 @@ def apply_softcap(x, cap: float):
     Single definition — used for attention scores (here and the decode
     path) and final LM logits (transformer head, decode head)."""
     return (jnp.tanh(x.astype(jnp.float32) / cap) * cap)
+
+
+def alibi_bias_from_slopes(slopes, q_len: int, k_len: int) -> jnp.ndarray:
+    """[H] per-head slopes -> [1, H, q_len, k_len] additive ALiBi bias,
+    last-query-aligned (q positions arange + k_len - q_len, the decode
+    offset convention shared with causal_mask). The dense counterpart of
+    the flash kernel's in-kernel slope * (k - q) term — only the fallback
+    paths materialize it."""
+    sl = jnp.asarray(slopes, jnp.float32).reshape(-1)
+    q_pos = jnp.arange(q_len) + (k_len - q_len)
+    k_pos = jnp.arange(k_len)
+    dist = (k_pos[None, :] - q_pos[:, None]).astype(jnp.float32)
+    return sl[None, :, None, None] * dist[None, None]
+
+
+def window_mask(q_len: int, k_len: int, window) -> jnp.ndarray:
+    """[1, 1, q_len, k_len] bool sliding-window mask (True = attend):
+    q_pos - k_pos < window, q positions last-row-aligned (arange +
+    k_len - q_len, the same offset convention as causal_mask). The dense
+    counterpart of the flash kernel's in-kernel window — only fallback
+    paths materialize it."""
+    q_pos = jnp.arange(q_len)[:, None] + (k_len - q_len)
+    k_pos = jnp.arange(k_len)[None, :]
+    return (q_pos - k_pos < window)[None, None]
 
 
 def mha_reference(q: jnp.ndarray,
@@ -79,10 +108,11 @@ def sliding_window_attention(q, k, v, window: int, *,
                              sm_scale: Optional[float] = None,
                              interpret: bool = False) -> jnp.ndarray:
     """Causal sliding-window attention on the block-skip kernel: the layout
-    visits only blocks intersecting the window (compute scales with window,
-    not seq) and the kernel applies the EXACT per-token window in-block —
-    same numerics as the dense (q_pos - k_pos < window) mask. Raises when
-    shapes can't tile; callers fall back to the dense-mask path."""
+    visits only blocks intersecting the window (compute AND K/V DMA scale
+    with window, not seq) and the kernel applies the EXACT per-token window
+    in-block — same numerics as the dense (q_pos - k_pos < window) mask.
+    Raises when shapes can't tile; callers fall back to the flash kernel's
+    in-kernel window (MXU skip only) and then the dense-mask path."""
     from .pallas.block_sparse_attention import block_sparse_flash_attention
     from .sparse_attention import LocalSlidingWindowSparsityConfig
     B, H, S, D = q.shape
@@ -106,6 +136,7 @@ def attention(q: jnp.ndarray,
               causal: bool = True,
               bias: Optional[jnp.ndarray] = None,
               mask: Optional[jnp.ndarray] = None,
+              alibi_slopes=None,
               sm_scale: Optional[float] = None,
               dropout_rate: float = 0.0,
               dropout_rng: Optional[jax.Array] = None,
@@ -113,41 +144,48 @@ def attention(q: jnp.ndarray,
               block_q: int = 1024,
               block_k: int = 1024,
               window: int = 0,
-              softcap: float = 0.0) -> jnp.ndarray:
+              softcap: float = 0.0,
+              interpret: bool = False) -> jnp.ndarray:
     """Dispatching attention entry point. Shapes: [batch, heads, seq, head_dim].
 
-    ``window`` > 0 (with causal=True, no mask/bias/dropout) routes to the
-    block-skip sliding-window kernel on TPU. The window must be a STATIC
-    python int for the kernel route — model paths that trace it (the
-    scanned-layers transformer, whose per-layer window is a scan element)
-    compose it into the dense mask instead; windows <= 0 mean global."""
-    # softcap has no flash/block-skip kernel path: honor it on the exact
-    # reference impl rather than silently dropping it
-    needs_reference = (bias is not None or mask is not None
-                       or dropout_rate > 0.0 or softcap > 0.0)
-    window = 0 if window is None or window <= 0 else window
-    if window and causal and not needs_reference and \
-            jax.default_backend() == "tpu" and impl in ("auto", "flash"):
+    Kernel-capable regimes (flash path, in-kernel fwd+bwd): boolean ``mask``
+    (padding or full), ``alibi_slopes`` ([H] per-head slopes — pass these
+    instead of a materialized alibi ``bias``), causal ``window`` > 0, and
+    ``softcap``. Attention dropout and generic additive ``bias`` fall back
+    to the exact jnp reference (documented, warned under impl="flash").
+
+    ``window`` must be a STATIC python int for the kernel routes — model
+    paths that trace it (e.g. per-layer windows as scan elements) compose it
+    into the dense mask instead; windows <= 0 mean global. A pure sliding
+    window (no other features) prefers the block-skip layout kernel, which
+    also skips the K/V DMA of out-of-window blocks.
+    """
+    window = 0 if window is None or window <= 0 else int(window)
+    # the flash kernel covers mask/alibi/window/softcap; dropout and generic
+    # additive biases have no kernel path — honor them on the reference impl
+    # rather than silently dropping them
+    kernel_capable = (dropout_rate == 0.0 and bias is None
+                      and (window == 0 or causal))
+    on_tpu = jax.default_backend() == "tpu"
+    pure_window = (window and causal and mask is None and bias is None
+                   and alibi_slopes is None and softcap == 0.0
+                   and dropout_rate == 0.0)
+    if pure_window and on_tpu and impl in ("auto", "flash"):
         try:
             return sliding_window_attention(q, k, v, window,
-                                            sm_scale=sm_scale)
+                                            sm_scale=sm_scale,
+                                            interpret=interpret)
         except ValueError:
-            pass        # shapes don't tile — dense mask below
-    if window:
-        S = q.shape[-2]
-        q_pos = jnp.arange(S)[:, None]
-        k_pos = jnp.arange(S)[None, :]
-        wmask = (q_pos - k_pos < window)[None, None]
-        mask = wmask if mask is None else mask & wmask
-        needs_reference = True
+            pass        # shapes don't tile — flash in-kernel window below
     if impl == "auto":
-        on_tpu = jax.default_backend() == "tpu"
-        impl = "flash" if (on_tpu and not needs_reference) else "reference"
+        impl = "flash" if (on_tpu and kernel_capable) else "reference"
     if impl in ("ring", "ulysses"):
-        if needs_reference:
+        if mask is not None or bias is not None or alibi_slopes is not None \
+                or dropout_rate > 0.0 or window or softcap:
             from ..utils.logging import logger
             logger.warning(f"attention impl='{impl}' does not support "
-                           "mask/bias/dropout; falling back to reference")
+                           "mask/bias/window/softcap/dropout; falling back "
+                           "to reference")
             impl = "reference"
         else:
             from ..parallel.ring_attention import (ring_attention,
@@ -155,17 +193,26 @@ def attention(q: jnp.ndarray,
             fn = ring_attention if impl == "ring" else ulysses_attention
             return fn(q, k, v, causal=causal, sm_scale=sm_scale)
     if impl == "flash":
-        if needs_reference:
-            # the flash kernel has no mask/bias/dropout path yet — honor the
-            # arguments rather than silently dropping them
+        if not kernel_capable:
             from ..utils.logging import logger
-            logger.warning("attention impl='flash' does not support "
-                           "mask/bias/dropout; falling back to reference")
+            logger.warning("attention impl='flash' has no kernel path for "
+                           "dropout / generic bias / non-causal windows; "
+                           "falling back to reference")
             impl = "reference"
         else:
             from .pallas.flash_attention import flash_attention
             return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                                   block_q=block_q, block_k=block_k)
+                                   mask=mask, alibi_slopes=alibi_slopes,
+                                   window=window, softcap=softcap,
+                                   block_q=block_q, block_k=block_k,
+                                   interpret=interpret)
+    # reference: materialize what the kernel computes from indices
+    if alibi_slopes is not None:
+        ali = alibi_bias_from_slopes(alibi_slopes, q.shape[-2], k.shape[-2])
+        bias = ali if bias is None else bias + ali
+    if window:
+        wmask = window_mask(q.shape[-2], k.shape[-2], window)
+        mask = wmask if mask is None else mask & wmask
     return mha_reference(q, k, v, causal=causal, bias=bias, mask=mask,
                          sm_scale=sm_scale, dropout_rate=dropout_rate,
                          dropout_rng=dropout_rng, softcap=softcap)

@@ -267,15 +267,16 @@ def _fwd(q3, k3, v3, lay8, cnt, idx, maxk, H, causal, sm_scale, block_q,
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
     )
-    o, lse = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
-            jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
-        ],
-        interpret=interpret,
-    )(cnt, idx, lay8, q3, k3, v3)
+    with jax.named_scope("block_sparse_attention_fwd"):
+        o, lse = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+                jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
+            ],
+            interpret=interpret,
+        )(cnt, idx, lay8, q3, k3, v3)
     return o, lse
 
 
@@ -307,15 +308,16 @@ def _bwd(q3, k3, v3, o3, do3, lse, lay8, sched, H, causal, sm_scale, block_q,
             pl.BlockSpec((1, block_q, D), lambda b, i, j, c, x: (b, i, 0))],
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
     )
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, H=H, nq=nq, maxk=maxk,
-                          sm_scale=sm_scale, causal=causal, block_q=block_q,
-                          block_k=block_k, fine=fine, window=window,
-                          layout_exact=layout_exact),
-        grid_spec=grid_dq,
-        out_shape=[jax.ShapeDtypeStruct((BH, S, D), q3.dtype)],
-        interpret=interpret,
-    )(cnt, idx, lay8, q3, k3, v3, do3, lse, delta)[0]
+    with jax.named_scope("block_sparse_attention_bwd_dq"):
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, H=H, nq=nq, maxk=maxk,
+                              sm_scale=sm_scale, causal=causal,
+                              block_q=block_q, block_k=block_k, fine=fine,
+                              window=window, layout_exact=layout_exact),
+            grid_spec=grid_dq,
+            out_shape=[jax.ShapeDtypeStruct((BH, S, D), q3.dtype)],
+            interpret=interpret,
+        )(cnt, idx, lay8, q3, k3, v3, do3, lse, delta)[0]
 
     # dkv: grid over k blocks x active q blocks (transposed lists); every
     # q-side tensor (q, do, lse, delta) and the layout rows are fetched via
@@ -347,16 +349,17 @@ def _bwd(q3, k3, v3, o3, do3, lse, lay8, sched, H, causal, sm_scale, block_q,
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
     )
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, H=H, nk=nk, maxq=maxq,
-                          sm_scale=sm_scale, causal=causal, block_q=block_q,
-                          block_k=block_k, fine=fine, window=window,
-                          layout_exact=layout_exact),
-        grid_spec=grid_dkv,
-        out_shape=[jax.ShapeDtypeStruct((BH, S, D), k3.dtype),
-                   jax.ShapeDtypeStruct((BH, S, D), v3.dtype)],
-        interpret=interpret,
-    )(cnt_t, idx_t, lay8, q3, k3, v3, do3, lse, delta)
+    with jax.named_scope("block_sparse_attention_bwd_dkv"):
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, H=H, nk=nk, maxq=maxq,
+                              sm_scale=sm_scale, causal=causal,
+                              block_q=block_q, block_k=block_k, fine=fine,
+                              window=window, layout_exact=layout_exact),
+            grid_spec=grid_dkv,
+            out_shape=[jax.ShapeDtypeStruct((BH, S, D), k3.dtype),
+                       jax.ShapeDtypeStruct((BH, S, D), v3.dtype)],
+            interpret=interpret,
+        )(cnt_t, idx_t, lay8, q3, k3, v3, do3, lse, delta)
     return dq, dk, dv
 
 

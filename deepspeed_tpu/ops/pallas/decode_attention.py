@@ -177,11 +177,12 @@ def decode_attention(q: jnp.ndarray,
             pltpu.VMEM((hg, Tp, 128), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
-        partial(_kernel, hg=hg, Tp=Tp, block_k=block_k, nk=nk, sm_scale=scale,
-                stacked=stacked),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B * ng, hg, Tp, hd), q.dtype),
-        interpret=interpret,
-    )(scal, qf, kf, vf)
+    with jax.named_scope("decode_attention"):
+        out = pl.pallas_call(
+            partial(_kernel, hg=hg, Tp=Tp, block_k=block_k, nk=nk,
+                    sm_scale=scale, stacked=stacked),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B * ng, hg, Tp, hd), q.dtype),
+            interpret=interpret,
+        )(scal, qf, kf, vf)
     return out.reshape(B, nh, Tp, hd)[:, :, :T]

@@ -1,5 +1,6 @@
 """graftlint unit tests: one true-positive and one true-negative fixture
-per rule (TPU001–TPU007), plus suppression, baseline and self-lint tests.
+per rule (TPU001–TPU007, TPU010), plus suppression, baseline and self-lint
+tests.
 
 Fixtures are source snippets linted in-memory through a temp file — the
 linter is AST-only, so none of this imports JAX or touches devices.
@@ -414,9 +415,57 @@ def test_baseline_entries_carry_justification():
 
 def test_rule_registry_complete():
     assert {"TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
-            "TPU007"} <= set(RULES)
+            "TPU007", "TPU010"} <= set(RULES)
     for code, rule in RULES.items():
         assert rule.summary and rule.name, code
+
+
+# --------------------------------------------------------------------- TPU010
+
+def test_tpu010_positive_unscoped_pallas_call(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def launch(x, kernel, spec):
+            return pl.pallas_call(kernel, out_shape=spec)(x)
+    """)
+    (f,) = [f for f in findings if f.rule == "TPU010"]
+    assert f.severity == Severity.WARNING
+    assert f.symbol == "launch"
+    assert "named_scope" in f.message
+
+
+def test_tpu010_positive_scope_not_lexical(tmp_path):
+    """A named_scope in the CALLER does not cover the launching function."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def _launch(x, kernel, spec):
+            return pl.pallas_call(kernel, out_shape=spec)(x)
+
+        def entry(x, kernel, spec):
+            with jax.named_scope("my_kernel"):
+                return _launch(x, kernel, spec)
+    """)
+    assert "TPU010" in codes(findings)
+
+
+def test_tpu010_negative_with_scope(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def launch(x, kernel, spec):
+            with jax.named_scope("my_kernel"):
+                return pl.pallas_call(kernel, out_shape=spec)(x)
+
+        @jax.named_scope("decorated_kernel")
+        def launch2(x, kernel, spec):
+            return pl.pallas_call(kernel, out_shape=spec)(x)
+    """)
+    assert "TPU010" not in codes(findings)
 
 
 def test_cli_json_format(tmp_path):

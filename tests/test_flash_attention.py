@@ -86,3 +86,241 @@ def test_bf16_forward_close():
     ref = mha_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32), rtol=5e-2, atol=5e-2)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel masks / alibi / sliding window / softcap (fwd + bwd parity)
+# ---------------------------------------------------------------------------
+
+from deepspeed_tpu.ops.attention import alibi_bias_from_slopes
+from deepspeed_tpu.models.transformer import alibi_slopes
+
+
+def padding_mask(rng, B, S, min_len):
+    """Ragged [B, 1, 1, S] key-padding mask with random per-sample lengths."""
+    lens = rng.integers(min_len, S + 1, size=(B,))
+    return jnp.asarray(np.arange(S)[None, :] < lens[:, None])[:, None, None, :]
+
+
+def assert_grad_parity(loss_flash, loss_ref, q, k, v, rtol=5e-4, atol=5e-4):
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                                   err_msg=f"d{name} mismatch")
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_padding_mask_parity(causal, seed):
+    """Ragged key-padding masks across several random patterns, fwd + bwd."""
+    rng = np.random.default_rng(seed)
+    q, k, v = make_qkv(rng, (3, 2, 128, 32))
+    mask = padding_mask(rng, 3, 128, min_len=16 + seed * 7)
+    fa = functools.partial(flash_attention, causal=causal, mask=mask,
+                           block_q=64, block_k=64, interpret=True)
+    ref = functools.partial(mha_reference, causal=causal, mask=mask)
+    np.testing.assert_allclose(fa(q, k, v), ref(q, k, v),
+                               rtol=2e-4, atol=2e-4)
+    assert_grad_parity(lambda *a: jnp.sum(fa(*a) ** 2),
+                       lambda *a: jnp.sum(ref(*a) ** 2), q, k, v)
+
+
+def test_full_qk_mask_parity():
+    """Arbitrary [B, 1, S, S] boolean mask (per-block tiles in-kernel)."""
+    rng = np.random.default_rng(4)
+    q, k, v = make_qkv(rng, (2, 2, 128, 32))
+    m = jnp.asarray(rng.random((2, 1, 128, 128)) > 0.3)
+    m = m | jnp.eye(128, dtype=bool)[None, None]     # >=1 active key per row
+    fa = functools.partial(flash_attention, causal=False, mask=m,
+                           block_q=64, block_k=64, interpret=True)
+    ref = functools.partial(mha_reference, causal=False, mask=m)
+    np.testing.assert_allclose(fa(q, k, v), ref(q, k, v),
+                               rtol=2e-4, atol=2e-4)
+    assert_grad_parity(lambda *a: jnp.sum(fa(*a) ** 2),
+                       lambda *a: jnp.sum(ref(*a) ** 2), q, k, v)
+
+
+def test_per_head_mask_parity():
+    rng = np.random.default_rng(5)
+    q, k, v = make_qkv(rng, (1, 4, 64, 32))
+    m = jnp.asarray(rng.random((1, 4, 64, 64)) > 0.4)
+    m = m | jnp.eye(64, dtype=bool)[None, None]
+    out = flash_attention(q, k, v, causal=True, mask=m, block_q=32,
+                          block_k=32, interpret=True)
+    ref = mha_reference(q, k, v, causal=True, mask=m)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fully_masked_rows_zero():
+    """Rows with zero active keys: kernel returns 0 output and 0 grads (the
+    jnp reference degenerates to uniform weights there — documented
+    divergence; real padding layouts never produce such rows)."""
+    rng = np.random.default_rng(6)
+    q, k, v = make_qkv(rng, (2, 2, 64, 32))
+    m = np.ones((2, 1, 1, 64), bool)
+    m[1] = False                                    # sample 1: all keys dead
+    m = jnp.asarray(m)
+    fa = functools.partial(flash_attention, causal=False, mask=m,
+                           block_q=32, block_k=32, interpret=True)
+    out = fa(q, k, v)
+    assert np.allclose(np.asarray(out)[1], 0.0)
+    g = jax.grad(lambda *a: jnp.sum(fa(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for t in g:
+        assert np.allclose(np.asarray(t)[1], 0.0)
+
+
+def test_fully_masked_rows_zero_qk_mask_path():
+    """Same zero-rows contract on the per-tile (qk) mask path: a
+    bidirectional padding mask valid[q] & valid[k] leaves padded QUERY rows
+    with zero active keys inside otherwise-live tiles. The kernel's fwd
+    must produce zeros there (not the degenerate uniform weights) so the
+    bwd — which zeroes the same entries — is the true gradient of the fwd;
+    valid rows still match the reference exactly."""
+    rng = np.random.default_rng(16)
+    S, n_valid = 64, 50
+    q, k, v = make_qkv(rng, (1, 1, S, 32))
+    valid = np.arange(S) < n_valid
+    m = jnp.asarray(valid[:, None] & valid[None, :])[None, None]
+    fa = functools.partial(flash_attention, causal=False, mask=m,
+                           block_q=32, block_k=32, interpret=True)
+    out = fa(q, k, v)
+    ref = mha_reference(q, k, v, causal=False, mask=m)
+    assert np.allclose(np.asarray(out)[:, :, n_valid:], 0.0)
+    np.testing.assert_allclose(np.asarray(out)[:, :, :n_valid],
+                               np.asarray(ref)[:, :, :n_valid],
+                               rtol=2e-4, atol=2e-4)
+    # kernel loss over ALL rows == loss over valid rows (dead rows are 0);
+    # the reference oracle must exclude its dead-row uniform outputs
+    g = jax.grad(lambda *a: jnp.sum(fa(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(mha_reference(
+        *a, causal=False, mask=m)[:, :, :n_valid] ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    # dead query rows contribute nothing anywhere
+    assert np.allclose(np.asarray(g[0])[:, :, n_valid:], 0.0)
+    for a, b, name in zip(g, gr, "qkv"):
+        np.testing.assert_allclose(a, b, rtol=5e-4, atol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_alibi_parity(causal):
+    """Per-head-slope alibi bias rebuilt from block indices in-kernel."""
+    rng = np.random.default_rng(7)
+    H = 4
+    q, k, v = make_qkv(rng, (2, H, 128, 32))
+    sl = alibi_slopes(H)
+    fa = functools.partial(flash_attention, causal=causal, alibi_slopes=sl,
+                           block_q=64, block_k=64, interpret=True)
+    ref = functools.partial(mha_reference, causal=causal,
+                            bias=alibi_bias_from_slopes(sl, 128, 128))
+    np.testing.assert_allclose(fa(q, k, v), ref(q, k, v),
+                               rtol=2e-4, atol=2e-4)
+    assert_grad_parity(lambda *a: jnp.sum(fa(*a) ** 2),
+                       lambda *a: jnp.sum(ref(*a) ** 2), q, k, v)
+
+
+@pytest.mark.parametrize("window", [16, 48, 200])
+def test_sliding_window_parity(window):
+    """Causal sliding window: block-level skip + exact per-token boundary."""
+    rng = np.random.default_rng(8)
+    q, k, v = make_qkv(rng, (1, 2, 128, 32))
+    q_pos = np.arange(128)[:, None]
+    k_pos = np.arange(128)[None, :]
+    wmask = jnp.asarray(q_pos - k_pos < window)[None, None]
+    fa = functools.partial(flash_attention, causal=True, window=window,
+                           block_q=32, block_k=32, interpret=True)
+    ref = functools.partial(mha_reference, causal=True, mask=wmask)
+    np.testing.assert_allclose(fa(q, k, v), ref(q, k, v),
+                               rtol=2e-4, atol=2e-4)
+    assert_grad_parity(lambda *a: jnp.sum(fa(*a) ** 2),
+                       lambda *a: jnp.sum(ref(*a) ** 2), q, k, v)
+
+
+@pytest.mark.parametrize("cap", [5.0, 30.0])
+def test_softcap_parity(cap):
+    """Gemma-2 tanh softcap pre-softmax; bwd threads the tanh derivative."""
+    rng = np.random.default_rng(9)
+    q, k, v = make_qkv(rng, (2, 2, 128, 32))
+    fa = functools.partial(flash_attention, causal=True, softcap=cap,
+                           block_q=64, block_k=64, interpret=True)
+    ref = functools.partial(mha_reference, causal=True, softcap=cap)
+    np.testing.assert_allclose(fa(q, k, v), ref(q, k, v),
+                               rtol=2e-4, atol=2e-4)
+    assert_grad_parity(lambda *a: jnp.sum(fa(*a) ** 2),
+                       lambda *a: jnp.sum(ref(*a) ** 2), q, k, v)
+
+
+def test_combined_mask_alibi_softcap_window():
+    """All in-kernel features composed at once (BLOOM+Gemma2+Mistral union)."""
+    rng = np.random.default_rng(10)
+    H, S, W = 4, 128, 96
+    q, k, v = make_qkv(rng, (2, H, S, 32))
+    mask = padding_mask(rng, 2, S, min_len=32)
+    sl = alibi_slopes(H)
+    q_pos = np.arange(S)[:, None]
+    k_pos = np.arange(S)[None, :]
+    wmask = jnp.asarray(q_pos - k_pos < W)[None, None]
+    fa = functools.partial(flash_attention, causal=True, mask=mask,
+                           alibi_slopes=sl, window=W, softcap=20.0,
+                           block_q=32, block_k=32, interpret=True)
+    ref = functools.partial(mha_reference, causal=True, mask=mask & wmask,
+                            bias=alibi_bias_from_slopes(sl, S, S),
+                            softcap=20.0)
+    np.testing.assert_allclose(fa(q, k, v), ref(q, k, v),
+                               rtol=2e-4, atol=2e-4)
+    assert_grad_parity(lambda *a: jnp.sum(fa(*a) ** 2),
+                       lambda *a: jnp.sum(ref(*a) ** 2), q, k, v)
+
+
+def test_masked_cross_length_offset():
+    """Sk > S (decode prefill shape) with a key mask + alibi: the offset
+    convention (last q row sees all keys) must hold for every feature."""
+    rng = np.random.default_rng(11)
+    H = 2
+    q, _, _ = make_qkv(rng, (1, H, 64, 32))
+    _, k, v = make_qkv(rng, (1, H, 192, 32))
+    mask = padding_mask(rng, 1, 192, min_len=100)
+    sl = alibi_slopes(H)
+    out = flash_attention(q, k, v, causal=True, mask=mask, alibi_slopes=sl,
+                          block_q=32, block_k=32, interpret=True)
+    ref = mha_reference(q, k, v, causal=True, mask=mask,
+                        bias=alibi_bias_from_slopes(sl, 64, 192))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("shape", [(1, 2, 100, 24), (2, 1, 144, 32)])
+def test_nondivisible_shapes_with_features(shape):
+    """Non-divisible block shapes: seq 100 can't tile (reference fallback),
+    seq 144 snaps to 48-blocks and stays on the kernel — identical numerics
+    either way, with mask+alibi+softcap active."""
+    rng = np.random.default_rng(12)
+    B, H, S, D = shape
+    q, k, v = make_qkv(rng, shape)
+    mask = padding_mask(rng, B, S, min_len=S // 2)
+    sl = alibi_slopes(H)
+    out = flash_attention(q, k, v, causal=True, mask=mask, alibi_slopes=sl,
+                          softcap=15.0, interpret=True)
+    ref = mha_reference(q, k, v, causal=True, mask=mask,
+                        bias=alibi_bias_from_slopes(sl, S, S), softcap=15.0)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+    assert_grad_parity(
+        lambda *a: jnp.sum(flash_attention(
+            *a, causal=True, mask=mask, alibi_slopes=sl, softcap=15.0,
+            interpret=True) ** 2),
+        lambda *a: jnp.sum(mha_reference(
+            *a, causal=True, mask=mask,
+            bias=alibi_bias_from_slopes(sl, S, S), softcap=15.0) ** 2),
+        q, k, v)
+
+
+def test_bf16_masked_softcap_close():
+    rng = np.random.default_rng(13)
+    q, k, v = make_qkv(rng, (2, 2, 128, 64), jnp.bfloat16)
+    mask = padding_mask(rng, 2, 128, min_len=48)
+    out = flash_attention(q, k, v, causal=False, mask=mask, softcap=8.0,
+                          block_q=64, block_k=64, interpret=True)
+    ref = mha_reference(q, k, v, causal=False, mask=mask, softcap=8.0)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
