@@ -1,4 +1,4 @@
-"""graftlint rules TPU001–TPU007, TPU010.
+"""graftlint rules TPU001–TPU008, TPU010.
 
 Each rule targets one class of bug that regresses the gas-amortized train
 step silently: the bench still runs, just slower (host syncs, retraces)
@@ -557,6 +557,67 @@ class TracerBranchRule(Rule):
             if isinstance(n, ast.Name) and n.id in arrayish:
                 return f"'{n.id}'"
         return None
+
+
+@register
+class ShardingSpecDriftRule(Rule):
+    """TPU008 — sharding-constraint drift: non-canonical PartitionSpecs.
+
+    The compiler's canonical output form for a spec drops trailing
+    ``None`` entries, unwraps single-name tuples and never names size-1
+    axes. A ``with_sharding_constraint`` / ``NamedSharding`` built from a
+    non-canonical literal denotes the SAME placement but is a DIFFERENT
+    jit cache key than what XLA emits for the step's outputs — the
+    mismatch costs a spurious retrace of the whole program (caught live in
+    PR 1: size-1-axis specs retraced the train step on step 2; the
+    canonicalize_spec fix in runtime/zero/stages.py is the idiom). The
+    statically detectable drift: trailing ``None`` entries, single-name
+    tuple entries, and empty-tuple entries in P(...) literals passed to a
+    constraint site.
+    """
+
+    code = "TPU008"
+    name = "sharding-spec-drift"
+    severity = Severity.WARNING
+    summary = "non-canonical PartitionSpec at a sharding-constraint site"
+
+    _SITES = {"jax.lax.with_sharding_constraint",
+              "jax.sharding.NamedSharding",
+              "jax.experimental.pjit.with_sharding_constraint"}
+    _SPECS = {"jax.sharding.PartitionSpec",
+              "jax.interpreters.pxla.PartitionSpec"}
+
+    def _drift(self, module: ModuleInfo, spec: ast.Call) -> Optional[str]:
+        args = spec.args
+        if args and isinstance(args[-1], ast.Constant) \
+                and args[-1].value is None:
+            return "trailing None entries (canonical form strips them)"
+        for a in args:
+            if isinstance(a, ast.Tuple) and len(a.elts) == 1:
+                return (f"single-name tuple entry {ast.unparse(a)} "
+                        "(canonical form unwraps it)")
+            if isinstance(a, ast.Tuple) and not a.elts:
+                return "empty-tuple entry (canonical form is None)"
+        return None
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in module.all_calls:
+            if _qual(module, node.func) not in self._SITES:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call) or \
+                        _qual(module, sub.func) not in self._SPECS:
+                    continue
+                why = self._drift(module, sub)
+                if why:
+                    yield self.finding(
+                        module, sub,
+                        f"non-canonical PartitionSpec at a constraint "
+                        f"site: {why}; the spec names the same sharding "
+                        "as its canonical form but is a different jit "
+                        "cache key — a spurious retrace. Canonicalize "
+                        "(drop trailing Nones / unwrap 1-tuples) or pass "
+                        "through canonicalize_spec")
 
 
 @register

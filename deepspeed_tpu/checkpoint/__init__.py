@@ -6,10 +6,10 @@ machinery — largely mooted here because checkpoints are name-keyed whole
 tensors, topology-free by construction).
 """
 
-from .engine import (AsyncCheckpointEngine, CheckpointEngine,
+from .engine import (AsyncCheckpointEngine, CheckpointEngine, CommitResult,
                      NpzCheckpointEngine, build_checkpoint_engine)
 from .universal import DeepSpeedCheckpoint, inspect_checkpoint
 
 __all__ = ["CheckpointEngine", "NpzCheckpointEngine", "AsyncCheckpointEngine",
-           "build_checkpoint_engine", "DeepSpeedCheckpoint",
+           "CommitResult", "build_checkpoint_engine", "DeepSpeedCheckpoint",
            "inspect_checkpoint"]

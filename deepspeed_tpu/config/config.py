@@ -300,10 +300,26 @@ class CheckpointConfig(DeepSpeedConfigModel):
     use_node_local_storage: bool = False
     parallel_write: Dict[str, Any] = Field(default_factory=dict)
     async_save: bool = False   # TPU-native: orbax-style async checkpointing
+    # -- crash-safety knobs (TPU-native; see docs/RESILIENCE.md) ------------
+    keep_last: Optional[int] = None   # retention: keep newest K tags (None = all)
+    keep_every: int = 0               # + every tag whose step % keep_every == 0
+    write_retries: int = 3            # async writer: transient-IO retries
+    write_retry_backoff: float = 0.05  # exponential-backoff base, seconds
+    verify_load: bool = True          # digest-verify tags at load/rollback
 
 
 class DataTypesConfig(DeepSpeedConfigModel):
     grad_accum_dtype: Optional[str] = None
+
+
+class NonFiniteGuardConfig(DeepSpeedConfigModel):
+    """TPU-native: bf16 runs have no loss scaler, but the train step already
+    skips-and-counts non-finite updates in-jit (TrainState.nonfinite_streak).
+    ``abort_after``: raise after N CONSECUTIVE non-finite steps (0 = never).
+    The host check rides the existing batched `_after_step` metrics pull, so
+    detection latency is `steps_per_print` steps and the hot path gains no
+    extra device sync."""
+    abort_after: int = 0
 
 
 class ProgressiveLayerDropConfig(DeepSpeedConfigModel):
@@ -425,6 +441,8 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     quantize_training: Dict[str, Any] = Field(default_factory=dict)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
+    nonfinite_guard: NonFiniteGuardConfig = Field(
+        default_factory=NonFiniteGuardConfig)
     dataloader_drop_last: bool = False
     nebula: NebulaConfig = Field(default_factory=NebulaConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)

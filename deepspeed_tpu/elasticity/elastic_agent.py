@@ -9,6 +9,12 @@ mutate), the agent polls it, and on change — or on worker crash, up to
 re-deriving the elastic batch config (elasticity.compute_elastic_config's
 HCN math) for the new node count. Training resumes from the engine's own
 checkpoints (topology-free by construction).
+
+Preemption contract (round-3): a worker that exits with
+:data:`PREEMPTION_EXIT_CODE` — what ``engine.install_preemption_handler``
+does after its emergency save — is a RESUME, not a crash: the agent
+relaunches immediately and does NOT count it against ``max_restarts``
+(TPU preemptions at multi-host scale would exhaust any budget).
 """
 
 from __future__ import annotations
@@ -23,6 +29,11 @@ from ..utils.logging import log_dist, logger
 
 MEMBERSHIP_CHANGED = object()       # monitor sentinel; never equals an rc
 
+#: Exit code meaning "I was preempted but checkpointed; relaunch me and
+#: don't count this against max_restarts". Chosen outside the shell's
+#: conventional 126-165 signal range and Python's 0-2.
+PREEMPTION_EXIT_CODE = 114
+
 
 class DSElasticAgent:
     def __init__(self,
@@ -30,19 +41,39 @@ class DSElasticAgent:
                  hostfile: str,
                  max_restarts: int = 100,
                  check_interval: float = 1.0,
-                 min_nodes: int = 1):
-        """launch_fn(active_hosts) -> Popen for one training run."""
+                 min_nodes: int = 1,
+                 confirm_polls: int = 2):
+        """launch_fn(active_hosts) -> Popen for one training run.
+
+        ``confirm_polls``: how many CONSECUTIVE identical polls must agree
+        before a hostfile difference counts as a membership change — an
+        atomic rewrite of the hostfile mid-poll (truncate+write, or a brief
+        unlink during rename) must not look like a rescale."""
         self.launch_fn = launch_fn
         self.hostfile = hostfile
         self.max_restarts = max_restarts
         self.check_interval = check_interval
         self.min_nodes = min_nodes
+        self.confirm_polls = max(1, confirm_polls)
         self.restarts = 0
         self.membership_changes = 0
+        self.preemptions = 0
 
     def _members(self) -> List[str]:
-        pool = fetch_hostfile(self.hostfile)
-        return list(pool) if pool else ["localhost"]
+        pool = self._read_members()
+        return pool if pool else ["localhost"]
+
+    def _read_members(self) -> Optional[List[str]]:
+        """Hostfile membership, or None on a transient failure (unreadable
+        or empty mid-rewrite) — callers must treat None as 'no evidence',
+        never as 'the cluster shrank to nothing'."""
+        try:
+            pool = fetch_hostfile(self.hostfile)
+        except (OSError, ValueError) as e:
+            logger.warning("elastic agent: transient hostfile read failure "
+                           "(%s); keeping current membership", e)
+            return None
+        return list(pool) if pool else None
 
     def run(self) -> int:
         """Supervise until a run exits 0 (or restarts are exhausted).
@@ -64,6 +95,14 @@ class DSElasticAgent:
             if rc is MEMBERSHIP_CHANGED:
                 self.membership_changes += 1
                 continue                      # membership change: relaunch
+            if rc == PREEMPTION_EXIT_CODE:
+                # graceful preemption: the worker checkpointed on SIGTERM
+                # and asked to be resumed — not a failure
+                self.preemptions += 1
+                log_dist(f"elastic agent: worker preempted (rc={rc}); "
+                         f"resuming (preemption {self.preemptions}, not "
+                         "counted against max_restarts)", ranks=[0])
+                continue
             self.restarts += 1
             if self.restarts > self.max_restarts:
                 logger.error("elastic agent: max_restarts exceeded (rc=%d)",
@@ -74,19 +113,36 @@ class DSElasticAgent:
         """Poll worker + membership. Returns the worker rc, or the
         MEMBERSHIP_CHANGED sentinel when the hostfile changed (a distinct
         object — a signal-killed worker's negative rc must count as a crash,
-        not a rescale)."""
+        not a rescale).
+
+        A candidate membership change must repeat for ``confirm_polls``
+        consecutive polls before it triggers a restart; transient states
+        (unreadable/empty hostfile, a half-written rewrite that happens to
+        parse) reset the confirmation counter."""
+        pending: Optional[List[str]] = None
+        agree = 0
         while True:
             rc = proc.poll()
             if rc is not None:
                 return rc
-            if self._members() != members:
-                log_dist("elastic agent: membership changed — restarting",
-                         ranks=[0])
-                proc.terminate()
-                try:
-                    proc.wait(timeout=30)
-                except subprocess.TimeoutExpired:
-                    proc.kill()
-                    proc.wait()
-                return MEMBERSHIP_CHANGED
+            observed = self._read_members()
+            if observed is None or observed == members:
+                pending, agree = None, 0
+            else:
+                if observed == pending:
+                    agree += 1
+                else:
+                    pending, agree = observed, 1
+                # checked on EVERY differing poll, including the first —
+                # confirm_polls=1 means restart on first confirmed read
+                if agree >= self.confirm_polls:
+                    log_dist("elastic agent: membership changed — restarting",
+                             ranks=[0])
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait()
+                    return MEMBERSHIP_CHANGED
             time.sleep(self.check_interval)

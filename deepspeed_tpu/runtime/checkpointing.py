@@ -1,4 +1,4 @@
-"""Checkpoint save/load for TrainState pytrees.
+"""Checkpoint save/load for TrainState pytrees — crash-safe by construction.
 
 Capability parity with the reference's checkpoint layer (engine.py:2712-3489 +
 runtime/checkpoint_engine/): tagged checkpoint dirs, a ``latest`` tag file,
@@ -11,6 +11,26 @@ Parameters are stored under their /-joined pytree paths — names, not partition
 indices — so a checkpoint written under one mesh/ZeRO topology loads under any
 other ("universal checkpoint by construction"; the reference needs the whole
 ``deepspeed/checkpoint/`` reshape machinery for this).
+
+Durability model (round-3: crash/preemption resilience):
+
+- every save writes into a ``<tag>.tmp`` staging dir; the final ``<tag>``
+  dir appears via one ``os.replace`` — a reader can never observe a
+  half-written tag;
+- ``ckpt_meta.json`` (sha256 + size per file, shard count) is written LAST
+  inside the staging dir, after the data files are fsync'd: a tag without
+  its completion marker is by definition not a checkpoint;
+- ``latest`` is rewritten atomically (tmp + replace) only after the tag is
+  published, so it can never reference a tag missing its marker;
+- ``load_checkpoint`` verifies the marker (and digests, by default) and on
+  a corrupt/partial tag walks back to the newest intact one, repairing
+  ``latest`` and logging what it skipped, instead of crashing;
+- a failed save's staging dir is quarantined to ``<tag>.failed`` so the
+  next save of the same tag starts clean.
+
+Every crash-critical stage carries a named chaos failpoint
+(``deepspeed_tpu.testing.chaos``) — see docs/RESILIENCE.md for the catalog
+and tests/test_chaos.py for the crash-at-every-stage matrix.
 """
 
 from __future__ import annotations
@@ -18,15 +38,21 @@ from __future__ import annotations
 import json
 import os
 import zipfile
-from typing import Any, Callable, Dict, Optional, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple, Union
 
 import jax
 import numpy as np
 
+from ..testing import chaos
 from ..utils.logging import logger
 from ..utils.partitioning import path_str
 
 LATEST_FILE = "latest"
+META_FILE = "meta.json"
+CKPT_META_FILE = "ckpt_meta.json"
+STAGING_SUFFIX = ".tmp"
+QUARANTINE_SUFFIX = ".failed"
+CKPT_FORMAT_VERSION = 1
 _DTYPES_KEY = "__dtypes__"
 
 try:
@@ -37,6 +63,12 @@ except ImportError:  # pragma: no cover - ml_dtypes ships with jax
 
 _NATIVE_DTYPES = (np.float32, np.float64, np.float16, np.int32, np.int64,
                   np.int8, np.uint8, np.uint16, np.bool_)
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """An explicitly requested tag failed verification (missing completion
+    marker, digest/size mismatch, missing shard files). Auto-resolution
+    (``tag=None``) never raises this — it rolls back instead."""
 
 
 def _gather_leaf(leaf) -> np.ndarray:
@@ -73,6 +105,7 @@ def write_flat_npz(flat: Dict[str, Union[np.ndarray, Callable]],
     from numpy.lib import format as npfmt
     dtypes: Dict[str, str] = {}
     with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED, allowZip64=True) as zf:
+        first = True
         for key, val in flat.items():
             arr = np.asarray(val() if callable(val) else val)
             if _BF16 is not None and arr.dtype == _BF16:
@@ -84,6 +117,12 @@ def write_flat_npz(flat: Dict[str, Union[np.ndarray, Callable]],
                 npfmt.write_array(f, np.ascontiguousarray(arr),
                                   allow_pickle=False)
             del arr
+            if first:
+                # fires after the first array so a raise/kill here leaves a
+                # TRUNCATED file — the hardest partial for a loader to spot
+                # without digests
+                chaos.failpoint("ckpt.write")
+                first = False
         meta = np.frombuffer(json.dumps(dtypes).encode(), dtype=np.uint8)
         with zf.open(_DTYPES_KEY + ".npy", "w") as f:
             npfmt.write_array(f, meta, allow_pickle=False)
@@ -264,116 +303,547 @@ def load_tree(path: str, like, shardings=None):
     return jax.tree.map(lambda arr, ref: restore(arr, ref), tree, like)
 
 
-def save_checkpoint(save_dir: str,
-                    tag: str,
-                    state,
-                    client_state: Optional[Dict[str, Any]] = None,
-                    master_aliases_params: bool = False,
-                    ckpt_engine=None) -> str:
-    """Write {save_dir}/{tag}/ with model+optim npz and metadata; update `latest`.
+# ---------------------------------------------------------------------------
+# Durability primitives: fsync, digests, completion marker, atomic publish
+# ---------------------------------------------------------------------------
 
-    ``master_aliases_params``: fp32 training stores params once (the master copy
-    IS the param tree); the alias is re-established at load.
-    ``ckpt_engine``: a checkpoint.engine.CheckpointEngine — async engines do
-    the file IO off-thread; `latest` lands only after the data is durable
-    (the async engine's single FIFO worker orders it behind the writes)."""
-    ckpt_dir = os.path.join(save_dir, tag)
-    optim_group = {"opt_state": state.opt_state}
-    if not master_aliases_params:
-        optim_group["master"] = state.master
-    if jax.process_count() > 1:
-        # sharded save: EVERY process writes its own addressable pieces
-        # (replica-0 dedup) through the configured checkpoint engine (async
-        # engines do the IO off-thread); a global barrier — FIFO-ordered
-        # behind the writes on each rank — gates rank 0's metadata+`latest`
-        # so `latest` never points at a partially-written checkpoint. No
-        # cross-process gather happens at all.
-        if ckpt_engine is None:
-            from ..checkpoint.engine import NpzCheckpointEngine
-            ckpt_engine = NpzCheckpointEngine()
-        os.makedirs(ckpt_dir, exist_ok=True)
-        ckpt_engine.create(tag)
-        p = jax.process_index()
-        # shard pieces are local host copies already (np.asarray of
-        # addressable shards) — safe to hand to an async writer thread
-        ckpt_engine.save(shard_flat_dict(state.params),
-                         os.path.join(ckpt_dir, f"model_states-shard{p}.npz"))
-        ckpt_engine.save(shard_flat_dict(optim_group),
-                         os.path.join(ckpt_dir, f"optim_states-shard{p}.npz"))
-        # the barrier + meta must run on the MAIN thread: a collective from
-        # an async writer thread could interleave with train-step
-        # collectives in different orders across ranks (deadlock), and the
-        # donated TrainState must be read before the next step consumes it.
-        # Async engines therefore drain here — multi-process saves are
-        # durable-on-return.
-        ok = ckpt_engine.commit(tag)
-        from jax.experimental import multihost_utils
-        # aggregate per-rank write success (the gather doubles as the
-        # durability barrier): `latest` must never advance onto a
-        # checkpoint any rank failed to write
-        flags = multihost_utils.process_allgather(
-            np.asarray([1 if ok is not False else 0], np.int32))
-        if int(np.min(flags)) == 0:
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so renames/creations inside it are durable; a
+    filesystem that can't fsync directories (some network mounts) degrades
+    to best-effort rather than failing the save."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def file_digest(path: str) -> str:
+    """Streaming sha256 of a file's bytes."""
+    import hashlib
+    chaos.failpoint("ckpt.digest")
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_completion_marker(stage_dir: str, num_shards: int = 1) -> None:
+    """Digest every data file in the staging dir, fsync them, then write
+    ``ckpt_meta.json`` LAST (tmp + atomic replace + dir fsync). The marker's
+    existence asserts "everything listed here was durable before I was"."""
+    files: Dict[str, Dict[str, Any]] = {}
+    for name in sorted(os.listdir(stage_dir)):
+        if name in (CKPT_META_FILE, CKPT_META_FILE + ".tmp"):
+            continue
+        path = os.path.join(stage_dir, name)
+        if not os.path.isfile(path):
+            continue
+        files[name] = {"sha256": file_digest(path),
+                       "size": os.path.getsize(path)}
+        _fsync_file(path)
+    marker = {"format_version": CKPT_FORMAT_VERSION,
+              "num_shards": num_shards,
+              "files": files}
+    chaos.failpoint("ckpt.marker")
+    tmp = os.path.join(stage_dir, CKPT_META_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(marker, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(stage_dir, CKPT_META_FILE))
+    _fsync_dir(stage_dir)
+
+
+def publish_tag(save_dir: str, tag: str) -> str:
+    """Atomically promote ``<tag>.tmp`` to ``<tag>``. An existing tag dir
+    (an overwrite-save of the same tag) is moved aside first so the final
+    rename is still a single atomic transition."""
+    stage = os.path.join(save_dir, tag + STAGING_SUFFIX)
+    final = os.path.join(save_dir, tag)
+    chaos.failpoint("ckpt.rename")
+    if os.path.isdir(final):
+        import shutil
+        old = final + ".replaced"
+        if os.path.isdir(old):
+            shutil.rmtree(old)
+        os.replace(final, old)
+        os.replace(stage, final)
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.replace(stage, final)
+    _fsync_dir(save_dir)
+    return final
+
+
+def write_latest(save_dir: str, tag: str) -> None:
+    """Atomic ``latest`` update: tmp file + fsync + replace + dir fsync —
+    a crash leaves either the old pointer or the new one, never a
+    truncated file."""
+    chaos.failpoint("ckpt.latest")
+    tmp = os.path.join(save_dir, LATEST_FILE + STAGING_SUFFIX)
+    with open(tmp, "w") as f:
+        f.write(tag)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(save_dir, LATEST_FILE))
+    _fsync_dir(save_dir)
+
+
+def quarantine_staging(stage_dir: str, reason: str = "") -> Optional[str]:
+    """Move a failed save's staging dir to ``<tag>.failed`` so the next
+    save of the same tag starts clean and the debris stays inspectable.
+    Never raises (this runs on failure paths)."""
+    try:
+        if not os.path.isdir(stage_dir):
+            return None
+        if os.path.exists(os.path.join(stage_dir, CKPT_META_FILE)):
+            # the marker is written LAST: its presence means every data
+            # file is durable and only the publish failed — leave the
+            # staging in place so the next load finishes the rename
+            # (_recover_interrupted_publishes) instead of discarding the
+            # newest checkpoint to the quarantine
             logger.error(
-                f"sharded checkpoint {ckpt_dir}: a rank's shard write "
-                "failed — leaving `latest` on the previous checkpoint")
-            return ckpt_dir
-        if jax.process_index() == 0:
-            _save_meta_and_latest(save_dir, ckpt_dir, tag, state,
-                                  client_state, master_aliases_params)
-        return ckpt_dir
-    if jax.process_index() != 0:
-        return ckpt_dir
-    if ckpt_engine is None:
-        from ..checkpoint.engine import NpzCheckpointEngine
-        ckpt_engine = NpzCheckpointEngine()
-    os.makedirs(ckpt_dir, exist_ok=True)
-    ckpt_engine.create(tag)
-    # async engines must not race donated device buffers: gather to host
-    # eagerly (leaf-wise), hand numpy to the writer thread
-    lazy = getattr(ckpt_engine, "wants_lazy", True)
-    ckpt_engine.save(_tree_to_flat_dict(state.params, lazy=lazy),
-                     os.path.join(ckpt_dir, "model_states.npz"))
-    ckpt_engine.save(_tree_to_flat_dict(optim_group, lazy=lazy),
-                     os.path.join(ckpt_dir, "optim_states.npz"))
-    _save_meta_and_latest(save_dir, ckpt_dir, tag, state, client_state,
-                          master_aliases_params, ckpt_engine=ckpt_engine)
-    return ckpt_dir
+                "checkpoint save failed (%s) AFTER its staging dir was "
+                "fully durable; leaving %s for publish recovery at the "
+                "next load", reason or "see prior log", stage_dir)
+            return None
+        base = (stage_dir[:-len(STAGING_SUFFIX)]
+                if stage_dir.endswith(STAGING_SUFFIX) else stage_dir)
+        dst = base + QUARANTINE_SUFFIX
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = f"{base}{QUARANTINE_SUFFIX}.{n}"
+        os.replace(stage_dir, dst)
+        logger.error("checkpoint save failed (%s): staging quarantined at %s",
+                     reason or "see prior log", dst)
+        return dst
+    except OSError as e:  # pragma: no cover - double fault
+        logger.error("could not quarantine %s: %s", stage_dir, e)
+        return None
 
 
-def _save_meta_and_latest(save_dir, ckpt_dir, tag, state, client_state,
-                          master_aliases_params, ckpt_engine=None) -> None:
-    meta = {
+# ---------------------------------------------------------------------------
+# Verification, tag enumeration, rollback, retention
+# ---------------------------------------------------------------------------
+
+def verify_tag(ckpt_dir: str, check_digests: bool = True) -> Optional[str]:
+    """``None`` when the tag is intact, else a human-readable reason.
+
+    Checks: readable ``meta.json``, completion marker present and readable,
+    every listed file present with the recorded size (and sha256 when
+    ``check_digests``), shard count consistent with the marker. Tags from
+    before the marker format (no ``ckpt_meta.json``) pass on a structural
+    check alone, with a warning — crash partials can't masquerade as them
+    because partials only ever live in ``.tmp``/``.failed`` dirs."""
+    import glob as _glob
+    if not os.path.isdir(ckpt_dir):
+        return "missing directory"
+    try:
+        with open(os.path.join(ckpt_dir, META_FILE)) as f:
+            json.load(f)
+    except (OSError, ValueError) as e:
+        return f"unreadable {META_FILE} ({e.__class__.__name__})"
+    marker_path = os.path.join(ckpt_dir, CKPT_META_FILE)
+    if not os.path.exists(marker_path):
+        has_model = (
+            os.path.exists(os.path.join(ckpt_dir, "model_states.npz"))
+            or _glob.glob(os.path.join(ckpt_dir, "model_states-shard*.npz")))
+        if not has_model:
+            return "no completion marker and no model_states data"
+        logger.warning(
+            "checkpoint %s predates the completion-marker format; loading "
+            "without digest verification", ckpt_dir)
+        return None
+    try:
+        with open(marker_path) as f:
+            marker = json.load(f)
+    except (OSError, ValueError) as e:
+        return f"unreadable {CKPT_META_FILE} ({e.__class__.__name__})"
+    files = marker.get("files", {})
+    if not any(n.startswith("model_states") for n in files):
+        # a marker that lists no model data (e.g. finalize ran against a
+        # gutted staging dir) must not verify clean — resolve would pick
+        # it as "newest intact" and the load would crash instead of
+        # rolling back
+        return "completion marker lists no model_states data"
+    for name, info in files.items():
+        path = os.path.join(ckpt_dir, name)
+        if not os.path.exists(path):
+            return f"missing file {name}"
+        if os.path.getsize(path) != info.get("size"):
+            return (f"size mismatch for {name}: {os.path.getsize(path)} != "
+                    f"{info.get('size')}")
+    num_shards = marker.get("num_shards")
+    shard_files = [n for n in files if n.startswith("model_states-shard")]
+    if shard_files and num_shards is not None \
+            and len(shard_files) != num_shards:
+        return (f"marker lists {len(shard_files)} model shards, "
+                f"expected {num_shards}")
+    if check_digests:
+        for name, info in files.items():
+            got = file_digest(os.path.join(ckpt_dir, name))
+            if got != info.get("sha256"):
+                return f"digest mismatch for {name}"
+    return None
+
+
+def _is_reserved_name(name: str) -> bool:
+    return (name.endswith(STAGING_SUFFIX) or name.endswith(".replaced")
+            or name.endswith(QUARANTINE_SUFFIX)
+            or f"{QUARANTINE_SUFFIX}." in name)
+
+
+def _tag_sort_key(save_dir: str, tag: str) -> Tuple[int, float]:
+    step = -1
+    try:
+        with open(os.path.join(save_dir, tag, META_FILE)) as f:
+            step = int(json.load(f).get("step", -1))
+    except (OSError, ValueError):
+        pass
+    try:
+        mtime = os.path.getmtime(os.path.join(save_dir, tag))
+    except OSError:
+        mtime = 0.0
+    return (step, mtime)
+
+
+def list_tags(save_dir: str) -> List[str]:
+    """Published (non-staging, non-quarantined) tags, oldest -> newest by
+    recorded step, then directory mtime."""
+    if not os.path.isdir(save_dir):
+        return []
+    out = []
+    for name in sorted(os.listdir(save_dir)):
+        path = os.path.join(save_dir, name)
+        if not os.path.isdir(path) or _is_reserved_name(name):
+            continue
+        if not (os.path.exists(os.path.join(path, META_FILE))
+                or os.path.exists(os.path.join(path, CKPT_META_FILE))):
+            continue
+        out.append(name)
+    out.sort(key=lambda t: _tag_sort_key(save_dir, t))
+    return out
+
+
+def _recover_interrupted_publishes(load_dir: str) -> None:
+    """Finish publishes a crash interrupted. The marker is written LAST,
+    so a ``<tag>.tmp`` that contains one is fully durable — the crash hit
+    between the marker and the rename (or between the two renames of an
+    overwrite-save, which also strands the old tag in ``<tag>.replaced``).
+    Promote such staging dirs and sweep ``.replaced`` debris whose tag is
+    live again. Never raises (recovery must not block a load)."""
+    import shutil
+    try:
+        names = os.listdir(load_dir)
+    except OSError:
+        return
+    for name in names:
+        if not name.endswith(STAGING_SUFFIX):
+            continue
+        tag = name[:-len(STAGING_SUFFIX)]
+        if not tag or tag == LATEST_FILE:
+            continue
+        stage = os.path.join(load_dir, name)
+        if not os.path.isdir(stage) \
+                or os.path.isdir(os.path.join(load_dir, tag)) \
+                or not os.path.exists(os.path.join(stage, CKPT_META_FILE)):
+            continue        # debris or not yet durable: leave for quarantine
+        try:
+            publish_tag(load_dir, tag)
+            logger.warning("recovered interrupted publish of checkpoint "
+                           "'%s' (marker was durable, rename was not)", tag)
+        except OSError as e:
+            logger.error("could not recover interrupted publish of %s: %s",
+                         tag, e)
+    for name in names:
+        if name.endswith(".replaced") and os.path.isdir(
+                os.path.join(load_dir, name[:-len(".replaced")])):
+            shutil.rmtree(os.path.join(load_dir, name), ignore_errors=True)
+
+
+def resolve_load_tag(load_dir: str, check_digests: bool = True) -> str:
+    """The newest intact tag under ``load_dir``. Corrupt or partial tags
+    are skipped (logged, left in place for forensics); if the survivor
+    differs from what ``latest`` points at — a crash between publish and
+    the pointer update, or a rolled-back corruption — ``latest`` is
+    repaired to match."""
+    _recover_interrupted_publishes(load_dir)
+    latest = get_latest_tag(load_dir)
+    tags = list_tags(load_dir)[::-1]                      # newest first
+    if not tags:
+        raise FileNotFoundError(
+            f"no checkpoint tags under {load_dir}"
+            + ("" if latest else f" (and no '{LATEST_FILE}' tag file)"))
+    skipped: List[Tuple[str, str]] = []
+    for tag in tags:
+        reason = verify_tag(os.path.join(load_dir, tag),
+                            check_digests=check_digests)
+        if reason is not None:
+            skipped.append((tag, reason))
+            logger.warning("skipping corrupt checkpoint %s: %s",
+                           os.path.join(load_dir, tag), reason)
+            continue
+        if tag != latest:
+            logger.warning(
+                "rolling back to newest intact checkpoint '%s' "
+                "(%r pointed at %r%s)", tag, LATEST_FILE, latest,
+                f"; skipped {[t for t, _ in skipped]}" if skipped else "")
+            try:
+                write_latest(load_dir, tag)
+            except OSError as e:
+                logger.error("could not repair %s: %s", LATEST_FILE, e)
+        return tag
+    detail = "; ".join(f"{t}: {r}" for t, r in skipped)
+    raise FileNotFoundError(
+        f"no intact checkpoint under {load_dir} ({detail})")
+
+
+def prune_checkpoints(save_dir: str, keep_last: int, keep_every: int = 0,
+                      protect: Optional[Set[str]] = None) -> List[str]:
+    """Retention GC: keep the newest ``keep_last`` tags, every tag whose
+    recorded step is a positive multiple of ``keep_every`` (0 disables the
+    ladder), whatever ``latest`` points at, and ``protect``. Returns the
+    removed tags. ``keep_last <= 0`` is a no-op (retention off)."""
+    import shutil
+    if keep_last <= 0:
+        return []
+    protect = set(protect or ())
+    latest = get_latest_tag(save_dir)
+    if latest:
+        protect.add(latest)
+    tags = list_tags(save_dir)                            # oldest -> newest
+    keep = set(tags[-keep_last:]) | protect
+    if keep_every > 0:
+        for tag in tags:
+            step, _ = _tag_sort_key(save_dir, tag)
+            if step > 0 and step % keep_every == 0:
+                keep.add(tag)
+    removed = []
+    for tag in tags:
+        if tag in keep:
+            continue
+        shutil.rmtree(os.path.join(save_dir, tag), ignore_errors=True)
+        removed.append(tag)
+    if removed:
+        logger.info("checkpoint retention: removed %s (keep_last=%d, "
+                    "keep_every=%d)", removed, keep_last, keep_every)
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# Save / load
+# ---------------------------------------------------------------------------
+
+def _build_meta(state, client_state, master_aliases_params) -> Dict[str, Any]:
+    streak = getattr(state, "nonfinite_streak", None)
+    return {
         "master_aliases_params": master_aliases_params,
         "sharded": jax.process_count() > 1,
         "num_shards": jax.process_count(),
         "step": int(jax.device_get(state.step)),
         "skipped_steps": int(jax.device_get(state.skipped_steps)),
+        "nonfinite_streak": (int(jax.device_get(streak))
+                             if streak is not None else 0),
         "loss_scale": float(jax.device_get(state.scale.scale)),
         "scale_good_steps": int(jax.device_get(state.scale.good_steps)),
         "scale_hysteresis": int(jax.device_get(state.scale.hysteresis)),
         "client_state": client_state or {},
     }
-    with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
+
+
+def _write_meta(stage_dir: str, meta: Dict[str, Any]) -> None:
+    chaos.failpoint("ckpt.meta")
+    with open(os.path.join(stage_dir, META_FILE), "w") as f:
         json.dump(meta, f, indent=2)
 
-    def _write_latest():
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-            f.write(tag)
-        logger.info(f"saved checkpoint {ckpt_dir}")
 
+def _finalize_tag(save_dir: str, tag: str, num_shards: int,
+                  keep_last: Optional[int], keep_every: int) -> None:
+    """Marker -> publish -> latest -> retention. Runs FIFO-ordered behind
+    the data writes (inline for sync engines, on the single worker for
+    async ones), so ``latest`` can only ever advance onto a tag whose data
+    is fully on disk.
+
+    IDEMPOTENT past the publish: the async engine retries OSError jobs,
+    and a transient `latest` failure after a successful rename must not
+    re-run the marker/rename against the now-vanished staging dir (the
+    retry would fail forever and mis-report a durable checkpoint as
+    failed)."""
+    stage_dir = os.path.join(save_dir, tag + STAGING_SUFFIX)
+    if os.path.isdir(stage_dir):
+        write_completion_marker(stage_dir, num_shards=num_shards)
+        publish_tag(save_dir, tag)
+    elif not os.path.isdir(os.path.join(save_dir, tag)):
+        raise FileNotFoundError(
+            f"nothing to finalize for checkpoint '{tag}': neither "
+            f"{stage_dir} nor a published tag exists")
+    write_latest(save_dir, tag)
+    logger.info(f"saved checkpoint {os.path.join(save_dir, tag)}")
+    if keep_last:
+        prune_checkpoints(save_dir, keep_last, keep_every, protect={tag})
+
+
+def save_checkpoint(save_dir: str,
+                    tag: str,
+                    state,
+                    client_state: Optional[Dict[str, Any]] = None,
+                    master_aliases_params: bool = False,
+                    ckpt_engine=None,
+                    keep_last: Optional[int] = None,
+                    keep_every: int = 0) -> str:
+    """Write {save_dir}/{tag}/ atomically (staging dir + marker + rename);
+    update ``latest`` only after the tag is fully durable.
+
+    ``master_aliases_params``: fp32 training stores params once (the master copy
+    IS the param tree); the alias is re-established at load.
+    ``ckpt_engine``: a checkpoint.engine.CheckpointEngine — async engines do
+    the file IO off-thread; the marker/rename/`latest` sequence is FIFO-ordered
+    behind the writes on the engine's single worker, and a failed write
+    quarantines the staging dir instead of publishing (commit() reports it).
+    ``keep_last``/``keep_every``: retention GC after a successful publish."""
+    ckpt_dir = os.path.join(save_dir, tag)
+    stage_dir = ckpt_dir + STAGING_SUFFIX
+    optim_group = {"opt_state": state.opt_state}
+    if not master_aliases_params:
+        optim_group["master"] = state.master
     if ckpt_engine is None:
-        _write_latest()
-    else:
-        ckpt_engine.run(_write_latest)   # async: FIFO-ordered behind writes
+        from ..checkpoint.engine import NpzCheckpointEngine
+        ckpt_engine = NpzCheckpointEngine()
+    if jax.process_count() > 1:
+        return _save_checkpoint_multiprocess(
+            save_dir, tag, state, optim_group, client_state,
+            master_aliases_params, ckpt_engine, keep_last, keep_every)
+    os.makedirs(save_dir, exist_ok=True)
+    if os.path.isdir(stage_dir):
+        # a previous save of this tag may still be writing (async): drain
+        # it before touching the staging dir — rmtree under the worker's
+        # open handles would let the OLD generation's queued finalize
+        # publish a gutted dir. A healthy drain publishes the old save
+        # (staging vanishes); what remains after is genuinely stale.
+        ckpt_engine.commit(tag)
+        if os.path.isdir(stage_dir):
+            import shutil
+            shutil.rmtree(stage_dir)
+    os.makedirs(stage_dir)
+    ckpt_engine.create(tag, stage_dir=stage_dir)
+    # async engines must not race donated device buffers: gather to host
+    # eagerly (leaf-wise), hand numpy to the writer thread
+    lazy = getattr(ckpt_engine, "wants_lazy", True)
+    # meta scalars are read eagerly for the same donation reason
+    meta = _build_meta(state, client_state, master_aliases_params)
+    try:
+        ckpt_engine.save(_tree_to_flat_dict(state.params, lazy=lazy),
+                         os.path.join(stage_dir, "model_states.npz"))
+        ckpt_engine.save(_tree_to_flat_dict(optim_group, lazy=lazy),
+                         os.path.join(stage_dir, "optim_states.npz"))
+        ckpt_engine.run(lambda: _write_meta(stage_dir, meta),
+                        label=os.path.join(stage_dir, META_FILE))
+        ckpt_engine.run(
+            lambda: _finalize_tag(save_dir, tag, 1, keep_last, keep_every),
+            label=f"finalize:{tag}")
+    except Exception as e:
+        # sync engines raise inline; quarantine so the next save of this
+        # tag starts clean, then surface the failure to the caller
+        quarantine_staging(stage_dir, reason=f"{e.__class__.__name__}: {e}")
+        raise
+    return ckpt_dir
+
+
+def _save_checkpoint_multiprocess(save_dir, tag, state, optim_group,
+                                  client_state, master_aliases_params,
+                                  ckpt_engine, keep_last, keep_every) -> str:
+    """Sharded save: EVERY process writes its own addressable pieces
+    (replica-0 dedup) into the SHARED staging dir; a global barrier —
+    FIFO-ordered behind the writes on each rank — gates rank 0's
+    marker/publish/`latest` so `latest` never points at a partially-written
+    checkpoint. No cross-process gather happens at all."""
+    from jax.experimental import multihost_utils
+    ckpt_dir = os.path.join(save_dir, tag)
+    stage_dir = ckpt_dir + STAGING_SUFFIX
+    os.makedirs(save_dir, exist_ok=True)
+    if jax.process_index() == 0 and os.path.isdir(stage_dir):
+        import shutil
+        shutil.rmtree(stage_dir)        # stale staging from a crashed save
+    multihost_utils.sync_global_devices(f"ckpt-stage-{tag}")
+    os.makedirs(stage_dir, exist_ok=True)
+    # rank 0's commit() must not quarantine the SHARED staging dir while
+    # other ranks may still be writing — aggregate failure handling happens
+    # after the allgather barrier below, so no stage_dir is registered here
+    ckpt_engine.create(tag)
+    p = jax.process_index()
+    # a rank-local write failure must NOT raise before the allgather below
+    # — the surviving ranks would hang in the collective. Sync engines
+    # raise inline from save(); catch and fold into the ok flag so every
+    # rank reaches the barrier. (Async engines defer errors to commit().)
+    local_ok = True
+    try:
+        # shard pieces are local host copies already (np.asarray of
+        # addressable shards) — safe to hand to an async writer thread
+        ckpt_engine.save(shard_flat_dict(state.params),
+                         os.path.join(stage_dir, f"model_states-shard{p}.npz"))
+        ckpt_engine.save(shard_flat_dict(optim_group),
+                         os.path.join(stage_dir, f"optim_states-shard{p}.npz"))
+    except Exception as e:
+        logger.error("rank %d shard write for %s failed: %s", p, tag, e)
+        local_ok = False
+    # the barrier + finalize must run on the MAIN thread: a collective from
+    # an async writer thread could interleave with train-step collectives in
+    # different orders across ranks (deadlock), and the donated TrainState
+    # must be read before the next step consumes it. Async engines therefore
+    # drain here — multi-process saves are durable-on-return.
+    ok = bool(ckpt_engine.commit(tag)) and local_ok
+    # aggregate per-rank write success (the gather doubles as the
+    # durability barrier): `latest` must never advance onto a checkpoint
+    # any rank failed to write
+    flags = multihost_utils.process_allgather(
+        np.asarray([0 if not ok else 1], np.int32))
+    if int(np.min(flags)) == 0:
+        logger.error(
+            f"sharded checkpoint {ckpt_dir}: a rank's shard write failed — "
+            "leaving `latest` on the previous checkpoint")
+        if p == 0:
+            quarantine_staging(stage_dir, reason="a rank's shard write failed")
+        return ckpt_dir
+    if p == 0:
+        try:
+            _write_meta(stage_dir,
+                        _build_meta(state, client_state,
+                                    master_aliases_params))
+            _finalize_tag(save_dir, tag, jax.process_count(),
+                          keep_last, keep_every)
+        except Exception as e:
+            quarantine_staging(stage_dir,
+                               reason=f"{e.__class__.__name__}: {e}")
+            raise
+    return ckpt_dir
 
 
 def get_latest_tag(load_dir: str) -> Optional[str]:
     latest = os.path.join(load_dir, LATEST_FILE)
     if not os.path.exists(latest):
         return None
-    with open(latest) as f:
-        return f.read().strip()
+    try:
+        with open(latest) as f:
+            tag = f.read().strip()
+    except OSError:
+        return None
+    return tag or None
 
 
 def load_checkpoint(load_dir: str,
@@ -381,16 +851,27 @@ def load_checkpoint(load_dir: str,
                     state,
                     param_shardings=None,
                     master_shardings=None,
-                    opt_shardings=None) -> Tuple[Any, Dict[str, Any]]:
+                    opt_shardings=None,
+                    verify: bool = True) -> Tuple[Any, Dict[str, Any]]:
     """Load into the structure of ``state`` (shardings reapplied). Returns
-    (new_state, client_state)."""
+    (new_state, client_state).
+
+    ``tag=None`` resumes from the newest intact tag, rolling back over
+    corrupt/partial ones (see :func:`resolve_load_tag`). An explicit tag is
+    verified and raises :class:`CheckpointIntegrityError` when corrupt —
+    an explicitly requested checkpoint is user intent, not a resume
+    heuristic, so silently substituting another would be wrong."""
     import jax.numpy as jnp
     if tag is None:
-        tag = get_latest_tag(load_dir)
-        if tag is None:
-            raise FileNotFoundError(f"no 'latest' tag file in {load_dir}")
+        tag = resolve_load_tag(load_dir, check_digests=verify)
+    elif verify:
+        reason = verify_tag(os.path.join(load_dir, tag))
+        if reason is not None:
+            raise CheckpointIntegrityError(
+                f"checkpoint {os.path.join(load_dir, tag)} failed "
+                f"verification: {reason}")
     ckpt_dir = os.path.join(load_dir, tag)
-    with open(os.path.join(ckpt_dir, "meta.json")) as f:
+    with open(os.path.join(ckpt_dir, META_FILE)) as f:
         meta = json.load(f)
     sharded = not os.path.exists(os.path.join(ckpt_dir, "model_states.npz"))
 
@@ -417,6 +898,8 @@ def load_checkpoint(load_dir: str,
     new_state = state.replace(
         step=jnp.asarray(meta["step"], jnp.int32),
         skipped_steps=jnp.asarray(meta["skipped_steps"], jnp.int32),
+        nonfinite_streak=jnp.asarray(meta.get("nonfinite_streak", 0),
+                                     jnp.int32),
         params=params,
         master=optim["master"],
         opt_state=optim["opt_state"],

@@ -24,3 +24,7 @@ class TrainState:
     opt_state: Dict[str, Any]         # optimizer state (ZeRO>=1: sharded)
     scale: LossScaleState             # fp16 loss-scale state
     skipped_steps: jnp.ndarray        # i32 count of overflow-skipped steps
+    # i32 CONSECUTIVE non-finite (skipped) steps — the bf16 divergence
+    # signal (no loss scaler there to react); None in externally built
+    # states is treated as 0
+    nonfinite_streak: Any = None

@@ -1,0 +1,10 @@
+"""deepspeed_tpu.testing — fault-injection / chaos utilities.
+
+Production code imports ``chaos.failpoint`` at checkpoint-critical sites;
+with no failpoints armed every call is a dict lookup that misses — safe to
+leave compiled into the hot save path.
+"""
+
+from . import chaos
+
+__all__ = ["chaos"]

@@ -1,0 +1,177 @@
+"""Fault-injection harness: named failpoints for crash-safety tests.
+
+The checkpoint stack is only provably restartable if a test can crash a
+real save at EVERY stage — mid-array-write, after the data but before the
+completion marker, between the rename and the ``latest`` update — and then
+demonstrate that a fresh engine resumes from the newest intact tag. This
+module provides the crash trigger: production code declares *failpoints*
+(named, stable identifiers; see docs/RESILIENCE.md for the catalog) and
+tests arm them to raise an ``IOError`` or kill the process at exactly that
+point.
+
+Design constraints:
+
+- **Zero cost disarmed.** ``failpoint(name)`` with nothing armed is one
+  dict lookup that misses. No env reads, no locks on the fast path.
+- **Deterministic.** A failpoint fires on an exact hit index (``skip``
+  hits pass through first) for an exact number of times — no randomness,
+  so the crash-at-every-stage matrix is a plain parametrize.
+- **Cross-process.** Subprocess tests (kill-mid-write, SIGTERM) arm
+  failpoints in the child via the ``DSTPU_CHAOS`` env var, parsed once at
+  first failpoint evaluation in that process.
+
+Spec syntax (env var or ``arm()``)::
+
+    DSTPU_CHAOS="ckpt.write:raise"            # raise IOError on 1st hit
+    DSTPU_CHAOS="ckpt.write:kill"             # os._exit(13) on 1st hit
+    DSTPU_CHAOS="ckpt.write:raise:skip=1"     # pass 1 hit, fail the 2nd
+    DSTPU_CHAOS="ckpt.write:raise:times=2"    # fail the first 2 hits
+    DSTPU_CHAOS="a:raise;b:kill:skip=3"       # several failpoints
+
+reference counterpart: DeepSpeed's tests monkeypatch torch.save /
+simulate SIGTERM by hand per test; a named-failpoint registry is the
+jax_graft-native equivalent of kernel-style fault injection (fail_make_
+request) — one mechanism, every crash site.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+#: exit code used by ``kill`` mode — distinct from Python's 1 and from
+#: PREEMPTION_EXIT_CODE so tests can tell "chaos killed it" apart from
+#: ordinary failures.
+KILL_EXIT_CODE = 13
+
+_lock = threading.Lock()
+_armed: Dict[str, "_FailPoint"] = {}
+_env_loaded = False
+_history: List[str] = []        # fired failpoint names, in order
+
+
+class ChaosError(IOError):
+    """The injected fault. Subclasses IOError so code under test exercises
+    its real transient-IO handling (retry/backoff, quarantine) — a chaos
+    fault must be indistinguishable from a disk hiccup."""
+
+    def __init__(self, name: str):
+        super().__init__(f"chaos failpoint '{name}' fired")
+        self.failpoint = name
+
+
+class _FailPoint:
+    __slots__ = ("name", "mode", "skip", "times", "hits", "fired")
+
+    def __init__(self, name: str, mode: str, skip: int = 0, times: int = 1):
+        if mode not in ("raise", "kill"):
+            raise ValueError(f"chaos mode must be 'raise' or 'kill', "
+                             f"got {mode!r}")
+        self.name = name
+        self.mode = mode
+        self.skip = skip
+        self.times = times
+        self.hits = 0       # total traversals of this failpoint
+        self.fired = 0      # times it actually failed
+
+
+def parse_spec(spec: str) -> Dict[str, _FailPoint]:
+    """Parse a ``DSTPU_CHAOS`` spec string (see module docstring)."""
+    out: Dict[str, _FailPoint] = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) < 2:
+            raise ValueError(
+                f"bad chaos spec {part!r}: expected name:mode[:k=v...]")
+        name, mode = fields[0], fields[1]
+        kwargs = {}
+        for f in fields[2:]:
+            k, _, v = f.partition("=")
+            if k not in ("skip", "times"):
+                raise ValueError(f"bad chaos spec option {f!r} in {part!r}")
+            kwargs[k] = int(v)
+        out[name] = _FailPoint(name, mode, **kwargs)
+    return out
+
+
+def _load_env_once() -> None:
+    global _env_loaded
+    with _lock:
+        if _env_loaded:
+            return
+        _env_loaded = True
+        spec = os.environ.get("DSTPU_CHAOS", "")
+        if spec:
+            _armed.update(parse_spec(spec))
+
+
+def arm(name: str, mode: str = "raise", skip: int = 0, times: int = 1) -> None:
+    """Programmatically arm a failpoint (in-process tests)."""
+    with _lock:
+        _armed[name] = _FailPoint(name, mode, skip=skip, times=times)
+
+
+def disarm(name: Optional[str] = None) -> None:
+    """Disarm one failpoint, or all of them (``name=None``). The fired
+    history survives so a test can still assert WHAT fired; use
+    :func:`reset_for_tests` for a full wipe."""
+    global _env_loaded
+    with _lock:
+        if name is None:
+            _armed.clear()
+            _env_loaded = True      # env consumed; do not re-read
+        else:
+            _armed.pop(name, None)
+
+
+def reset_for_tests() -> None:
+    """Full reset incl. re-reading DSTPU_CHAOS on next use — conftest's
+    per-test hook, so a test that sets the env var (subprocess specs) and
+    one that arms programmatically can't interfere."""
+    global _env_loaded
+    with _lock:
+        _armed.clear()
+        _history.clear()
+        _env_loaded = False
+
+
+def fired(name: Optional[str] = None) -> List[str]:
+    """Names of failpoints that actually fired (optionally filtered)."""
+    with _lock:
+        return [h for h in _history if name is None or h == name]
+
+
+def armed() -> List[str]:
+    _load_env_once()
+    with _lock:
+        return sorted(_armed)
+
+
+def failpoint(name: str) -> None:
+    """Declare a failpoint. No-op unless a test armed ``name``.
+
+    ``raise`` mode raises :class:`ChaosError` (an IOError). ``kill`` mode
+    calls ``os._exit(KILL_EXIT_CODE)`` — no atexit handlers, no flushes:
+    the closest userspace approximation of the machine dying.
+    """
+    if not _env_loaded:
+        _load_env_once()
+    if not _armed:
+        return
+    with _lock:
+        fp = _armed.get(name)
+        if fp is None:
+            return
+        fp.hits += 1
+        if fp.hits <= fp.skip or fp.fired >= fp.times:
+            return
+        fp.fired += 1
+        _history.append(name)
+        mode = fp.mode
+    if mode == "kill":
+        os._exit(KILL_EXIT_CODE)
+    raise ChaosError(name)

@@ -1,0 +1,59 @@
+"""jax version compatibility shims (opt-in: DSTPU_JAX_COMPAT=1).
+
+The package is written against the modern jax surface; older images (the
+0.4.x line) lack some of it. Each shim forward-ports the missing API so
+call sites stay canonical — graftlint's jit-scope analysis keys on the
+``jax.shard_map`` spelling, and rewriting ~17 launch sites per jax
+version would churn every shard_map region in the tree.
+
+Opt-in rather than automatic: on the 0.4.x jaxlib the adapter unlocks
+compile paths (qwZ+TP int8 gathers, the SPMD pipeline executor) that
+crash INSIDE XLA compilation — `Fatal Python error: Aborted`, killing
+the process. A missing attribute fails one test; an aborting compiler
+kills the whole run. Set DSTPU_JAX_COMPAT=1 only on jaxlibs where the
+unlocked paths are known-good.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+
+def install_shard_map_compat() -> bool:
+    """Alias ``jax.shard_map`` on versions that only ship
+    ``jax.experimental.shard_map``, adapting the modern kwargs:
+
+    - ``axis_names={...}`` (axes manual inside the region; the rest stay
+      auto) -> the old ``auto=frozenset(all) - axis_names``;
+    - ``check_vma=`` -> the old ``check_rep=``.
+
+    Returns True when an alias was installed (False: native support)."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        return False
+    try:
+        from jax.experimental.shard_map import shard_map as _legacy
+    except ImportError:     # pragma: no cover - no shard_map at all
+        return False
+    legacy_params = inspect.signature(_legacy).parameters
+
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=None, **kwargs):
+        if axis_names is not None and "auto" in legacy_params:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            if auto:
+                kwargs["auto"] = auto
+        if check_vma is not None:
+            key = "check_rep" if "check_rep" in legacy_params else "check_vma"
+            kwargs[key] = check_vma
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kwargs)
+
+    jax.shard_map = shard_map
+    return True
+
+# NOTE: jax.lax.axis_size is deliberately NOT shimmed (psum(1, name) is
+# the classic spelling): unlocking the qwZ+TP compile path on the 0.4.x
+# jaxlib aborts the PROCESS inside XLA compilation — a clean
+# AttributeError at trace time is strictly safer than a compiler crash
+# that would kill an entire pytest run.

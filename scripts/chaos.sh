@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Fault-injection suite, standalone: crash a real checkpoint save at every
+# named failpoint (plus kill-mid-write and SIGTERM subprocess tests) and
+# prove resume. See docs/RESILIENCE.md for the failpoint catalog.
+#
+#   scripts/chaos.sh              # full crash-safety suite
+#   scripts/chaos.sh -k sigterm   # subset (pytest -k forwarded)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# determinism: the suite arms its own failpoints; a stray env spec would
+# fire inside arbitrary tests (tests/conftest.py also scrubs this)
+unset DSTPU_CHAOS
+
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
+    -p no:cacheprovider "$@"

@@ -21,7 +21,23 @@ if os.environ.get("DSTPU_TEST_PLATFORM", "cpu") == "cpu":
 
 import pytest
 
+# the chaos env knob must never leak into the suite from the outer
+# environment — a stray DSTPU_CHAOS would fail arbitrary checkpoint tests
+os.environ.pop("DSTPU_CHAOS", None)
+
 
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolation():
+    """Deterministic fault injection: every test starts and ends with no
+    armed failpoints, and DSTPU_CHAOS set by a test (for its subprocesses)
+    is scrubbed afterwards."""
+    from deepspeed_tpu.testing import chaos
+    chaos.reset_for_tests()
+    yield
+    chaos.reset_for_tests()
+    os.environ.pop("DSTPU_CHAOS", None)

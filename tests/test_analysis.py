@@ -1,5 +1,5 @@
 """graftlint unit tests: one true-positive and one true-negative fixture
-per rule (TPU001–TPU007, TPU010), plus suppression, baseline and self-lint
+per rule (TPU001–TPU008, TPU010), plus suppression, baseline and self-lint
 tests.
 
 Fixtures are source snippets linted in-memory through a temp file — the
@@ -322,6 +322,65 @@ def test_tpu007_negative(tmp_path):
     assert "TPU007" not in codes(findings)
 
 
+# --------------------------------------------------------------------- TPU008
+
+def test_tpu008_positive_trailing_none(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def constrain(x):
+            return lax.with_sharding_constraint(x, P("data", None))
+    """)
+    hits = [f for f in findings if f.rule == "TPU008"]
+    assert hits and "trailing None" in hits[0].message
+    assert hits[0].severity == Severity.WARNING
+
+
+def test_tpu008_positive_single_name_tuple(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def place(mesh, x):
+            return jax.device_put(x, NamedSharding(mesh, P(("model",))))
+    """)
+    hits = [f for f in findings if f.rule == "TPU008"]
+    assert hits and "single-name tuple" in hits[0].message
+
+
+def test_tpu008_negative_canonical_specs(tmp_path):
+    # canonical forms — bare names, interior None, multi-axis tuples — and
+    # specs built elsewhere (a variable the checker can't see into) pass
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def constrain(mesh, x, spec):
+            a = lax.with_sharding_constraint(x, P("data"))
+            b = lax.with_sharding_constraint(x, P(None, "model"))
+            c = lax.with_sharding_constraint(x, P(("data", "expert")))
+            d = lax.with_sharding_constraint(x, spec)
+            e = jax.device_put(x, NamedSharding(mesh, P()))
+            return a, b, c, d, e
+    """)
+    assert "TPU008" not in codes(findings, gating_only=False)
+
+
+def test_tpu008_ignores_specs_outside_constraint_sites(tmp_path):
+    # a non-canonical P literal that never reaches a constraint site is
+    # someone's intermediate value — not this rule's business
+    findings = lint_snippet(tmp_path, """
+        from jax.sharding import PartitionSpec as P
+
+        def build():
+            return P("data", None)
+    """)
+    assert "TPU008" not in codes(findings, gating_only=False)
+
+
 # --------------------------------------------- suppressions / baseline / CLI
 
 def test_inline_suppression_same_line(tmp_path):
@@ -415,7 +474,7 @@ def test_baseline_entries_carry_justification():
 
 def test_rule_registry_complete():
     assert {"TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
-            "TPU007", "TPU010"} <= set(RULES)
+            "TPU007", "TPU008", "TPU010"} <= set(RULES)
     for code, rule in RULES.items():
         assert rule.summary and rule.name, code
 

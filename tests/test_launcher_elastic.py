@@ -99,6 +99,67 @@ def test_elastic_agent_restarts_on_crash(tmp_path):
     assert attempts.read_text() == "2"
 
 
+def test_elastic_agent_preemption_rc_not_counted(tmp_path):
+    """A worker exiting with PREEMPTION_EXIT_CODE (what the engine's
+    SIGTERM handler does after its emergency save) is a resume: relaunch
+    without touching the max_restarts budget."""
+    from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                        PREEMPTION_EXIT_CODE)
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("localhost slots=1\n")
+    attempts = tmp_path / "attempts"
+
+    def launch(members):
+        code = (f"import os\np={str(attempts)!r}\n"
+                "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+                "open(p, 'w').write(str(n + 1))\n"
+                f"raise SystemExit({PREEMPTION_EXIT_CODE} if n < 2 else 0)\n")
+        return subprocess.Popen([sys.executable, "-c", code])
+
+    # max_restarts=0: ANY crash would end the run — only the preemption
+    # rc's exemption lets this reach the clean exit
+    agent = DSElasticAgent(launch, str(hostfile), max_restarts=0,
+                           check_interval=0.05)
+    assert agent.run() == 0
+    assert agent.preemptions == 2
+    assert agent.restarts == 0
+    assert attempts.read_text() == "3"
+
+
+def test_elastic_agent_tolerates_transient_hostfile_states(tmp_path):
+    """An atomic rewrite of the hostfile mid-poll (empty read, brief
+    unlink, identical rewrite) must NOT look like a membership change."""
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    hostfile = tmp_path / "hostfile"
+    content = "worker-0 slots=1\n"
+    hostfile.write_text(content)
+    launches = []
+
+    def launch(members):
+        launches.append(list(members))
+        return subprocess.Popen([sys.executable, "-c",
+                                 "import time; time.sleep(1.2)"])
+
+    def churn():
+        # several rewrite cycles while the agent polls at 20ms
+        for _ in range(4):
+            time.sleep(0.15)
+            hostfile.write_text("")            # truncate+write in flight
+            time.sleep(0.05)
+            os.unlink(hostfile)                # rename-style blip
+            time.sleep(0.05)
+            hostfile.write_text(content)       # same membership lands
+
+    t = threading.Thread(target=churn)
+    t.start()
+    agent = DSElasticAgent(launch, str(hostfile), check_interval=0.02)
+    rc = agent.run()
+    t.join()
+    assert rc == 0
+    assert agent.membership_changes == 0
+    assert len(launches) == 1
+
+
 def test_elastic_agent_restarts_on_membership_change(tmp_path):
     from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
     hostfile = tmp_path / "hostfile"
