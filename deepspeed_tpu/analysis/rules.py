@@ -1,4 +1,4 @@
-"""graftlint rules TPU001–TPU008, TPU010.
+"""graftlint rules TPU001–TPU010.
 
 Each rule targets one class of bug that regresses the gas-amortized train
 step silently: the bench still runs, just slower (host syncs, retraces)
@@ -618,6 +618,160 @@ class ShardingSpecDriftRule(Rule):
                         "cache key — a spurious retrace. Canonicalize "
                         "(drop trailing Nones / unwrap 1-tuples) or pass "
                         "through canonicalize_spec")
+
+
+@register
+class ScanCarryWideningRule(Rule):
+    """TPU009 — scan-carry dtype widening.
+
+    ``lax.scan`` requires the carry entering and leaving the body to have
+    the SAME pytree-of-dtypes: a body that returns a 16-bit carry widened
+    to f32 (an ``astype(float32)``, a ``jnp.float32(...)`` wrap, an
+    asarray-with-f32) either errors at trace time or silently runs the
+    whole scan in f32, doubling the carry's HBM and bandwidth — grads and
+    activations carried across layers are exactly the big tensors.
+    Flagged only when the scan's ``init`` argument shows explicit 16-bit
+    evidence, so intentional f32 scans never fire; a body that casts the
+    carry back to 16-bit before returning is clean. ``lax.scan`` call
+    sites only: ``nn.scan`` wraps a Module class and takes no init
+    argument, so its carry dtypes are not statically visible here.
+    """
+
+    code = "TPU009"
+    name = "scan-carry-widening"
+    severity = Severity.WARNING
+    summary = "16-bit scan carry returned widened to f32"
+
+    _SCANS = {"jax.lax.scan"}
+
+    def _halfish(self, module: ModuleInfo, node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, (ast.Attribute, ast.Name)) and \
+                    _qual(module, n) in _HALF_NAMES:
+                return True
+            if isinstance(n, ast.Constant) and n.value in ("bfloat16",
+                                                           "float16"):
+                return True
+        return False
+
+    def _widening_cast(self, module: ModuleInfo,
+                       expr: ast.AST) -> Optional[ast.AST]:
+        """A node inside ``expr`` that casts to f32."""
+        for n in ast.walk(expr):
+            if not isinstance(n, ast.Call):
+                continue
+            if isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "astype" and n.args:
+                a = n.args[0]
+                if _qual(module, a) in _F32_NAMES or (
+                        isinstance(a, ast.Constant)
+                        and a.value == "float32"):
+                    return n
+            q = _qual(module, n.func)
+            if q in _F32_NAMES and n.args:
+                return n
+            if q in ("jax.numpy.asarray", "jax.numpy.array"):
+                dt = [kw.value for kw in n.keywords if kw.arg == "dtype"]
+                dt += list(n.args[1:2])
+                for d in dt:
+                    if _qual(module, d) in _F32_NAMES or (
+                            isinstance(d, ast.Constant)
+                            and d.value == "float32"):
+                        return n
+        return None
+
+    def _narrows_back(self, module: ModuleInfo, expr: ast.AST) -> bool:
+        """The carry is re-cast to 16-bit somewhere in this expression —
+        the widening was an intentional f32 island (accumulate in f32,
+        carry in bf16), which is the correct idiom."""
+        for n in ast.walk(expr):
+            if not isinstance(n, ast.Call):
+                continue
+            if isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "astype" and n.args and \
+                    self._halfish(module, n.args[0]):
+                return True
+            if _qual(module, n.func) in _HALF_NAMES:
+                return True
+        return False
+
+    def _carry_exprs(self, module: ModuleInfo, body_fn):
+        """Expressions the body returns as its carry (first element of a
+        returned tuple), with one level of local-name dataflow."""
+        assigns = {}        # name -> [value exprs assigned to it]
+        for node in module.fn_nodes(body_fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            assigns.setdefault(leaf.id, []).append(node.value)
+        out = []
+        for node in module.fn_nodes(body_fn):
+            if not (isinstance(node, ast.Return) and node.value is not None):
+                continue
+            v = node.value
+            carry = v.elts[0] if isinstance(v, ast.Tuple) and v.elts else v
+            if isinstance(carry, ast.Name):
+                vals = assigns.get(carry.id, [])
+                # any rebinding that narrows back to 16-bit clears the
+                # name: the f32 hop was an intentional island
+                if any(self._narrows_back(module, a) for a in vals):
+                    continue
+                out.extend(vals)
+            out.append(carry)
+        return out
+
+    def _init_halfish(self, module: ModuleInfo, call: ast.Call,
+                      init: ast.AST) -> bool:
+        """16-bit evidence on the init expression — following a plain name
+        to its assignments in the function enclosing the scan call."""
+        if self._halfish(module, init):
+            return True
+        if not isinstance(init, ast.Name):
+            return False
+        encl = module.enclosing_function(call)
+        if encl is None:
+            return False
+        for node in module.fn_nodes(encl):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(leaf, ast.Name) and leaf.id == init.id
+                    for t in node.targets for leaf in ast.walk(t)):
+                if self._halfish(module, node.value):
+                    return True
+        return False
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        scope = module.scope
+        for node in module.all_calls:
+            if _qual(module, node.func) not in self._SCANS:
+                continue
+            init = (node.args[1] if len(node.args) >= 2 else
+                    next((kw.value for kw in node.keywords
+                          if kw.arg == "init"), None))
+            if init is None or not self._init_halfish(module, node, init):
+                continue
+            if not node.args:
+                continue
+            body = scope.resolve_local_def(node.args[0]) \
+                if isinstance(node.args[0], ast.Name) else node.args[0]
+            if not isinstance(body, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                continue
+            for carry in self._carry_exprs(module, body):
+                wide = self._widening_cast(module, carry)
+                if wide is None or self._narrows_back(module, carry):
+                    continue
+                yield self.finding(
+                    module, wide,
+                    "scan carry initialized 16-bit but returned widened to "
+                    f"f32 ('{ast.unparse(wide)}'): the carry dtype must be "
+                    "invariant across iterations — this errors at trace "
+                    "time, or silently runs the whole scan in f32 "
+                    "(doubling carry HBM/bandwidth). Cast the carry back "
+                    "to its input dtype before returning")
+                break       # one finding per scan site
 
 
 @register

@@ -67,8 +67,16 @@ def init_distributed(dist_backend: str = "xla",
     n_proc = world_size if world_size > 0 else int(os.environ.get("DSTPU_NUM_PROCESSES", "0") or 0)
     pid = rank if rank >= 0 else int(os.environ.get("DSTPU_PROCESS_ID", "-1"))
     if coord and n_proc > 1:
-        jax.distributed.initialize(coordinator_address=coord, num_processes=n_proc,
-                                   process_id=pid)
+        # a dead/unreachable coordinator blocks initialize forever with no
+        # diagnostics; under DSTPU_INIT_TIMEOUT the worker dumps stacks and
+        # exits the stall rc instead (launcher supervision tears down fast)
+        from ..runtime.watchdog import init_deadline
+        init_timeout = float(kwargs.pop("initialization_timeout", 0) or
+                             os.environ.get("DSTPU_INIT_TIMEOUT", "0") or 0)
+        with init_deadline(init_timeout):
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=n_proc,
+                                       process_id=pid)
         logger.info(f"jax.distributed initialized: process {pid}/{n_proc} @ {coord}")
     _initialized = True
 

@@ -322,6 +322,25 @@ class NonFiniteGuardConfig(DeepSpeedConfigModel):
     abort_after: int = 0
 
 
+class WatchdogConfig(DeepSpeedConfigModel):
+    """TPU-native (round-4): in-worker stall watchdog. A wedged rank in a
+    multi-controller job silently deadlocks every collective in the pod;
+    with ``stall_timeout > 0`` the engine heartbeats the watchdog on every
+    optimizer step, and a longer gap dumps all thread stacks and exits the
+    distinct stall rc (runtime/watchdog.py: STALL_EXIT_CODE) so the
+    launcher-side supervisor tears the world down and the elastic agent
+    restarts — counted against its budget, unlike a preemption. The
+    watchdog suspends during checkpoint saves and the preemption grace
+    window (slow IO is not a hang). The related bound on
+    ``jax.distributed.initialize`` is NOT a ds_config knob — it must act
+    before any config is parsed: set ``DSTPU_INIT_TIMEOUT`` (forwarded to
+    remote hosts by dstpu), ``launch.py --init_timeout``, or the
+    ``initialization_timeout=`` kwarg of ``init_distributed``.
+    See docs/RESILIENCE.md."""
+    stall_timeout: float = 0.0    # seconds without a step heartbeat; 0 = off
+    poll_interval: float = 0.0    # check cadence; 0 = stall_timeout / 4
+
+
 class ProgressiveLayerDropConfig(DeepSpeedConfigModel):
     enabled: bool = False
     theta: float = 0.5
@@ -443,6 +462,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
     nonfinite_guard: NonFiniteGuardConfig = Field(
         default_factory=NonFiniteGuardConfig)
+    watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
     dataloader_drop_last: bool = False
     nebula: NebulaConfig = Field(default_factory=NebulaConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)

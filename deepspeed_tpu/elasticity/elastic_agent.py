@@ -15,6 +15,16 @@ Preemption contract (round-3): a worker that exits with
 does after its emergency save — is a RESUME, not a crash: the agent
 relaunches immediately and does NOT count it against ``max_restarts``
 (TPU preemptions at multi-host scale would exhaust any budget).
+
+Stall contract (round-4): a worker the stall watchdog shot
+(``runtime.watchdog.STALL_EXIT_CODE``) DOES count against
+``max_restarts`` — a wedge is a failure mode, and unbounded relaunching
+of a run that wedges deterministically would burn the pod forever. The
+agent tracks it separately (``stalls``) so operators can tell "restarted
+because wedged" from "restarted because crashed". The run the agent
+monitors may be a single worker Popen or a launcher-side
+``RunSupervisor`` (duck-typed: poll/wait/terminate/kill), which is how
+``dstpu --elastic`` stacks agent-over-supervisor-over-ranks.
 """
 
 from __future__ import annotations
@@ -42,22 +52,31 @@ class DSElasticAgent:
                  max_restarts: int = 100,
                  check_interval: float = 1.0,
                  min_nodes: int = 1,
-                 confirm_polls: int = 2):
+                 confirm_polls: int = 2,
+                 teardown_grace: float = 30.0):
         """launch_fn(active_hosts) -> Popen for one training run.
 
         ``confirm_polls``: how many CONSECUTIVE identical polls must agree
         before a hostfile difference counts as a membership change — an
         atomic rewrite of the hostfile mid-poll (truncate+write, or a brief
-        unlink during rename) must not look like a rescale."""
+        unlink during rename) must not look like a rescale.
+
+        ``teardown_grace``: how long a membership-change terminate() may
+        take before the agent SIGKILLs — must COVER the run's own
+        SIGTERM->grace->SIGKILL window (RunSupervisor's grace_secs, i.e.
+        the emergency-checkpoint budget), or the agent's kill races the
+        in-flight preemption saves it exists to protect."""
         self.launch_fn = launch_fn
         self.hostfile = hostfile
         self.max_restarts = max_restarts
         self.check_interval = check_interval
         self.min_nodes = min_nodes
         self.confirm_polls = max(1, confirm_polls)
+        self.teardown_grace = float(teardown_grace)
         self.restarts = 0
         self.membership_changes = 0
         self.preemptions = 0
+        self.stalls = 0
 
     def _members(self) -> List[str]:
         pool = self._read_members()
@@ -103,6 +122,14 @@ class DSElasticAgent:
                          f"resuming (preemption {self.preemptions}, not "
                          "counted against max_restarts)", ranks=[0])
                 continue
+            from ..runtime.watchdog import STALL_EXIT_CODE
+            if rc == STALL_EXIT_CODE:
+                # the watchdog shot a wedged worker: restart, but COUNT it
+                # — a deterministic wedge must not relaunch forever
+                self.stalls += 1
+                logger.warning("elastic agent: worker stalled (rc=%d, "
+                               "stall %d); restarting (counted against "
+                               "max_restarts)", rc, self.stalls)
             self.restarts += 1
             if self.restarts > self.max_restarts:
                 logger.error("elastic agent: max_restarts exceeded (rc=%d)",
@@ -140,7 +167,10 @@ class DSElasticAgent:
                              ranks=[0])
                     proc.terminate()
                     try:
-                        proc.wait(timeout=30)
+                        # +5s headroom: the run's OWN teardown (grace for
+                        # emergency checkpoints, then SIGKILL) must finish
+                        # before the agent escalates
+                        proc.wait(timeout=self.teardown_grace + 5.0)
                     except subprocess.TimeoutExpired:
                         proc.kill()
                         proc.wait()

@@ -12,6 +12,7 @@ multi-host `jax.devices()` world.
 from __future__ import annotations
 
 import argparse
+import os
 import runpy
 import sys
 
@@ -26,6 +27,14 @@ def parse_args(argv=None):
                    help="host:port of process 0")
     p.add_argument("--world_info", default="",
                    help="base64 host->slots map (rank autodetect + info)")
+    p.add_argument("--init_timeout", type=float,
+                   default=float(os.environ.get("DSTPU_INIT_TIMEOUT", "0")
+                                 or 0),
+                   help="bound on jax.distributed.initialize, seconds "
+                        "(0 = wait forever). On expiry the worker dumps "
+                        "all thread stacks and exits the stall rc so the "
+                        "supervisor can tear the launch down — a dead "
+                        "coordinator otherwise hangs every rank silently")
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -62,10 +71,12 @@ def main(argv=None):
     args = parse_args(argv)
     import jax
     if args.nnodes > 1:
-        jax.distributed.initialize(
-            coordinator_address=args.coordinator,
-            num_processes=args.nnodes,
-            process_id=resolve_node_rank(args))
+        from ..runtime.watchdog import init_deadline
+        with init_deadline(args.init_timeout):
+            jax.distributed.initialize(
+                coordinator_address=args.coordinator,
+                num_processes=args.nnodes,
+                process_id=resolve_node_rank(args))
     sys.argv = [args.user_script] + args.user_args
     runpy.run_path(args.user_script, run_name="__main__")
 

@@ -129,20 +129,33 @@ def build_launch_cmd(host_idx: int, num_hosts: int, coordinator: str,
 
 
 def build_ssh_cmd(host: str, remote_cmd: List[str],
-                  env_exports: Dict[str, str]) -> List[str]:
+                  env_exports: Dict[str, str],
+                  connect_timeout: int = 15) -> List[str]:
+    """ssh argv for one rank. ``-o ConnectTimeout`` bounds the connect
+    phase (a dead host fails fast instead of hanging the dispatch), and
+    the remote shell prints the supervisor's started sentinel BEFORE
+    exec'ing the bootstrap — the line that marks this rank non-retryable
+    (see supervisor.STARTED_SENTINEL)."""
+    from .supervisor import STARTED_SENTINEL
     exports = " ".join(f"export {k}={shlex.quote(v)};"
                        for k, v in env_exports.items())
-    return ["ssh", "-o", "StrictHostKeyChecking=no", host,
-            f"cd {shlex.quote(os.getcwd())}; {exports} " +
+    return ["ssh", "-o", "StrictHostKeyChecking=no",
+            "-o", f"ConnectTimeout={int(connect_timeout)}", host,
+            f"cd {shlex.quote(os.getcwd())}; {exports} "
+            f"echo {STARTED_SENTINEL}; exec " +
             " ".join(shlex.quote(c) for c in remote_cmd)]
 
 
 def collect_env_exports() -> Dict[str, str]:
     """Env vars forwarded to workers (reference: runner.py:508-563 exports
-    NCCL_*/PYTHON* + .deepspeed_env file)."""
+    NCCL_*/PYTHON* + .deepspeed_env file). The DSTPU_ prefix carries the
+    launcher's own contract — coordinator overrides, DSTPU_CHAOS fault
+    specs, DSTPU_INIT_TIMEOUT — which previously never reached remote
+    hosts."""
     exports = {}
     for key, val in os.environ.items():
-        if key.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU_", "PYTHONPATH")):
+        if key.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU_", "DSTPU_",
+                           "PYTHONPATH")):
             exports[key] = val
     if os.path.isfile(DSTPU_ENV_FILE):
         with open(DSTPU_ENV_FILE) as f:
@@ -171,6 +184,34 @@ def parse_args(argv=None):
                         "(reference: deepspeed --autotuning)")
     p.add_argument("--deepspeed_config", default="",
                    help="base ds_config for --autotuning mode")
+    # -- run supervision (round-4; docs/RESILIENCE.md) -----------------------
+    p.add_argument("--elastic", action="store_true",
+                   help="supervise the run under DSElasticAgent: relaunch "
+                        "on membership change, resume (uncounted) on "
+                        "preemption rc 114, restart crashed/stalled runs "
+                        "up to --max-restarts")
+    p.add_argument("--max-restarts", "--max_restarts", type=int, default=100,
+                   dest="max_restarts",
+                   help="elastic: crash/stall restart budget (preemptions "
+                        "are not counted)")
+    p.add_argument("--min-nodes", "--min_nodes", type=int, default=1,
+                   dest="min_nodes",
+                   help="elastic: wait until the hostfile lists at least "
+                        "this many nodes before (re)launching")
+    p.add_argument("--check-interval", "--check_interval", type=float,
+                   default=1.0, dest="check_interval",
+                   help="elastic: hostfile/worker poll interval, seconds")
+    p.add_argument("--grace-secs", "--grace_secs", type=float, default=30.0,
+                   dest="grace_secs",
+                   help="teardown grace: SIGTERM -> this many seconds (the "
+                        "preemption handlers' checkpoint window) -> SIGKILL")
+    p.add_argument("--connect-retries", "--connect_retries", type=int,
+                   default=3, dest="connect_retries",
+                   help="retries for ssh CONNECT-phase failures (a rank "
+                        "that started user code is never retried)")
+    p.add_argument("--connect-timeout", "--connect_timeout", type=int,
+                   default=15, dest="connect_timeout",
+                   help="ssh -o ConnectTimeout per dispatch attempt")
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -194,6 +235,8 @@ def main(argv=None):
                 ["--deepspeed_config", best]
             os.execvpe(cmd[0], cmd, os.environ.copy())
         return
+    if args.elastic:
+        sys.exit(run_elastic(args))
     pool = fetch_hostfile(args.hostfile)
     if not pool:
         # single node, all local chips
@@ -203,41 +246,112 @@ def main(argv=None):
     active = parse_inclusion_exclusion(pool, args.include, args.exclude)
     if args.num_nodes > 0:
         active = OrderedDict(list(active.items())[:args.num_nodes])
+    exports = collect_env_exports()
+    if args.launcher in ("pdsh", "openmpi", "slurm", "mvapich"):
+        cmd = _backend_cmd(args, active, exports)
+        sys.exit(subprocess.call(cmd))
+    # ssh/local: concurrent per-rank supervision — first failure tears the
+    # world down, connect failures retry, rc 114 survives aggregation
+    # (reference: launch.py terminate_process_tree, rebuilt fail-fast)
+    sys.exit(build_world_supervisor(active, args, exports).run())
+
+
+def _backend_cmd(args, active, exports) -> List[str]:
+    """ONE scheduler command — the backend fans out itself (reference:
+    multinode_runner.py get_cmd per backend)."""
+    from .multinode_runner import build_runner
     hosts = list(active)
     coordinator = args.master_addr or hosts[0]
     world_info = encode_world_info(active)
-    exports = collect_env_exports()
-    if args.launcher in ("pdsh", "openmpi", "slurm", "mvapich"):
-        # backend fans out itself — ONE scheduler command (reference:
-        # multinode_runner.py get_cmd per backend)
-        from .multinode_runner import build_runner
-        runner = build_runner(args.launcher, args, world_info)
-        if not runner.backend_exists():
-            sys.exit(f"launcher backend '{args.launcher}' not found in PATH")
-        env = {"DSTPU_WORLD_INFO": world_info,
-               "DSTPU_COORDINATOR": coordinator,
-               "DSTPU_MASTER_PORT": str(args.master_port), **exports}
-        cmd = runner.get_cmd(env, active)
-        sys.exit(subprocess.call(cmd))
-    procs = []
+    runner = build_runner(args.launcher, args, world_info)
+    if not runner.backend_exists():
+        sys.exit(f"launcher backend '{args.launcher}' not found in PATH")
+    env = {"DSTPU_WORLD_INFO": world_info,
+           "DSTPU_COORDINATOR": coordinator,
+           "DSTPU_MASTER_PORT": str(args.master_port), **exports}
+    return runner.get_cmd(env, active)
+
+
+_LOCAL_HOSTS = ("localhost", "127.0.0.1", "::1")
+
+
+def build_world_supervisor(active: "OrderedDict[str, List[int]]", args,
+                           exports: Dict[str, str]):
+    """A started-but-not-yet-running RunSupervisor over the active world:
+    one RankSpec per host (ssh dispatch unless --launcher local or the
+    host is loopback)."""
+    from .supervisor import RankSpec, RunSupervisor
+    hosts = list(active)
+    coordinator = args.master_addr or hosts[0]
+    world_info = encode_world_info(active)
+    specs = []
     for idx, host in enumerate(hosts):
-        remote = build_launch_cmd(idx, len(hosts), coordinator,
-                                  args.master_port, world_info,
-                                  args.user_script, args.user_args)
-        cmd = (remote if args.launcher == "local"
-               else build_ssh_cmd(host, remote, exports))
-        procs.append(subprocess.Popen(cmd))
-    rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
-    # kill stragglers if any rank failed (reference: launch.py
-    # terminate_process_tree supervision)
-    if rc:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-    sys.exit(rc)
+        remote_cmd = build_launch_cmd(idx, len(hosts), coordinator,
+                                      args.master_port, world_info,
+                                      args.user_script, args.user_args)
+        if args.launcher == "local" or host in _LOCAL_HOSTS:
+            # exports (incl. .deepspeed_env entries that may not be in the
+            # launcher's own environ) still reach loopback ranks, which
+            # have no ssh command line to carry them
+            specs.append(RankSpec(host, remote_cmd, remote=False,
+                                  env=exports))
+        else:
+            specs.append(RankSpec(
+                host,
+                build_ssh_cmd(host, remote_cmd, exports,
+                              connect_timeout=args.connect_timeout),
+                remote=True))
+    return RunSupervisor(specs,
+                         grace_secs=args.grace_secs,
+                         connect_retries=args.connect_retries)
+
+
+def elastic_active_world(args, members: List[str]
+                         ) -> "OrderedDict[str, List[int]]":
+    """The world one elastic (re)launch covers: the agent's confirmed
+    membership, narrowed by the same --include/--exclude/--num_nodes
+    filters the non-elastic path applies (an operator excluding a flaky
+    host must stay excluded across every relaunch)."""
+    pool = fetch_hostfile(args.hostfile)
+    if pool:
+        filtered = parse_inclusion_exclusion(pool, args.include,
+                                             args.exclude)
+    else:
+        # no/unreadable hostfile: the agent already fell back to localhost
+        filtered = OrderedDict((h, [0]) for h in members)
+    active = OrderedDict(
+        (h, filtered[h]) for h in members if h in filtered)
+    if args.num_nodes > 0:
+        active = OrderedDict(list(active.items())[:args.num_nodes])
+    return active
+
+
+def run_elastic(args) -> int:
+    """dstpu --elastic: DSElasticAgent supervising the RunSupervisor.
+
+    The agent polls the hostfile and relaunches on membership change; the
+    rc contract does the rest — 114 (preemption) resumes without touching
+    --max-restarts, the stall rc and crashes count against it."""
+    from ..elasticity.elastic_agent import DSElasticAgent
+
+    def launch(members):
+        active = elastic_active_world(args, members)
+        if not active:
+            sys.exit("dstpu --elastic: every confirmed member is excluded "
+                     "by --include/--exclude; nothing to launch")
+        exports = collect_env_exports()
+        if args.launcher in ("pdsh", "openmpi", "slurm", "mvapich"):
+            # the backend command is one OS process — a plain Popen is
+            # already the facade the agent monitors
+            return subprocess.Popen(_backend_cmd(args, active, exports))
+        return build_world_supervisor(active, args, exports).start()
+
+    agent = DSElasticAgent(launch, args.hostfile,
+                           max_restarts=args.max_restarts,
+                           min_nodes=args.min_nodes,
+                           check_interval=args.check_interval,
+                           teardown_grace=args.grace_secs)
+    return agent.run()
 
 
 if __name__ == "__main__":

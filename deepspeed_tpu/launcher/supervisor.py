@@ -1,0 +1,379 @@
+"""RunSupervisor — fail-fast supervision of a multi-host launch.
+
+The pre-round-4 launcher waited on per-host ssh processes SERIALLY
+(runner.py): a crashed host was only noticed after every EARLIER host in
+the list exited, a wedged host stalled the whole pod forever (each live
+rank sits in a collective waiting for the dead one), and the final
+``rc = rc or p.returncode`` folded every exit code into "first nonzero" —
+erasing the preemption/crash distinction ``DSElasticAgent`` depends on.
+
+This module supervises all ranks CONCURRENTLY:
+
+- **first failure tears the world down**: any rank exiting nonzero (or a
+  preempted/stalled rank) triggers SIGTERM to every other rank, a grace
+  deadline for their preemption handlers to checkpoint, then SIGKILL for
+  the stragglers. No half-dead pods burning TPU hours.
+- **connect-phase retries**: ssh dispatch that fails BEFORE the remote
+  shell started (ssh's own rc 255 under ``-o ConnectTimeout``, or a
+  ``launch.ssh`` chaos fault) retries with bounded exponential backoff.
+  A rank whose remote shell already started (it printed the
+  :data:`STARTED_SENTINEL` line) is NEVER retried — re-dispatching a rank
+  that may have run user code would double-run the job.
+- **preemption-aware aggregation**: the overall rc is computed from the
+  ranks that exited VOLUNTARILY (before teardown signaled them): a
+  genuine crash rc wins, else a preemption (``PREEMPTION_EXIT_CODE``,
+  114) yields 114 — so "the pod was preempted" survives the launcher and
+  the elastic agent resumes without burning its restart budget. A stalled
+  rank's ``STALL_EXIT_CODE`` propagates the same way and DOES count as a
+  failure.
+
+The supervisor exposes a ``Popen``-like facade (``poll``/``wait``/
+``terminate``/``kill``/``returncode``) so ``DSElasticAgent.launch_fn``
+can return a started supervisor and the agent's monitor loop supervises
+the supervisor itself.
+
+reference counterpart: ``deepspeed/launcher/runner.py``'s pdsh path +
+``launch.py``'s terminate_process_tree sweep; concurrency and the rc
+contract are the TPU-native additions (one hung rank deadlocks EVERY
+collective in a multi-controller job, so liveness is global).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+from ..elasticity.elastic_agent import PREEMPTION_EXIT_CODE
+from ..testing import chaos
+from ..utils.logging import logger
+
+#: Line a remote shell prints once ssh has connected and the per-host
+#: bootstrap is about to exec — the boundary between "connect phase"
+#: (retryable) and "ran user code" (never retried).
+STARTED_SENTINEL = "DSTPU-RANK-STARTED"
+
+#: ssh reserves 255 for ITS OWN failures (connection refused/timeout,
+#: auth); user commands exiting 255 are indistinguishable, which is why
+#: the sentinel — not the rc — decides retryability.
+SSH_CONNECT_RC = 255
+
+
+class RankSpec:
+    """One supervised rank: where and what to launch.
+
+    ``remote=True`` marks ssh dispatch — connect-phase failures retry and
+    stdout is scanned for :data:`STARTED_SENTINEL`. Local ranks are
+    "started" by construction (Popen succeeding IS the start).
+
+    ``env``: extra environment for LOCAL ranks (remote ranks carry their
+    exports inside the ssh command line) — the .deepspeed_env /
+    collect_env_exports entries a loopback host must still receive even
+    though no ssh shell injects them."""
+
+    __slots__ = ("host", "cmd", "remote", "env")
+
+    def __init__(self, host: str, cmd: Sequence[str], remote: bool = False,
+                 env: Optional[dict] = None):
+        self.host = host
+        self.cmd = list(cmd)
+        self.remote = remote
+        self.env = dict(env) if env else None
+
+
+class _RankStatus:
+    __slots__ = ("rc", "signaled", "started", "attempts", "finished_at")
+
+    def __init__(self):
+        self.rc: Optional[int] = None
+        self.signaled = False       # torn down by the supervisor
+        self.started = False        # remote shell reached user code
+        self.attempts = 0
+        self.finished_at: Optional[float] = None
+
+
+class RunSupervisor:
+    """Monitor every rank concurrently; tear the world down on first
+    failure; aggregate exit codes preemption-aware."""
+
+    def __init__(self,
+                 specs: Sequence[RankSpec],
+                 grace_secs: float = 30.0,
+                 connect_retries: int = 3,
+                 connect_backoff: float = 0.5,
+                 connect_backoff_max: float = 10.0,
+                 popen_fn: Optional[Callable[..., subprocess.Popen]] = None,
+                 stream=None):
+        self.specs = list(specs)
+        self.grace_secs = float(grace_secs)
+        self.connect_retries = int(connect_retries)
+        self.connect_backoff = float(connect_backoff)
+        self.connect_backoff_max = float(connect_backoff_max)
+        self._popen = popen_fn or subprocess.Popen
+        self._stream = stream if stream is not None else sys.stdout
+        self.status = [_RankStatus() for _ in self.specs]
+        self._procs: List[Optional[subprocess.Popen]] = [None] * len(self.specs)
+        self._lock = threading.Lock()
+        self._teardown_started = threading.Event()
+        self._done = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._started = False
+        self.returncode: Optional[int] = None
+        if not self.specs:
+            self.returncode = 0
+            self._done.set()
+
+    # -------------------------------------------------------------- lifecycle
+
+    def start(self) -> "RunSupervisor":
+        if self._started or not self.specs:
+            return self
+        self._started = True
+        for idx in range(len(self.specs)):
+            t = threading.Thread(target=self._monitor_rank, args=(idx,),
+                                 name=f"dstpu-rank-{idx}", daemon=True)
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def run(self) -> int:
+        """start() + wait(): the non-elastic launcher entry point."""
+        return self.start().wait()
+
+    # ----------------------------------------------------- Popen-like facade
+
+    def poll(self) -> Optional[int]:
+        return self.returncode if self._done.is_set() else None
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        if not self._done.wait(timeout):
+            raise subprocess.TimeoutExpired(cmd="RunSupervisor",
+                                            timeout=timeout)
+        return self.returncode
+
+    def terminate(self) -> None:
+        """External teardown request (elastic agent: membership change)."""
+        self._trigger_teardown("terminate() requested")
+
+    def kill(self) -> None:
+        with self._lock:
+            procs = [p for p in self._procs if p is not None]
+            for st, p in zip(self.status, self._procs):
+                if p is not None and p.poll() is None:
+                    st.signaled = True
+        self._teardown_started.set()    # stop pending connect retries
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+
+    # ---------------------------------------------------------- rank monitor
+
+    def _forward_output(self, idx: int, proc: subprocess.Popen) -> None:
+        """Reader for a remote rank's merged stdout/stderr: recognizes the
+        started sentinel and prefixes every other line with the host."""
+        st = self.status[idx]
+        host = self.specs[idx].host
+        for line in proc.stdout:
+            if STARTED_SENTINEL in line:
+                st.started = True
+                continue
+            try:
+                self._stream.write(f"[{host}] {line}")
+                self._stream.flush()
+            except (ValueError, OSError):
+                pass        # parent stream closed mid-teardown
+
+    def _launch_once(self, idx: int) -> subprocess.Popen:
+        spec = self.specs[idx]
+        if spec.remote:
+            # the ssh dispatch failpoint: tests simulate connection
+            # failures deterministically (raise mode == ConnectTimeout)
+            chaos.failpoint("launch.ssh")
+            proc = self._popen(spec.cmd, stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT, text=True)
+            reader = threading.Thread(target=self._forward_output,
+                                      args=(idx, proc),
+                                      name=f"dstpu-out-{idx}", daemon=True)
+            reader.start()
+            proc._dstpu_reader = reader
+        else:
+            env = {**os.environ, **spec.env} if spec.env else None
+            proc = self._popen(spec.cmd, env=env)
+            self.status[idx].started = True
+        return proc
+
+    def _monitor_rank(self, idx: int) -> None:
+        spec = self.specs[idx]
+        st = self.status[idx]
+        attempt = 0
+        rc: Optional[int] = None
+        while not self._teardown_started.is_set():
+            attempt += 1
+            st.attempts = attempt
+            try:
+                proc = self._launch_once(idx)
+            except (OSError, chaos.ChaosError) as e:
+                rc = SSH_CONNECT_RC
+                if self._retry_connect(spec, st, attempt, e):
+                    continue
+                break
+            with self._lock:
+                self._procs[idx] = proc
+                late_teardown = (self._teardown_started.is_set()
+                                 and proc.poll() is None)
+                if late_teardown:
+                    st.signaled = True
+            if late_teardown:
+                # this proc registered after _do_teardown's snapshot — it
+                # still gets the full SIGTERM -> grace -> SIGKILL contract
+                self._term_then_kill(proc)
+            rc = proc.wait()
+            reader = getattr(proc, "_dstpu_reader", None)
+            if reader is not None:
+                reader.join(timeout=5)
+            connect_failed = (spec.remote and not st.started
+                              and not st.signaled and rc == SSH_CONNECT_RC)
+            if connect_failed and self._retry_connect(
+                    spec, st, attempt,
+                    f"ssh exited {SSH_CONNECT_RC} before the remote shell "
+                    "started"):
+                with self._lock:
+                    self._procs[idx] = None
+                continue
+            break
+        if rc is None or (self._teardown_started.is_set() and not st.started
+                          and rc == SSH_CONNECT_RC):
+            # the teardown aborted this rank's connect attempts — its 255
+            # is an artifact of the abort, not the failure that triggered it
+            st.signaled = True
+        st.rc = SSH_CONNECT_RC if rc is None else rc
+        st.finished_at = time.monotonic()
+        self._on_rank_exit(idx)
+
+    def _retry_connect(self, spec: RankSpec, st: _RankStatus, attempt: int,
+                       why) -> bool:
+        """Bounded exponential backoff for CONNECT-phase failures only."""
+        if not spec.remote or st.started or attempt > self.connect_retries:
+            return False
+        delay = min(self.connect_backoff * (2 ** (attempt - 1)),
+                    self.connect_backoff_max)
+        logger.warning(
+            "supervisor: connect to %s failed (%s); retry %d/%d in %.2fs",
+            spec.host, why, attempt, self.connect_retries, delay)
+        # sleep in slices so a teardown mid-backoff aborts the retry
+        deadline = time.monotonic() + delay
+        while time.monotonic() < deadline:
+            if self._teardown_started.wait(min(0.05, delay)):
+                return False
+        return not self._teardown_started.is_set()
+
+    # -------------------------------------------------------------- teardown
+
+    def _on_rank_exit(self, idx: int) -> None:
+        st = self.status[idx]
+        spec = self.specs[idx]
+        if st.rc != 0 and not st.signaled:
+            kind = {PREEMPTION_EXIT_CODE: "preempted"}.get(st.rc, "failed")
+            logger.error("supervisor: rank %d (%s) %s with rc=%d — tearing "
+                         "down the world", idx, spec.host, kind, st.rc)
+            self._trigger_teardown(f"rank {idx} ({spec.host}) rc={st.rc}")
+        with self._lock:
+            all_done = all(s.rc is not None for s in self.status)
+        if all_done and not self._done.is_set():
+            self.returncode = self._aggregate()
+            self._done.set()
+
+    def _term_then_kill(self, proc: subprocess.Popen) -> None:
+        """SIGTERM one process now, SIGKILL it if it outlives the grace
+        deadline — the per-proc form of _do_teardown's sweep, for procs
+        that registered after the sweep's snapshot."""
+        try:
+            proc.terminate()
+        except OSError:
+            return
+
+        def _escalate():
+            deadline = time.monotonic() + self.grace_secs
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    return
+                time.sleep(0.05)
+            if proc.poll() is None:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+
+        threading.Thread(target=_escalate, name="dstpu-late-teardown",
+                         daemon=True).start()
+
+    def _trigger_teardown(self, reason: str) -> None:
+        with self._lock:
+            if self._teardown_started.is_set():
+                return
+            self._teardown_started.set()
+        t = threading.Thread(target=self._do_teardown, args=(reason,),
+                             name="dstpu-teardown", daemon=True)
+        t.start()
+
+    def _do_teardown(self, reason: str) -> None:
+        """SIGTERM the survivors (their preemption handlers get the grace
+        window to checkpoint), then SIGKILL whatever outlives it."""
+        with self._lock:
+            live = []
+            for st, p in zip(self.status, self._procs):
+                if p is not None and p.poll() is None:
+                    st.signaled = True
+                    live.append(p)
+        if live:
+            logger.warning("supervisor: teardown (%s): SIGTERM %d ranks, "
+                           "grace %.1fs", reason, len(live), self.grace_secs)
+        for p in live:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + self.grace_secs
+        while time.monotonic() < deadline:
+            if all(p.poll() is not None for p in live):
+                return
+            time.sleep(0.05)
+        for p in live:
+            if p.poll() is None:
+                logger.error("supervisor: rank outlived the grace deadline "
+                             "— SIGKILL")
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+
+    # ----------------------------------------------------------- aggregation
+
+    def _aggregate(self) -> int:
+        """Overall rc from the VOLUNTARY exits (ranks that finished before
+        teardown signaled them): genuine crash > preemption > clean. The
+        torn-down remnants' codes (-15/-9, or 114 from their own handlers)
+        must not mask what actually happened first."""
+        voluntary = [st for st in self.status if not st.signaled]
+        crashes = [st for st in voluntary
+                   if st.rc not in (0, PREEMPTION_EXIT_CODE)]
+        if crashes:
+            first = min(crashes, key=lambda s: s.finished_at or 0.0)
+            return first.rc
+        if any(st.rc == PREEMPTION_EXIT_CODE for st in voluntary):
+            return PREEMPTION_EXIT_CODE
+        if all(st.rc == 0 for st in self.status):
+            return 0
+        # only torn-down ranks are nonzero: an external terminate() (the
+        # elastic agent's restart) — surface a preemption if any handler
+        # checkpointed, else the first nonzero remnant
+        if any(st.rc == PREEMPTION_EXIT_CODE for st in self.status):
+            return PREEMPTION_EXIT_CODE
+        nonzero = [st.rc for st in self.status if st.rc != 0]
+        return nonzero[0] if nonzero else 0

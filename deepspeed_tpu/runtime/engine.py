@@ -44,6 +44,7 @@ from ..parallel.mesh import MeshManager, build_mesh_from_config
 from ..utils.logging import log_dist, logger
 from ..utils.partitioning import build_tp_specs
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from ..testing import chaos
 from . import checkpointing as ckpt_lib
 from .loss_scaler import LossScaler
 from .lr_schedules import LRScheduler, build_schedule
@@ -525,6 +526,23 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.micro_steps = 0
 
+        # stall watchdog (round-4; docs/RESILIENCE.md): heartbeat on every
+        # optimizer step; a gap beyond stall_timeout dumps all stacks and
+        # exits STALL_EXIT_CODE so the supervisor can tear the world down.
+        # NOT started here: the clock arms at the FIRST completed step —
+        # XLA compile time (minutes at scale) must never read as a stall;
+        # a hang before step 1 is init_deadline's jurisdiction.
+        self.watchdog = None
+        wd = self.config.watchdog
+        if wd.stall_timeout > 0:
+            from .watchdog import StallWatchdog
+            self.watchdog = StallWatchdog(
+                wd.stall_timeout,
+                poll_interval=wd.poll_interval or None)
+            log_dist(f"stall watchdog configured: timeout "
+                     f"{wd.stall_timeout}s (arms at the first step)",
+                     ranks=[0])
+
         # progressive layer drop + eigenvalue (reference: engine hooks for
         # runtime/progressive_layer_drop.py + runtime/eigenvalue.py) ---------
         self.progressive_layer_drop = None
@@ -946,6 +964,12 @@ class DeepSpeedEngine:
             raise RuntimeError(
                 "engine has no optimizer: add an 'optimizer' section to the "
                 "config or pass optimizer= to initialize()")
+        # run-phase failpoints (testing/chaos.py; armed via DSTPU_CHAOS in
+        # subprocess chaos tests, no-ops otherwise): a crashing, preempted
+        # or wedged rank at a step boundary
+        chaos.failpoint("run.kill")
+        chaos.failpoint("run.preempt")
+        chaos.failpoint("run.hang")
         from ..parallel.mesh import BATCH_AXES
         if self.curriculum is not None:
             batch = self.curriculum(batch, self.global_steps)
@@ -1029,8 +1053,13 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch):
         batch = self.shard_batch(batch)
-        return self._eval_step(self._params_device(), batch, self.next_rng(),
-                               self.state.step)
+        out = self._eval_step(self._params_device(), batch, self.next_rng(),
+                              self.state.step)
+        if self.watchdog is not None:
+            # evaluation progress is liveness too: a long validation pass
+            # between optimizer steps must not read as a training stall
+            self.watchdog.beat()
+        return out
 
     # --- micro-batch API (reference forward/backward/step contract) ----------
 
@@ -1101,6 +1130,10 @@ class DeepSpeedEngine:
                     "engine.eval() first (forward-only program, no "
                     "gradient residuals).")
         self._pending = (batch, rng, loss, grads)
+        if self.watchdog is not None:
+            # micro-API liveness: scoring loops (eval-mode forward, no
+            # step()) must not read as a training stall
+            self.watchdog.beat()
         return loss
 
     __call__ = forward
@@ -1175,6 +1208,11 @@ class DeepSpeedEngine:
 
     def _after_step(self, metrics):  # graftlint: hotpath
         self.global_steps += 1
+        if self.watchdog is not None:
+            # step progress IS the liveness signal (dispatch completed; a
+            # wedged collective never reaches this line). start() is
+            # idempotent — the first completed step arms the clock.
+            self.watchdog.start().beat()
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self._last_metrics = metrics
@@ -1438,21 +1476,27 @@ class DeepSpeedEngine:
                               tag: Optional[str],
                               client_state: Optional[dict] = None):
         """Shared body of the periodic save and the preemption-time
-        emergency save (which forces a synchronous engine)."""
-        tag = tag or f"global_step{self.global_steps}"
-        client_state = dict(client_state or {})
-        client_state["global_steps"] = self.global_steps
-        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "state_dict"):
-            client_state["lr_scheduler"] = self.lr_scheduler.state_dict()
-        lazy = getattr(ckpt_engine, "wants_lazy", True)
-        ckpt = self.config.checkpoint
-        return ckpt_lib.save_checkpoint(
-            save_dir, tag, self._ckpt_view(lazy=lazy), client_state,
-            master_aliases_params=(not self.keep_master
-                                   and self.offload is None),
-            ckpt_engine=ckpt_engine,
-            keep_last=ckpt.keep_last,
-            keep_every=ckpt.keep_every)
+        emergency save (which forces a synchronous engine). Runs with the
+        stall watchdog suspended: save time is IO-bound and legitimately
+        unbounded by step time."""
+        import contextlib
+        suspend = (self.watchdog.suspended() if self.watchdog is not None
+                   else contextlib.nullcontext())
+        with suspend:
+            tag = tag or f"global_step{self.global_steps}"
+            client_state = dict(client_state or {})
+            client_state["global_steps"] = self.global_steps
+            if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "state_dict"):
+                client_state["lr_scheduler"] = self.lr_scheduler.state_dict()
+            lazy = getattr(ckpt_engine, "wants_lazy", True)
+            ckpt = self.config.checkpoint
+            return ckpt_lib.save_checkpoint(
+                save_dir, tag, self._ckpt_view(lazy=lazy), client_state,
+                master_aliases_params=(not self.keep_master
+                                       and self.offload is None),
+                ckpt_engine=ckpt_engine,
+                keep_last=ckpt.keep_last,
+                keep_every=ckpt.keep_every)
 
     def wait_for_checkpoints(self):
         """Durability barrier for async checkpointing (reference: Nebula
@@ -1465,7 +1509,9 @@ class DeepSpeedEngine:
     def close(self):
         """Explicit resource shutdown: drain + stop the async checkpoint
         writer (previously only ``__del__`` did, losing pending writes at
-        interpreter teardown)."""
+        interpreter teardown) and stop the stall watchdog."""
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if hasattr(self, "checkpoint_engine"):
             return self.checkpoint_engine.close()
         return True
@@ -1475,14 +1521,32 @@ class DeepSpeedEngine:
         """Preemption-time save: drain any pending async writes (their tag
         must not interleave with ours on the FIFO worker), then write
         synchronously — the grace window is no place for a fire-and-forget
-        thread."""
+        thread.
+
+        Overlap contract (round-4): if the drain itself just published an
+        intact checkpoint of THIS step — an async save was in flight when
+        the signal landed — the emergency save must NOT rewrite the same
+        tag. The rewrite would burn grace-window seconds re-serializing
+        the whole model, and dying mid-rewrite would leave `latest` on a
+        tag whose staging debris shadows the drained publish."""
         from ..checkpoint.engine import NpzCheckpointEngine
+        drained_ok = True
         if hasattr(self, "checkpoint_engine"):
             try:
-                self.checkpoint_engine.commit("preempt-drain")
+                drained_ok = bool(self.checkpoint_engine.commit(
+                    "preempt-drain"))
             except Exception as e:       # a failed past save must not
+                drained_ok = False
                 logger.error("preempt: drain of pending checkpoint "
                              "writes failed: %s", e)   # block THIS save
+        tag = f"global_step{self.global_steps}"
+        if drained_ok and ckpt_lib.get_latest_tag(save_dir) == tag:
+            path = os.path.join(save_dir, tag)
+            if ckpt_lib.verify_tag(path) is None:
+                log_dist(f"preempt: drained in-flight save already "
+                         f"published intact {tag}; skipping the duplicate "
+                         "emergency write", ranks=[0])
+                return path
         client_state = dict(client_state or {})
         client_state["preempted"] = True
         return self._save_checkpoint_with(NpzCheckpointEngine(), save_dir,
@@ -1513,6 +1577,11 @@ class DeepSpeedEngine:
                 exit_fn(PREEMPTION_EXIT_CODE)
                 return
             state["fired"] = True
+            if self.watchdog is not None:
+                # the grace window is save time, not step time — the stall
+                # watchdog must not shoot us mid-emergency-save (never
+                # resumed: this process only leaves via exit_fn)
+                self.watchdog.suspend()
             watchdog = threading.Timer(
                 max(grace_secs, 0.1),
                 lambda: exit_fn(PREEMPTION_EXIT_CODE))

@@ -1,0 +1,199 @@
+"""In-worker stall watchdog — turn a silent hang into a diagnosable exit.
+
+In a one-process-per-host multi-controller job a single wedged rank (a
+deadlocked collective, a hung host, a dead coordinator) stalls EVERY rank:
+all of them sit inside a collective waiting for the straggler, forever.
+Durable checkpoints (PR 3) don't help if nothing ever exits — supervision
+needs a liveness signal. This module provides two:
+
+- :class:`StallWatchdog`: a daemon thread fed by ``engine.step()``
+  progress (``beat()``). If no heartbeat arrives within ``stall_timeout``
+  seconds it dumps EVERY thread's stack via ``faulthandler`` (the hang is
+  usually in a collective or an IO thread, not the main thread) and exits
+  with :data:`STALL_EXIT_CODE` — a distinct rc so the launcher-side
+  supervisor and the elastic agent can tell "wedged" from "crashed" from
+  "preempted". The watchdog SUSPENDS during checkpoint saves and the
+  preemption grace window: slow-but-progressing IO must never be misread
+  as a hang.
+
+- :func:`init_deadline`: a bounded window around
+  ``jax.distributed.initialize`` (launch.py / comm.py). A dead or
+  unreachable coordinator makes initialize block forever with zero
+  diagnostics; under a deadline the worker dumps stacks and exits with
+  the stall rc instead, so the supervisor tears the launch down fast.
+
+Exit-code contract (docs/RESILIENCE.md): 0 = clean,
+``PREEMPTION_EXIT_CODE`` (114) = checkpointed-and-resumable,
+``STALL_EXIT_CODE`` (117) = wedged (counts against the elastic agent's
+``max_restarts`` — a stall is a failure, not a preemption).
+
+reference counterpart: torch-elastic's watchdog/healthcheck timers on the
+agent; placing the heartbeat IN the worker is what lets a jax_graft
+worker self-report before the collective deadlock propagates.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import faulthandler
+import io
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+#: Exit code meaning "this worker made no step progress within the stall
+#: timeout". Distinct from Python's 0-2, shell signal codes (>=128),
+#: chaos.KILL_EXIT_CODE (13) and PREEMPTION_EXIT_CODE (114).
+STALL_EXIT_CODE = 117
+
+
+def _dump_stacks(stream, reason: str) -> None:
+    """All-threads stack dump. Best-effort: diagnostics must never mask
+    the exit itself. faulthandler (async-signal-safe, the production
+    path) needs a real fd; fd-less streams (tests, redirected stderr)
+    fall back to a pure-Python dump via sys._current_frames()."""
+    try:
+        stream.write(f"\n=== dstpu watchdog: {reason} — "
+                     "dumping all thread stacks ===\n")
+        stream.flush()
+        try:
+            stream.fileno()
+            faulthandler.dump_traceback(file=stream, all_threads=True)
+        except (AttributeError, OSError, ValueError, io.UnsupportedOperation):
+            import traceback
+            names = {t.ident: t.name for t in threading.enumerate()}
+            for tid, frame in sys._current_frames().items():
+                stream.write(f"\nThread {names.get(tid, '?')} ({tid}):\n")
+                traceback.print_stack(frame, file=stream)
+        stream.flush()
+    except Exception:
+        pass
+
+
+class StallWatchdog:
+    """Heartbeat-fed stall detector.
+
+    ``beat()`` is called from the engine's step path; a gap longer than
+    ``stall_timeout`` seconds (while not suspended) dumps stacks and calls
+    ``exit_fn(STALL_EXIT_CODE)`` (default ``os._exit`` — a wedged process
+    cannot be trusted to unwind). ``suspended()`` brackets operations
+    whose duration is legitimately unbounded by step time (checkpoint
+    saves, the preemption grace window); leaving the bracket re-arms the
+    clock from now, so save time is never charged to the next step.
+    """
+
+    def __init__(self,
+                 stall_timeout: float,
+                 poll_interval: Optional[float] = None,
+                 exit_fn: Optional[Callable[[int], None]] = None,
+                 stream=None):
+        if stall_timeout <= 0:
+            raise ValueError("stall_timeout must be > 0 (0 disables the "
+                             "watchdog at the config layer, not here)")
+        self.stall_timeout = float(stall_timeout)
+        self.poll_interval = (float(poll_interval) if poll_interval
+                              else max(self.stall_timeout / 4.0, 0.05))
+        self._exit_fn = exit_fn or os._exit
+        self._stream = stream if stream is not None else sys.stderr
+        self._lock = threading.Lock()
+        self._last_beat = time.monotonic()
+        self._suspends = 0          # nested suspensions (save inside grace)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = False          # observable by in-process tests
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> "StallWatchdog":
+        if self._thread is not None:
+            return self
+        # fresh event per start: start() after stop() must arm a REAL
+        # monitor, not a thread that sees the stale stop flag and dies
+        self._stop = threading.Event()
+        self._last_beat = time.monotonic()
+        self._thread = threading.Thread(target=self._run,
+                                        name="dstpu-stall-watchdog",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=self.poll_interval * 4)
+        self._thread = None
+
+    # ------------------------------------------------------------ heartbeat
+
+    def beat(self) -> None:
+        with self._lock:
+            self._last_beat = time.monotonic()
+
+    def suspend(self) -> None:
+        with self._lock:
+            self._suspends += 1
+
+    def resume(self) -> None:
+        with self._lock:
+            self._suspends = max(0, self._suspends - 1)
+            # the suspended window must not count toward the NEXT gap
+            self._last_beat = time.monotonic()
+
+    @contextlib.contextmanager
+    def suspended(self):
+        """Bracket a save (or any legitimately slow section): the watchdog
+        cannot fire inside, and the clock restarts on exit."""
+        self.suspend()
+        try:
+            yield self
+        finally:
+            self.resume()
+
+    # ----------------------------------------------------------------- loop
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            with self._lock:
+                if self._suspends > 0:
+                    continue
+                gap = time.monotonic() - self._last_beat
+            if gap <= self.stall_timeout:
+                continue
+            self.fired = True
+            _dump_stacks(self._stream,
+                         f"no step progress for {gap:.1f}s "
+                         f"(stall_timeout={self.stall_timeout:.1f}s)")
+            self._exit_fn(STALL_EXIT_CODE)
+            return          # test exit_fns return instead of exiting
+
+
+@contextlib.contextmanager
+def init_deadline(timeout: float,
+                  what: str = "jax.distributed.initialize",
+                  exit_fn: Optional[Callable[[int], None]] = None,
+                  stream=None):
+    """Hard deadline around process bootstrap. ``timeout <= 0`` is a
+    no-op (opt-in knob). If the body doesn't finish in time, dump all
+    stacks and exit ``STALL_EXIT_CODE`` — a worker that never rendezvoused
+    holds no state worth saving, and the fast distinct exit is what lets
+    the supervisor tear the launch down instead of waiting forever."""
+    if timeout is None or timeout <= 0:
+        yield
+        return
+    exit_fn = exit_fn or os._exit
+    out = stream if stream is not None else sys.stderr
+
+    def _expired():
+        _dump_stacks(out, f"{what} did not complete within {timeout:.1f}s")
+        exit_fn(STALL_EXIT_CODE)
+
+    timer = threading.Timer(timeout, _expired)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    finally:
+        timer.cancel()

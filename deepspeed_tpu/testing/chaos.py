@@ -27,6 +27,19 @@ Spec syntax (env var or ``arm()``)::
     DSTPU_CHAOS="ckpt.write:raise:skip=1"     # pass 1 hit, fail the 2nd
     DSTPU_CHAOS="ckpt.write:raise:times=2"    # fail the first 2 hits
     DSTPU_CHAOS="a:raise;b:kill:skip=3"       # several failpoints
+    DSTPU_CHAOS="run.preempt:kill:code=114"   # kill with a chosen exit code
+    DSTPU_CHAOS="run.hang:hang"               # block forever (wedged rank)
+    DSTPU_CHAOS="ckpt.write:sleep:ms=300"     # delay, then continue
+    DSTPU_CHAOS="run.preempt:sigterm"         # SIGTERM self (preemption)
+
+Run-supervision modes (round-4): ``hang`` blocks the calling thread
+forever — the userspace approximation of a wedged collective, what the
+stall watchdog and the supervisor's teardown exist to catch. ``sleep``
+delays ``ms`` milliseconds and then continues — for overlap tests that
+need an IO operation to still be in flight when something else happens.
+``sigterm`` sends SIGTERM to the calling process (the installed
+preemption handler fires, exactly like a real TPU preemption notice).
+``kill`` takes ``code=N`` to emulate any exit-code contract.
 
 reference counterpart: DeepSpeed's tests monkeypatch torch.save /
 simulate SIGTERM by hand per test; a named-failpoint registry is the
@@ -37,7 +50,9 @@ request) — one mechanism, every crash site.
 from __future__ import annotations
 
 import os
+import signal
 import threading
+import time
 from typing import Dict, List, Optional
 
 #: exit code used by ``kill`` mode — distinct from Python's 1 and from
@@ -61,17 +76,24 @@ class ChaosError(IOError):
         self.failpoint = name
 
 
-class _FailPoint:
-    __slots__ = ("name", "mode", "skip", "times", "hits", "fired")
+_MODES = ("raise", "kill", "hang", "sleep", "sigterm")
 
-    def __init__(self, name: str, mode: str, skip: int = 0, times: int = 1):
-        if mode not in ("raise", "kill"):
-            raise ValueError(f"chaos mode must be 'raise' or 'kill', "
+
+class _FailPoint:
+    __slots__ = ("name", "mode", "skip", "times", "hits", "fired", "code",
+                 "ms")
+
+    def __init__(self, name: str, mode: str, skip: int = 0, times: int = 1,
+                 code: Optional[int] = None, ms: int = 0):
+        if mode not in _MODES:
+            raise ValueError(f"chaos mode must be one of {_MODES}, "
                              f"got {mode!r}")
         self.name = name
         self.mode = mode
         self.skip = skip
         self.times = times
+        self.code = KILL_EXIT_CODE if code is None else code
+        self.ms = ms        # sleep mode: delay in milliseconds
         self.hits = 0       # total traversals of this failpoint
         self.fired = 0      # times it actually failed
 
@@ -91,7 +113,7 @@ def parse_spec(spec: str) -> Dict[str, _FailPoint]:
         kwargs = {}
         for f in fields[2:]:
             k, _, v = f.partition("=")
-            if k not in ("skip", "times"):
+            if k not in ("skip", "times", "code", "ms"):
                 raise ValueError(f"bad chaos spec option {f!r} in {part!r}")
             kwargs[k] = int(v)
         out[name] = _FailPoint(name, mode, **kwargs)
@@ -109,10 +131,12 @@ def _load_env_once() -> None:
             _armed.update(parse_spec(spec))
 
 
-def arm(name: str, mode: str = "raise", skip: int = 0, times: int = 1) -> None:
+def arm(name: str, mode: str = "raise", skip: int = 0, times: int = 1,
+        code: Optional[int] = None, ms: int = 0) -> None:
     """Programmatically arm a failpoint (in-process tests)."""
     with _lock:
-        _armed[name] = _FailPoint(name, mode, skip=skip, times=times)
+        _armed[name] = _FailPoint(name, mode, skip=skip, times=times,
+                                  code=code, ms=ms)
 
 
 def disarm(name: Optional[str] = None) -> None:
@@ -155,8 +179,11 @@ def failpoint(name: str) -> None:
     """Declare a failpoint. No-op unless a test armed ``name``.
 
     ``raise`` mode raises :class:`ChaosError` (an IOError). ``kill`` mode
-    calls ``os._exit(KILL_EXIT_CODE)`` — no atexit handlers, no flushes:
-    the closest userspace approximation of the machine dying.
+    calls ``os._exit(code)`` (default ``KILL_EXIT_CODE``) — no atexit
+    handlers, no flushes: the closest userspace approximation of the
+    machine dying. ``hang`` blocks this thread forever (a wedged rank);
+    ``sleep`` delays ``ms`` milliseconds then continues; ``sigterm``
+    raises SIGTERM in this process (drives the preemption handler).
     """
     if not _env_loaded:
         _load_env_once()
@@ -171,7 +198,16 @@ def failpoint(name: str) -> None:
             return
         fp.fired += 1
         _history.append(name)
-        mode = fp.mode
+        mode, code, ms = fp.mode, fp.code, fp.ms
     if mode == "kill":
-        os._exit(KILL_EXIT_CODE)
+        os._exit(code)
+    if mode == "hang":
+        while True:             # cannot be woken — only killed from outside
+            time.sleep(3600)
+    if mode == "sleep":
+        time.sleep(ms / 1000.0)
+        return
+    if mode == "sigterm":
+        os.kill(os.getpid(), signal.SIGTERM)
+        return
     raise ChaosError(name)

@@ -1,9 +1,13 @@
 #!/usr/bin/env bash
 # Fault-injection suite, standalone: crash a real checkpoint save at every
-# named failpoint (plus kill-mid-write and SIGTERM subprocess tests) and
-# prove resume. See docs/RESILIENCE.md for the failpoint catalog.
+# named failpoint (plus kill-mid-write and SIGTERM subprocess tests), prove
+# resume, and drive the round-4 run-supervision matrix — fail-fast teardown,
+# stall watchdog stack-dump/rc, connect retries, rc-114 end-to-end through
+# dstpu --elastic, and the per-rank failpoint in the REAL 2-process sharded
+# save. Includes the `slow`-marked engine-in-child tests tier-1 skips.
+# See docs/RESILIENCE.md for the failpoint catalog and exit-code contract.
 #
-#   scripts/chaos.sh              # full crash-safety suite
+#   scripts/chaos.sh              # full crash-safety + supervision suite
 #   scripts/chaos.sh -k sigterm   # subset (pytest -k forwarded)
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -12,5 +16,8 @@ cd "$(dirname "$0")/.."
 # fire inside arbitrary tests (tests/conftest.py also scrubs this)
 unset DSTPU_CHAOS
 
-exec env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
-    -p no:cacheprovider "$@"
+exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_chaos.py \
+    tests/test_supervisor.py \
+    "tests/test_multiprocess.py::test_two_process_sharded_save_with_per_rank_failpoint" \
+    -q -p no:cacheprovider "$@"

@@ -381,6 +381,70 @@ def test_tpu008_ignores_specs_outside_constraint_sites(tmp_path):
     assert "TPU008" not in codes(findings, gating_only=False)
 
 
+# --------------------------------------------------------------------- TPU009
+
+def test_tpu009_positive_bf16_carry_widened(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def run(xs):
+            def body(c, x):
+                c = (c + x).astype(jnp.float32)
+                return c, x
+            init = jnp.zeros((8,), jnp.bfloat16)
+            return lax.scan(body, init, xs)
+    """)
+    hits = [f for f in findings if f.rule == "TPU009"]
+    assert hits and "carry" in hits[0].message
+    assert hits[0].severity == Severity.WARNING
+
+
+def test_tpu009_positive_inline_init_f32_wrap(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def run(xs):
+            def body(c, x):
+                return jnp.float32(c + x), x
+            return lax.scan(body, jnp.zeros((8,), jnp.bfloat16), xs)
+    """)
+    assert [f.rule for f in findings if f.rule == "TPU009"]
+
+
+def test_tpu009_negative_carry_cast_back(tmp_path):
+    # the CORRECT idiom: accumulate in an f32 island, carry bf16
+    findings = lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def run(xs):
+            def body(c, x):
+                acc = c.astype(jnp.float32) + x
+                return acc.astype(jnp.bfloat16), x
+            init = jnp.zeros((8,), jnp.bfloat16)
+            return lax.scan(body, init, xs)
+    """)
+    assert "TPU009" not in codes(findings, gating_only=False)
+
+
+def test_tpu009_negative_f32_scan_untouched(tmp_path):
+    # an intentionally-f32 scan (init shows no 16-bit evidence) never fires
+    findings = lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def run(xs):
+            def body(c, x):
+                return c.astype(jnp.float32) + x, x
+            init = jnp.zeros((8,), jnp.float32)
+            return lax.scan(body, init, xs)
+    """)
+    assert "TPU009" not in codes(findings, gating_only=False)
+
+
 # --------------------------------------------- suppressions / baseline / CLI
 
 def test_inline_suppression_same_line(tmp_path):
@@ -474,7 +538,7 @@ def test_baseline_entries_carry_justification():
 
 def test_rule_registry_complete():
     assert {"TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
-            "TPU007", "TPU008", "TPU010"} <= set(RULES)
+            "TPU007", "TPU008", "TPU009", "TPU010"} <= set(RULES)
     for code, rule in RULES.items():
         assert rule.summary and rule.name, code
 

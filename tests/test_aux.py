@@ -77,6 +77,37 @@ def test_world_info_roundtrip():
     assert decode_world_info(encode_world_info(active)) == active
 
 
+def test_collect_env_exports_forwards_dstpu_prefix(monkeypatch):
+    """Round-4: DSTPU_* (chaos specs, coordinator overrides, init
+    timeouts) must reach remote hosts — they previously never did."""
+    from deepspeed_tpu.launcher.runner import collect_env_exports
+    monkeypatch.setenv("DSTPU_CHAOS", "run.kill:kill")
+    monkeypatch.setenv("DSTPU_INIT_TIMEOUT", "60")
+    monkeypatch.setenv("JAX_TRACEBACK_FILTERING", "off")
+    monkeypatch.setenv("DSTPU_UNRELATED_HOME", "keepme")
+    monkeypatch.setenv("NOT_FORWARDED", "x")
+    exports = collect_env_exports()
+    assert exports["DSTPU_CHAOS"] == "run.kill:kill"
+    assert exports["DSTPU_INIT_TIMEOUT"] == "60"
+    assert exports["DSTPU_UNRELATED_HOME"] == "keepme"
+    assert exports["JAX_TRACEBACK_FILTERING"] == "off"
+    assert "NOT_FORWARDED" not in exports
+
+
+def test_build_ssh_cmd_connect_timeout_and_sentinel():
+    """The supervisor's connect-phase contract lives in the ssh argv:
+    ConnectTimeout bounds dead-host dispatch, and the sentinel line marks
+    the retryable/not-retryable boundary."""
+    from deepspeed_tpu.launcher.runner import build_ssh_cmd
+    from deepspeed_tpu.launcher.supervisor import STARTED_SENTINEL
+    cmd = build_ssh_cmd("w1", ["python", "t.py"], {"A": "b"},
+                        connect_timeout=7)
+    assert "ConnectTimeout=7" in cmd
+    remote = cmd[-1]
+    assert f"echo {STARTED_SENTINEL}; exec" in remote
+    assert remote.index("export A=b") < remote.index(STARTED_SENTINEL)
+
+
 # -- flops profiler -----------------------------------------------------------
 
 def test_compiled_cost_counts_matmul_flops():

@@ -102,6 +102,26 @@ def test_chaos_spec_parsing_and_reset():
     assert chaos.fired() == []
 
 
+def test_chaos_run_supervision_modes():
+    """Round-4 spec surface: kill exit-code override, sleep delay, hang,
+    sigterm (the firing paths for hang/sigterm are exercised by the
+    subprocess tests in test_supervisor.py — in-process they would wedge
+    or kill the suite)."""
+    fps = chaos.parse_spec("a:kill:code=114;b:sleep:ms=50;c:hang;d:sigterm")
+    assert fps["a"].mode == "kill" and fps["a"].code == 114
+    assert fps["b"].mode == "sleep" and fps["b"].ms == 50
+    assert fps["c"].mode == "hang" and fps["d"].mode == "sigterm"
+    assert chaos.parse_spec("x:kill")["x"].code == chaos.KILL_EXIT_CODE
+    with pytest.raises(ValueError):
+        chaos.parse_spec("a:kill:bogus=1")
+    # sleep mode: fires, delays, then CONTINUES (no exception)
+    chaos.arm("s", "sleep", ms=40)
+    t0 = time.monotonic()
+    chaos.failpoint("s")
+    assert time.monotonic() - t0 >= 0.03
+    assert chaos.fired("s") == ["s"]
+
+
 # ------------------------------------------------- crash-at-every-stage matrix
 
 #: every named failpoint a save traverses, in execution order
@@ -370,6 +390,72 @@ def test_engine_async_save_failure_then_clean_save(tmp_path):
     e.save_checkpoint(d)
     assert e.wait_for_checkpoints()
     assert ck.get_latest_tag(d) == "global_step3"
+    assert e.close()
+
+
+# ------------------------------------------ emergency-save / async overlap
+
+def _install_handler_scoped(e, d, rcs):
+    """install_preemption_handler swaps the PROCESS signal handlers; an
+    in-process test must restore them or later tests inherit the hook."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def scoped():
+        prev_term = signal.getsignal(signal.SIGTERM)
+        prev_int = signal.getsignal(signal.SIGINT)
+        try:
+            yield e.install_preemption_handler(d, grace_secs=60,
+                                               exit_fn=rcs.append)
+        finally:
+            signal.signal(signal.SIGTERM, prev_term)
+            signal.signal(signal.SIGINT, prev_int)
+    return scoped()
+
+
+def test_emergency_save_skips_tag_already_drained(tmp_path):
+    """ROADMAP gap (round-4): SIGTERM lands while the async writer still
+    has THIS step's save in flight. The grace-window drain publishes it;
+    the emergency save must NOT rewrite the same tag — the rewrite burns
+    grace seconds re-serializing the model, and dying mid-rewrite leaves
+    staging debris shadowing the drained publish."""
+    d = str(tmp_path / "ck")
+    e = _engine({"checkpoint": {"async_save": True}})
+    e.train_batch(random_batch(8, seed=0))
+    chaos.arm("ckpt.write", "sleep", ms=250, times=2)
+    e.save_checkpoint(d)                      # async: writes in flight
+    rcs = []
+    with _install_handler_scoped(e, d, rcs) as handler:
+        handler()                             # the preemption "signal"
+    assert rcs == [PREEMPTION_EXIT_CODE]
+    # exactly the async save's two data writes hit the writer: the
+    # emergency path drained and SKIPPED, it did not write again
+    assert chaos._armed["ckpt.write"].hits == 2
+    assert ck.get_latest_tag(d) == "global_step1"
+    assert ck.verify_tag(os.path.join(d, "global_step1")) is None
+    assert [n for n in os.listdir(d)
+            if n.endswith((".tmp", ck.QUARANTINE_SUFFIX))] == []
+    assert e.close()
+
+
+def test_emergency_save_writes_fresh_tag_when_steps_advanced(tmp_path):
+    """The skip is exact: once training advanced past the in-flight tag,
+    the emergency save must still write the NEW step."""
+    d = str(tmp_path / "ck")
+    e = _engine({"checkpoint": {"async_save": True}})
+    e.train_batch(random_batch(8, seed=0))
+    e.save_checkpoint(d)                      # global_step1 (async)
+    e.train_batch(random_batch(8, seed=1))    # now at step 2, unsaved
+    rcs = []
+    with _install_handler_scoped(e, d, rcs) as handler:
+        handler()
+    assert rcs == [PREEMPTION_EXIT_CODE]
+    assert ck.get_latest_tag(d) == "global_step2"
+    assert ck.verify_tag(os.path.join(d, "global_step2")) is None
+    r = _engine()
+    _, client = r.load_checkpoint(d)
+    assert client.get("preempted") is True
+    assert client["global_steps"] == 2
     assert e.close()
 
 

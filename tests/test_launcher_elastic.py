@@ -76,6 +76,28 @@ def test_launcher_end_to_end_localhost(tmp_path):
     assert marker.read_text() == "--hello world"
 
 
+def test_elastic_active_world_honors_include_exclude(tmp_path):
+    """--exclude must hold across elastic relaunches: a flaky host kept
+    out of the pod must not re-enter the world on the next restart."""
+    from deepspeed_tpu.launcher.runner import elastic_active_world
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("w0 slots=2\nw1 slots=2\nw2 slots=1\n")
+    args = types.SimpleNamespace(hostfile=str(hostfile), include="",
+                                 exclude="w1", num_nodes=-1)
+    active = elastic_active_world(args, ["w0", "w1", "w2"])
+    assert list(active) == ["w0", "w2"]
+    assert active["w0"] == [0, 1]
+    # include filter narrows slots too
+    args = types.SimpleNamespace(hostfile=str(hostfile), include="w0:1@w2",
+                                 exclude="", num_nodes=-1)
+    active = elastic_active_world(args, ["w0", "w1", "w2"])
+    assert active == {"w0": [1], "w2": [0]}
+    # no hostfile: localhost fallback world
+    args = types.SimpleNamespace(hostfile=str(tmp_path / "missing"),
+                                 include="", exclude="", num_nodes=-1)
+    assert elastic_active_world(args, ["localhost"]) == {"localhost": [0]}
+
+
 # -- elastic agent ------------------------------------------------------------
 
 def test_elastic_agent_restarts_on_crash(tmp_path):

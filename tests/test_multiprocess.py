@@ -15,6 +15,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
 
 WORKER = r"""
@@ -126,6 +128,54 @@ print(f"RANK{rank} OK tp={tl[-1]:.4f} pp={pl[-1]:.4f}", flush=True)
 """
 
 
+WORKER_RANK_FAILPOINT = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+sys.path.insert(0, os.environ["DSTPU_TEST_REPO"])
+
+import deepspeed_tpu as ds
+
+ds.init_distributed()
+rank = ds.comm.get_rank()
+sys.path.insert(0, os.path.join(os.environ["DSTPU_TEST_REPO"], "tests"))
+from util import SimpleModel, random_batch
+from deepspeed_tpu.runtime import checkpointing as ck
+
+config = {
+    "train_batch_size": 8,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    "zero_optimization": {"stage": 1},
+    "seed": 11,
+}
+engine, *_ = ds.initialize(model=SimpleModel(), config=config,
+                           example_batch=random_batch(8))
+ckdir = os.environ["DSTPU_TEST_CKPT"]
+engine.train_batch(random_batch(8, seed=0))
+engine.save_checkpoint(ckdir)             # clean sharded save: both ranks ok
+# non-zero ranks return from the save's allgather BEFORE rank 0 publishes
+# `latest` — order the read behind the publish
+ds.comm.barrier("after-save-1")
+assert ck.get_latest_tag(ckdir) == "global_step1", ck.get_latest_tag(ckdir)
+
+engine.train_batch(random_batch(8, seed=1))
+# DSTPU_CHAOS (rank 1 only, skip=2) fails rank 1's shard writes HERE: the
+# failure folds into the ok flag, every rank reaches the allgather, and
+# `latest` must not advance onto the half-written tag
+engine.save_checkpoint(ckdir)
+ds.comm.barrier("after-save-2")
+assert ck.get_latest_tag(ckdir) == "global_step1", ck.get_latest_tag(ckdir)
+
+# no rank hung in the barrier AND the collectives still work after the
+# failed save — the surviving-rank path is genuinely alive
+loss = float(engine.train_batch(random_batch(8, seed=2))["loss"])
+assert loss == loss, loss
+print(f"RANK{rank} SURVIVED ok", flush=True)
+"""
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -184,6 +234,53 @@ def test_two_process_train_and_checkpoint(tmp_path):
     assert int(engine.state.step) == 12
     m = engine.train_batch(random_batch(8, seed=100))
     assert float(m["loss"]) == float(m["loss"])   # finite, trains on
+
+@pytest.mark.slow
+def test_two_process_sharded_save_with_per_rank_failpoint(tmp_path):
+    """ROADMAP gap (round-4): the REAL multi-host save path under a
+    per-rank fault. Rank 1's shard writes fail mid-sharded-save (via
+    DSTPU_CHAOS threaded into just that worker's env — the launcher now
+    forwards DSTPU_* for exactly this); the PR-3 ok-flag/allgather path
+    must keep every rank out of a hung barrier, leave `latest` on the
+    previous tag, and quarantine the shared staging dir."""
+    import os
+    worker = tmp_path / "worker_failpoint.py"
+    worker.write_text(WORKER_RANK_FAILPOINT)
+    port = _free_port()
+    ckdir = tmp_path / "ck"
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ,
+                   DSTPU_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   DSTPU_NUM_PROCESSES="2",
+                   DSTPU_PROCESS_ID=str(pid),
+                   DSTPU_TEST_REPO=REPO_ROOT,
+                   DSTPU_TEST_CKPT=str(ckdir))
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("DSTPU_CHAOS", None)
+        if pid == 1:
+            # skip the 2 clean first-save shard files, then fail every
+            # write of the second save — rank 0 stays fault-free
+            env["DSTPU_CHAOS"] = "ckpt.write:raise:skip=2:times=100"
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out[-3000:]}"
+        assert f"RANK{pid} SURVIVED ok" in out, out[-2000:]
+
+    from deepspeed_tpu.runtime import checkpointing as ck
+    assert ck.get_latest_tag(str(ckdir)) == "global_step1"
+    assert ck.list_tags(str(ckdir)) == ["global_step1"]
+    # the half-written tag was quarantined for forensics, not published
+    assert any(n.startswith("global_step2") and
+               n.endswith(ck.QUARANTINE_SUFFIX)
+               for n in os.listdir(ckdir)), os.listdir(ckdir)
+
 
 def test_two_process_tp_and_pp(tmp_path):
     """TP=2 and PP=2 over two REAL OS processes x 4 global devices (2 local

@@ -1,0 +1,427 @@
+"""Run supervision: fail-fast teardown, connect retries, stall watchdog,
+and the agent-over-supervisor exit-code contract (round 4).
+
+The fast tests drive RunSupervisor/StallWatchdog over plain python
+workers — no engine, sub-second. The ``slow``-marked subprocess tests
+spawn a REAL engine in a child and prove the in-worker halves end to end
+(run.hang -> stack dump + stall rc; run.preempt -> emergency save + rc
+114); ``scripts/chaos.sh`` runs them standalone.
+
+Exit-code contract under test (docs/RESILIENCE.md): 0 = clean, 114 =
+preempted-and-checkpointed (agent resumes, uncounted), 117 = stalled
+(agent restarts, counted), anything else = crash (counted).
+"""
+
+import io
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                    PREEMPTION_EXIT_CODE)
+from deepspeed_tpu.launcher.supervisor import (RankSpec, RunSupervisor,
+                                               SSH_CONNECT_RC,
+                                               STARTED_SENTINEL)
+from deepspeed_tpu.runtime.watchdog import (STALL_EXIT_CODE, StallWatchdog,
+                                            init_deadline)
+from deepspeed_tpu.testing import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PY = sys.executable
+
+
+def _spec(code, host="h", remote=False):
+    return RankSpec(host, [PY, "-c", code], remote=remote)
+
+
+# -------------------------------------------------------- fail-fast teardown
+
+def test_kill_one_rank_tears_down_world_within_grace():
+    """Acceptance (a): one rank dies -> every other rank is torn down
+    within the grace deadline, not after its natural exit."""
+    t0 = time.monotonic()
+    sup = RunSupervisor([
+        _spec("import time; time.sleep(0.2); raise SystemExit(3)", "h0"),
+        _spec("import time; time.sleep(120)", "h1"),
+        _spec("import time; time.sleep(120)", "h2"),
+    ], grace_secs=2.0)
+    rc = sup.run()
+    elapsed = time.monotonic() - t0
+    assert rc == 3
+    # 0.2s crash + SIGTERM (sleepers die instantly) << the 120s naps
+    assert elapsed < 30, elapsed
+    assert sup.status[0].signaled is False          # the voluntary crash
+    assert sup.status[1].signaled and sup.status[2].signaled
+
+
+def test_sigkill_escalation_after_grace_deadline():
+    """A rank that ignores SIGTERM is SIGKILLed once the grace expires."""
+    stubborn = ("import signal, time\n"
+                "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+                "print('armored', flush=True)\n"
+                "time.sleep(120)\n")
+    t0 = time.monotonic()
+    sup = RunSupervisor([
+        _spec("import time; time.sleep(0.3); raise SystemExit(1)", "h0"),
+        _spec(stubborn, "h1"),
+    ], grace_secs=0.5)
+    rc = sup.run()
+    assert rc == 1
+    assert time.monotonic() - t0 < 30
+    assert sup.status[1].signaled
+
+
+def test_all_ranks_clean_is_zero():
+    sup = RunSupervisor([_spec("pass", f"h{i}") for i in range(3)])
+    assert sup.run() == 0
+    assert all(st.rc == 0 and not st.signaled for st in sup.status)
+
+
+# ------------------------------------------------ preemption-aware aggregate
+
+def test_preemption_rc_survives_teardown_aggregation():
+    """Acceptance (c), launcher half: one rank exits 114, the rest are
+    torn down -> overall 114, not -15/"crash"."""
+    sup = RunSupervisor([
+        _spec(f"raise SystemExit({PREEMPTION_EXIT_CODE})", "h0"),
+        _spec("import time; time.sleep(120)", "h1"),
+    ], grace_secs=1.0)
+    assert sup.run() == PREEMPTION_EXIT_CODE
+
+
+def test_crash_beats_preemption_in_aggregate():
+    """A genuine crash observed alongside a preemption is a crash — the
+    rc that matters is the one that costs the restart budget."""
+    sup = RunSupervisor([
+        _spec(f"import time; time.sleep(0.05); "
+              f"raise SystemExit({PREEMPTION_EXIT_CODE})", "h0"),
+        _spec("raise SystemExit(7)", "h1"),
+    ], grace_secs=1.0)
+    assert sup.run() == 7
+
+
+def test_stall_rc_propagates_as_failure():
+    sup = RunSupervisor([
+        _spec(f"raise SystemExit({STALL_EXIT_CODE})", "h0"),
+        _spec("import time; time.sleep(120)", "h1"),
+    ], grace_secs=1.0)
+    assert sup.run() == STALL_EXIT_CODE
+
+
+# ------------------------------------------------------ connect-phase retry
+
+def test_connect_failure_retries_with_backoff_then_succeeds():
+    chaos.arm("launch.ssh", "raise", times=2)
+    buf = io.StringIO()
+    sup = RunSupervisor(
+        [_spec(f"print('{STARTED_SENTINEL}'); print('payload ran')",
+               "h0", remote=True)],
+        connect_backoff=0.01, stream=buf)
+    assert sup.run() == 0
+    assert sup.status[0].attempts == 3
+    assert sup.status[0].started
+    assert "payload ran" in buf.getvalue()
+    assert STARTED_SENTINEL not in buf.getvalue()    # sentinel swallowed
+
+
+def test_connect_retries_are_bounded():
+    chaos.arm("launch.ssh", "raise", times=100)
+    sup = RunSupervisor([_spec("pass", "h0", remote=True)],
+                        connect_retries=2, connect_backoff=0.01)
+    assert sup.run() == SSH_CONNECT_RC
+    assert sup.status[0].attempts == 3               # 1 try + 2 retries
+
+
+def test_rank_that_started_user_code_is_never_retried():
+    """rc 255 AFTER the sentinel is user-code death over a live
+    connection — re-dispatching would double-run the job."""
+    sup = RunSupervisor(
+        [_spec(f"print('{STARTED_SENTINEL}', flush=True); "
+               f"raise SystemExit({SSH_CONNECT_RC})", "h0", remote=True)],
+        connect_backoff=0.01)
+    assert sup.run() == SSH_CONNECT_RC
+    assert sup.status[0].attempts == 1
+
+
+def test_local_rank_receives_spec_env():
+    """Loopback ranks have no ssh command line to carry exports —
+    RankSpec.env must reach the child (e.g. .deepspeed_env entries not in
+    the launcher's own environ)."""
+    sup = RunSupervisor([RankSpec(
+        "localhost",
+        [PY, "-c", "import os, sys; "
+         "sys.exit(0 if os.environ.get('DSTPU_VERIFY_ENV') == 'yes' else 5)"],
+        env={"DSTPU_VERIFY_ENV": "yes"})])
+    assert sup.run() == 0
+
+
+def test_watchdog_restarts_after_stop():
+    """start() after stop() must arm a REAL monitor thread (a stale stop
+    flag would leave the engine believing it is protected)."""
+    rcs = []
+    wd = StallWatchdog(stall_timeout=0.1, poll_interval=0.02,
+                       exit_fn=rcs.append, stream=io.StringIO())
+    wd.start()
+    wd.stop()
+    assert rcs == []
+    wd.start()
+    deadline = time.monotonic() + 10
+    while not rcs and time.monotonic() < deadline:
+        time.sleep(0.02)
+    wd.stop()
+    assert rcs == [STALL_EXIT_CODE]
+
+
+# --------------------------------------------------- Popen facade + the agent
+
+def test_popen_facade_poll_wait_terminate():
+    sup = RunSupervisor([_spec("import time; time.sleep(120)", "h0")],
+                        grace_secs=0.5).start()
+    assert sup.poll() is None
+    with pytest.raises(subprocess.TimeoutExpired):
+        sup.wait(timeout=0.2)
+    sup.terminate()
+    rc = sup.wait(timeout=30)
+    assert rc != 0                       # torn down, not clean
+    assert sup.poll() == rc == sup.returncode
+
+
+def test_agent_resumes_preempted_supervisor_without_counting(tmp_path):
+    """Acceptance (c), agent half: worker 114 -> supervisor 114 -> agent
+    resumes with max_restarts=0 still intact."""
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("localhost slots=1\n")
+    attempts = tmp_path / "n"
+
+    launches = []
+
+    def launch(members):
+        launches.append(1)
+        code = (f"import os\np={str(attempts)!r}\n"
+                "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+                "open(p, 'w').write(str(n + 1))\n"
+                f"raise SystemExit({PREEMPTION_EXIT_CODE} if n == 0 else 0)\n")
+        specs = [RankSpec("localhost", [PY, "-c", code])]
+        if len(launches) == 1:
+            # first world: a second rank that must be torn down when
+            # rank 0 is preempted (the clean relaunch runs solo)
+            specs.append(_spec("import time; time.sleep(120)", "h1"))
+        return RunSupervisor(specs, grace_secs=1.0).start()
+
+    agent = DSElasticAgent(launch, str(hostfile), max_restarts=0,
+                           check_interval=0.05)
+    assert agent.run() == 0
+    assert agent.preemptions == 1
+    assert agent.restarts == 0
+    assert attempts.read_text() == "2"
+
+
+def test_agent_counts_stall_against_max_restarts(tmp_path):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("localhost slots=1\n")
+    attempts = tmp_path / "n"
+
+    def launch(members):
+        code = (f"import os\np={str(attempts)!r}\n"
+                "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+                "open(p, 'w').write(str(n + 1))\n"
+                f"raise SystemExit({STALL_EXIT_CODE} if n == 0 else 0)\n")
+        return RunSupervisor([RankSpec("localhost", [PY, "-c", code])],
+                             grace_secs=1.0).start()
+
+    agent = DSElasticAgent(launch, str(hostfile), max_restarts=1,
+                           check_interval=0.05)
+    assert agent.run() == 0
+    assert agent.stalls == 1
+    assert agent.restarts == 1
+    assert agent.preemptions == 0
+
+
+# ------------------------------------------------------------ stall watchdog
+
+def test_watchdog_fires_on_stall_with_stack_dump():
+    rcs = []
+    buf = io.StringIO()
+    wd = StallWatchdog(stall_timeout=0.15, poll_interval=0.02,
+                       exit_fn=rcs.append, stream=buf).start()
+    try:
+        deadline = time.monotonic() + 10
+        while not rcs and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        wd.stop()
+    assert rcs == [STALL_EXIT_CODE]
+    assert wd.fired
+    out = buf.getvalue()
+    assert "no step progress" in out
+    # faulthandler dumped at least this thread's stack
+    assert "test_supervisor" in out or "Thread" in out
+
+
+def test_watchdog_beats_and_suspension_prevent_firing():
+    rcs = []
+    wd = StallWatchdog(stall_timeout=0.2, poll_interval=0.02,
+                       exit_fn=rcs.append, stream=io.StringIO()).start()
+    try:
+        for _ in range(5):                       # heartbeats hold it off
+            time.sleep(0.1)
+            wd.beat()
+        with wd.suspended():                     # a "slow save"
+            time.sleep(0.5)
+        time.sleep(0.1)                          # resume re-arms from now
+    finally:
+        wd.stop()
+    assert rcs == []
+    assert not wd.fired
+
+
+def test_init_deadline_noop_when_disabled_and_fires_when_hung():
+    with init_deadline(0):                       # disabled: pure pass-through
+        pass
+    rcs = []
+    buf = io.StringIO()
+    with init_deadline(0.1, what="test-init", exit_fn=rcs.append,
+                       stream=buf):
+        time.sleep(0.4)
+    assert rcs == [STALL_EXIT_CODE]
+    assert "test-init" in buf.getvalue()
+    rcs2 = []
+    with init_deadline(5.0, exit_fn=rcs2.append, stream=io.StringIO()):
+        pass                                     # fast body: timer cancelled
+    time.sleep(0.05)
+    assert rcs2 == []
+
+
+def test_exit_code_contract_is_distinct():
+    assert len({0, PREEMPTION_EXIT_CODE, STALL_EXIT_CODE,
+                chaos.KILL_EXIT_CODE}) == 4
+    assert STALL_EXIT_CODE < 126                 # below shell signal space
+
+
+# ----------------------------------------------------------- dstpu --elastic
+
+def test_dstpu_elastic_cli_preemption_resume(tmp_path):
+    """bin/dstpu --elastic end to end: worker exits 114 on the first
+    attempt; with --max-restarts 0 only the preemption exemption lets the
+    relaunch happen; second attempt exits clean."""
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("localhost slots=1\n")
+    attempts = tmp_path / "n"
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        p = {str(attempts)!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, 'w').write(str(n + 1))
+        sys.exit({PREEMPTION_EXIT_CODE} if n == 0 else 0)
+    """))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [PY, os.path.join(REPO, "bin", "dstpu"),
+         "--hostfile", str(hostfile), "--launcher", "local",
+         "--elastic", "--max-restarts", "0", "--min-nodes", "1",
+         "--check-interval", "0.05", "--grace-secs", "2",
+         str(script)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert attempts.read_text() == "2"
+
+
+def test_dstpu_elastic_cli_crash_exhausts_budget(tmp_path):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("localhost slots=1\n")
+    script = tmp_path / "train.py"
+    script.write_text("import sys; sys.exit(9)\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [PY, os.path.join(REPO, "bin", "dstpu"),
+         "--hostfile", str(hostfile), "--launcher", "local",
+         "--elastic", "--max-restarts", "1", "--check-interval", "0.05",
+         str(script)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 9, (proc.returncode, proc.stderr[-2000:])
+
+
+# ----------------------------- engine-in-child chaos proofs (scripts/chaos.sh)
+
+def _run_child(code, tmp_path, env_extra=None, timeout=300):
+    env = dict(os.environ,
+               PYTHONPATH=os.pathsep.join([REPO, os.path.join(REPO, "tests")]),
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    env.pop("DSTPU_CHAOS", None)
+    env.update(env_extra or {})
+    script = tmp_path / "child.py"
+    script.write_text(textwrap.dedent(code))
+    return subprocess.Popen([PY, str(script)], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True), timeout
+
+
+CHILD_TRAIN = """
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import deepspeed_tpu as ds
+from util import SimpleModel, random_batch
+
+cfg = {"train_batch_size": 8,
+       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+       "watchdog": {"stall_timeout": 1.5, "poll_interval": 0.1}}
+e, *_ = ds.initialize(model=SimpleModel(), config=cfg,
+                      example_batch=random_batch(8))
+if os.environ.get("INSTALL_PREEMPT"):
+    e.install_preemption_handler(os.environ["CKDIR"], grace_secs=60)
+for i in range(50):
+    e.train_batch(random_batch(8, seed=i))
+raise SystemExit(99)                      # chaos must fire before step 50
+"""
+
+
+@pytest.mark.slow
+def test_wedged_engine_dumps_stacks_and_exits_stall_rc(tmp_path):
+    """Acceptance (b): a wedged rank (run.hang on the 3rd step) produces
+    an all-threads stack dump and the distinct stall rc."""
+    proc, timeout = _run_child(
+        CHILD_TRAIN, tmp_path,
+        env_extra={"DSTPU_CHAOS": "run.hang:hang:skip=2"})
+    out, err = proc.communicate(timeout=timeout)
+    assert proc.returncode == STALL_EXIT_CODE, (proc.returncode, err[-2000:])
+    assert "no step progress" in err
+    assert "dumping all thread stacks" in err
+    # the wedged thread is visible in the dump
+    assert "Current thread" in err or "Thread" in err
+
+
+@pytest.mark.slow
+def test_run_preempt_failpoint_emergency_save_rc114(tmp_path):
+    """run.preempt (SIGTERM self) at a step boundary: the preemption
+    handler checkpoints inside the grace window, the watchdog stays
+    suspended through the save, and the process exits 114."""
+    d = str(tmp_path / "ck")
+    proc, timeout = _run_child(
+        CHILD_TRAIN, tmp_path,
+        env_extra={"DSTPU_CHAOS": "run.preempt:sigterm:skip=2",
+                   "INSTALL_PREEMPT": "1", "CKDIR": d})
+    out, err = proc.communicate(timeout=timeout)
+    assert proc.returncode == PREEMPTION_EXIT_CODE, (proc.returncode,
+                                                     err[-2000:])
+    from deepspeed_tpu.runtime import checkpointing as ck
+    latest = ck.get_latest_tag(d)
+    assert latest is not None
+    assert ck.verify_tag(os.path.join(d, latest)) is None
+
+
+@pytest.mark.slow
+def test_run_kill_failpoint_exits_kill_rc(tmp_path):
+    proc, timeout = _run_child(
+        CHILD_TRAIN, tmp_path,
+        env_extra={"DSTPU_CHAOS": "run.kill:kill:skip=1"})
+    out, err = proc.communicate(timeout=timeout)
+    assert proc.returncode == chaos.KILL_EXIT_CODE, (proc.returncode,
+                                                     err[-2000:])
