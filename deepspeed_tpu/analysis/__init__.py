@@ -1,10 +1,18 @@
 """graftlint — JAX/TPU-aware static analysis that gates the hot path.
 
 AST-only (never imports the linted code), so a full-package pass is
-CI-cheap. Rules TPU001–TPU007 target the bug classes that silently
-regress the gas-amortized train step: host syncs, retraces, trace-time
-side effects, dtype leaks, missing donation, tracer branches and PRNG
-key reuse. See docs/LINT.md for the catalog and workflow.
+CI-cheap. Rules TPU001–TPU010 target the per-module bug classes that
+silently regress the gas-amortized train step: host syncs, retraces,
+trace-time side effects, dtype leaks, missing donation, tracer
+branches, PRNG key reuse, sharding-spec drift, scan-carry widening and
+unscoped kernels. TPU011–TPU013 are INTERPROCEDURAL: a project-wide
+call graph (callgraph.py) + collective catalog (collectives.py) make
+rank-divergent collectives, invalid mesh axes and collective-order
+divergence visible across function and module boundaries — the
+distributed-hang class PRs 3–4 fixed at runtime. ``--fix`` autofixes
+the mechanical rules; ``--sarif`` emits SARIF 2.1.0 for CI PR
+annotation. See docs/LINT.md for the catalog, architecture and
+workflows.
 
 Programmatic use::
 
@@ -12,10 +20,14 @@ Programmatic use::
     findings = lint_paths(["deepspeed_tpu/"])
 """
 
-from . import rules as _rules  # noqa: F401  (registers TPU001–TPU007)
+from . import rules as _rules  # noqa: F401  (registers TPU001–TPU010)
+from . import rules_collective as _rules2  # noqa: F401  (TPU011–TPU013)
 from .baseline import Baseline, DEFAULT_BASELINE
+from .callgraph import ProjectIndex
 from .cli import main
-from .core import Finding, ModuleInfo, Rule, RULES, Severity, lint_paths
+from .core import (Finding, ModuleInfo, Rule, RULES, Severity, lint_modules,
+                   lint_paths)
 
-__all__ = ["Baseline", "DEFAULT_BASELINE", "Finding", "ModuleInfo", "Rule",
-           "RULES", "Severity", "lint_paths", "main"]
+__all__ = ["Baseline", "DEFAULT_BASELINE", "Finding", "ModuleInfo",
+           "ProjectIndex", "Rule", "RULES", "Severity", "lint_modules",
+           "lint_paths", "main"]

@@ -1,0 +1,426 @@
+"""Project-wide call graph and symbol resolution for graftlint.
+
+Per-module analysis (jitscope.py) answers "is this node under a trace?";
+this pass answers the questions that need to see the WHOLE lint run at
+once:
+
+symbol resolution
+    Every def gets a dotted name (``deepspeed_tpu.comm.comm.barrier``,
+    ``...checkpoint.engine.TorchCheckpointEngine.save``). Imports —
+    including RELATIVE imports, which jitscope ignores — map local names
+    onto those dotted names, and one level of re-export indirection is
+    followed (``from .comm import barrier`` in ``comm/__init__.py`` makes
+    ``deepspeed_tpu.comm.barrier`` an alias of the real def), so a call
+    through any spelling resolves to the same FunctionNode.
+
+call edges
+    Bare-name calls resolve to same-module defs; ``self.meth()`` to
+    methods of the enclosing class; dotted calls through the import map
+    to defs in OTHER modules of the same lint run.
+
+rank guards
+    ``if jax.process_index() == 0:`` / ``if comm.get_rank() != 0:`` /
+    ``if rank == 0:`` (name matched, or a local assigned from a rank
+    probe) mark their body AND orelse as rank-divergent: only some
+    processes execute them. World-size probes (``process_count``,
+    ``get_world_size``) are uniform across ranks and are NOT guards.
+
+collective reachability
+    For each function, the set of collectives (see collectives.py)
+    reachable through UNGUARDED calls — the payload TPU011 checks when a
+    call site sits under a rank guard, so "rank 0 calls a helper whose
+    helper calls barrier()" is caught the same as a direct barrier.
+
+axis contexts
+    For each function, the named-axis sets it can run under: direct
+    ``shard_map``/``pmap`` wraps where this function (or a lambda) is the
+    mapped callable, propagated through call edges and lexical nesting.
+    Contexts whose axis names aren't statically visible are UNKNOWN and
+    make TPU012 stay silent rather than guess.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from . import collectives as C
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+#: canonical dotted names whose call result is THIS process's rank
+RANK_PROBES = {
+    "jax.process_index",
+    "deepspeed_tpu.comm.get_rank", "deepspeed_tpu.comm.comm.get_rank",
+    "deepspeed_tpu.comm.get_local_rank",
+    "deepspeed_tpu.comm.comm.get_local_rank",
+}
+#: bare attribute/function names that read as a rank probe even when the
+#: receiver can't be resolved (``_jax.process_index()``, ``dist.get_rank()``)
+_RANK_CALL_ATTRS = {"process_index", "get_rank", "get_local_rank"}
+#: identifiers that denote a rank by convention (params, locals, attrs)
+_RANK_NAME = re.compile(
+    r"^(?:global_|local_|node_)?rank$|^process_index$|^process_id$")
+
+
+def module_dotted_name(rel_path: str) -> str:
+    """'deepspeed_tpu/comm/comm.py' -> 'deepspeed_tpu.comm.comm';
+    '__init__.py' collapses onto its package."""
+    p = rel_path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [x for x in p.split("/") if x and x != "."]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    # paths escaping the root (lint of /tmp fixtures from elsewhere):
+    # fall back to the basename so names stay valid, if not unique
+    parts = [x for x in parts if x != ".."]
+    return ".".join(parts)
+
+
+class FunctionNode:
+    """One def (or lambda) in the project."""
+
+    __slots__ = ("module", "fn", "qualname", "dotted")
+
+    def __init__(self, module, fn: ast.AST, qualname: str, dotted: str):
+        self.module = module
+        self.fn = fn
+        self.qualname = qualname
+        self.dotted = dotted
+
+    def __repr__(self):
+        return f"<fn {self.dotted}>"
+
+
+class ProjectIndex:
+    """Symbol table + call graph over every module in one lint run."""
+
+    def __init__(self, modules: List):
+        self.modules = list(modules)
+        self.mod_dotted: Dict[int, str] = {}     # id(module) -> dotted
+        self._is_init: Dict[int, bool] = {}
+        self.by_dotted: Dict[str, FunctionNode] = {}
+        self.node_of: Dict[ast.AST, FunctionNode] = {}
+        self._aliases: Dict[int, Dict[str, str]] = {}   # per-module imports
+        self._reexports: Dict[str, str] = {}            # dotted -> dotted
+        self._reach: Dict[ast.AST, Dict[str, Tuple[str, int, str]]] = {}
+        self._ctx_memo: Dict[ast.AST, List[C.AxisContext]] = {}
+        self._callers: Dict[ast.AST, List[ast.AST]] = {}
+        self._direct_ctx: Dict[ast.AST, List[C.AxisContext]] = {}
+        self.axis_universe: Set[str] = set()
+        self._rank_locals: Dict[ast.AST, Set[str]] = {}
+        for m in self.modules:
+            self._register_module(m)
+        for m in self.modules:
+            self._collect_imports(m)
+        for m in self.modules:
+            self._collect_contexts_and_axes(m)
+        for m in self.modules:
+            self._collect_callers(m)
+
+    # ------------------------------------------------------------- building
+
+    def _register_module(self, module) -> None:
+        dotted = module_dotted_name(module.rel_path)
+        self.mod_dotted[id(module)] = dotted
+        self._is_init[id(module)] = module.rel_path.endswith("__init__.py")
+        for fn in module.scope._defs:
+            if isinstance(fn, ast.Lambda):
+                node = FunctionNode(module, fn, "<lambda>",
+                                    f"{dotted}.<lambda>@{fn.lineno}")
+            else:
+                qual = module.enclosing_qualname(fn)
+                node = FunctionNode(module, fn, qual, f"{dotted}.{qual}")
+                self.by_dotted.setdefault(node.dotted, node)
+            self.node_of[fn] = node
+
+    def _package_base(self, module, level: int) -> List[str]:
+        parts = self.mod_dotted[id(module)].split(".")
+        if not self._is_init[id(module)]:
+            parts = parts[:-1]
+        drop = level - 1
+        return parts[:len(parts) - drop] if drop else parts
+
+    def _collect_imports(self, module) -> None:
+        """Local name -> dotted prefix, ABSOLUTE and RELATIVE imports both
+        (jitscope's ImportMap skips relative ones; the call graph cannot)."""
+        table: Dict[str, str] = dict(module.scope.imports.aliases)
+        mod_dotted = self.mod_dotted[id(module)]
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and node.level > 0:
+                base = self._package_base(module, node.level)
+                prefix = ".".join(base + ([node.module] if node.module
+                                          else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    table[a.asname or a.name] = f"{prefix}.{a.name}"
+        self._aliases[id(module)] = table
+        # re-export edges: `from X import y as z` makes <module>.z an
+        # alias of X.y for OTHER modules importing through this one
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.level > 0:
+                    base = self._package_base(module, node.level)
+                    src = ".".join(base + ([node.module] if node.module
+                                           else []))
+                elif node.module:
+                    src = node.module
+                else:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self._reexports[f"{mod_dotted}.{a.asname or a.name}"] \
+                        = f"{src}.{a.name}"
+
+    def qualify(self, module, node: ast.AST) -> Optional[str]:
+        """Dotted name of a Name/Attribute chain through this module's
+        FULL import table (absolute + relative)."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        table = self._aliases.get(id(module), {})
+        root = table.get(cur.id, cur.id)
+        return ".".join([root] + list(reversed(parts)))
+
+    def resolve_dotted(self, dotted: str) -> Optional[FunctionNode]:
+        seen = set()
+        while dotted not in self.by_dotted and dotted in self._reexports:
+            if dotted in seen:
+                return None
+            seen.add(dotted)
+            dotted = self._reexports[dotted]
+        return self.by_dotted.get(dotted)
+
+    def resolve_call(self, module, call: ast.Call) -> Optional[FunctionNode]:
+        """The project def a call lands on, or None (builtin / external /
+        dynamic)."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            target = module.scope.resolve_local_def(f)
+            if target is not None:
+                return self.node_of.get(target)
+            dotted = self._aliases.get(id(module), {}).get(f.id)
+            return self.resolve_dotted(dotted) if dotted else None
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                defs = module.scope._by_name.get(f.attr)
+                target = defs[-1] if defs else None
+                return self.node_of.get(target) if target else None
+            dotted = self.qualify(module, f)
+            return self.resolve_dotted(dotted) if dotted else None
+        return None
+
+    # ------------------------------------------------------- rank guards
+
+    def _fn_rank_locals(self, module, fn: Optional[ast.AST]) -> Set[str]:
+        """Names in ``fn`` assigned from a rank probe (``p =
+        jax.process_index()``)."""
+        key = fn if fn is not None else module
+        if key in self._rank_locals:
+            return self._rank_locals[key]
+        names: Set[str] = set()
+        for node in module.nodes_by_fn.get(fn, ()):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) and self._is_rank_call(
+                    module, node.value):
+                for t in node.targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            names.add(leaf.id)
+        self._rank_locals[key] = names
+        return names
+
+    def _is_rank_call(self, module, call: ast.Call) -> bool:
+        q = self.qualify(module, call.func)
+        if q in RANK_PROBES:
+            return True
+        f = call.func
+        attr = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        return attr in _RANK_CALL_ATTRS
+
+    def is_rank_test(self, module, test: ast.AST,
+                     fn: Optional[ast.AST]) -> bool:
+        """Does this condition read the process/rank identity — i.e. can
+        it evaluate differently on different ranks of the same job?"""
+        rank_locals = self._fn_rank_locals(module, fn)
+        for n in ast.walk(test):
+            if isinstance(n, ast.Call) and self._is_rank_call(module, n):
+                return True
+            if isinstance(n, ast.Name) and (
+                    _RANK_NAME.match(n.id) or n.id in rank_locals):
+                return True
+            if isinstance(n, ast.Attribute) and _RANK_NAME.match(n.attr):
+                return True
+        return False
+
+    def rank_guard(self, module, node: ast.AST) -> Optional[ast.If]:
+        """The innermost enclosing ``if`` whose test is rank-divergent
+        (searched up to the enclosing function boundary), else None. Both
+        arms count: the orelse of ``if rank == 0`` runs on the complement
+        set of ranks."""
+        fn = module.enclosing_function(node)
+        prev, cur = node, module.parent(node)
+        while cur is not None and not isinstance(cur, _FN):
+            if isinstance(cur, ast.If) and prev is not cur.test and \
+                    self.is_rank_test(module, cur.test, fn):
+                return cur
+            prev, cur = cur, module.parent(cur)
+        return None
+
+    # ---------------------------------------------- collective reachability
+
+    def collective_name(self, module, call: ast.Call) -> Optional[str]:
+        """Canonical dotted name if this call is a cataloged collective."""
+        q = self.qualify(module, call.func)
+        if C.collective_kind(q):
+            return q
+        # a resolved project def that IS a cataloged facade fn (spelled
+        # through an alias path the catalog doesn't list)
+        target = self.resolve_call(module, call)
+        if target is not None and C.collective_kind(target.dotted):
+            return target.dotted
+        return None
+
+    def direct_collectives(self, module, fn: Optional[ast.AST]
+                           ) -> List[Tuple[ast.Call, str, bool]]:
+        """(call, canonical name, rank_guarded) for collectives directly
+        in ``fn``'s own body (nested defs are their own graph nodes)."""
+        out = []
+        for node in module.nodes_by_fn.get(fn, ()):
+            if isinstance(node, ast.Call):
+                q = self.collective_name(module, node)
+                if q:
+                    out.append((node, q,
+                                self.rank_guard(module, node) is not None))
+        return out
+
+    def call_edges(self, module, fn: Optional[ast.AST]
+                   ) -> List[Tuple[ast.Call, FunctionNode, bool]]:
+        out = []
+        for node in module.nodes_by_fn.get(fn, ()):
+            if isinstance(node, ast.Call):
+                target = self.resolve_call(module, node)
+                if target is not None and target.fn is not fn:
+                    out.append((node, target,
+                                self.rank_guard(module, node) is not None))
+        return out
+
+    def reachable_collectives(self, node: FunctionNode,
+                              _stack: Optional[Set[ast.AST]] = None
+                              ) -> Dict[str, Tuple[str, int, str]]:
+        """Collectives reachable from ``node`` through UNGUARDED paths:
+        {canonical name: (rel_path, line, via-qualname)}. A call or
+        collective already under its own rank guard inside a callee is
+        conditional there — not part of the callee's unconditional
+        contract — so it does not propagate."""
+        fn = node.fn
+        if fn in self._reach:
+            return self._reach[fn]
+        stack = _stack if _stack is not None else set()
+        if fn in stack:
+            return {}
+        stack.add(fn)
+        out: Dict[str, Tuple[str, int, str]] = {}
+        for call, q, guarded in self.direct_collectives(node.module, fn):
+            if not guarded and q not in out:
+                out[q] = (node.module.rel_path, call.lineno, node.qualname)
+        for call, target, guarded in self.call_edges(node.module, fn):
+            if guarded:
+                continue
+            for q, where in self.reachable_collectives(
+                    target, stack).items():
+                out.setdefault(q, where)
+        stack.discard(fn)
+        if _stack is None:
+            # only memoize top-level walks: an INNER result computed while
+            # its caller sits on the cycle stack is truncated at the
+            # back-edge and caching it would make later queries
+            # order-dependent (a top-level DFS visits every reachable node
+            # and accumulates its direct collectives, so it is exact)
+            self._reach[fn] = out
+        return out
+
+    # ------------------------------------------------------- axis contexts
+
+    def _collect_contexts_and_axes(self, module) -> None:
+        """Direct shard_map/pmap wraps + the project axis universe."""
+        for call in module.all_calls:
+            q = self.qualify(module, call.func)
+            ctx: Optional[C.AxisContext] = None
+            if q in C.SHARD_WRAPPERS:
+                ax = next((kw.value for kw in call.keywords
+                           if kw.arg == "axis_names"), None)
+                names = C.literal_axes(ax)
+                ctx = names if names is not None else C.UNKNOWN
+            elif q in C.PMAP_WRAPPERS:
+                ax = next((kw.value for kw in call.keywords
+                           if kw.arg == "axis_name"), None)
+                names = C.literal_axes(ax)
+                ctx = names if names is not None else C.UNKNOWN
+            elif q in C.MESH_CTORS:
+                ax = (call.args[1] if len(call.args) > 1 else
+                      next((kw.value for kw in call.keywords
+                            if kw.arg in ("axis_names", "axis_name")), None))
+                names = C.literal_axes(ax)
+                if names:
+                    self.axis_universe |= names
+                continue
+            else:
+                continue
+            if isinstance(ctx, frozenset):
+                self.axis_universe |= ctx
+            target = None
+            if call.args:
+                arg = call.args[0]
+                target = arg if isinstance(arg, ast.Lambda) else \
+                    module.scope.resolve_local_def(arg)
+            if target is not None:
+                self._direct_ctx.setdefault(target, []).append(ctx)
+        # module-level *AXES* tuple constants (parallel/mesh.py MESH_AXES
+        # and friends) declare names even when Mesh() is built from them
+        for node in module.nodes_by_fn.get(None, ()):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and "AXES" in node.targets[0].id.upper():
+                names = C.literal_axes(node.value)
+                if names:
+                    self.axis_universe |= names
+
+    def _collect_callers(self, module) -> None:
+        for fn in list(module.nodes_by_fn):
+            for call, target, _g in self.call_edges(module, fn):
+                if fn is not None:
+                    self._callers.setdefault(target.fn, []).append(fn)
+
+    def axis_contexts(self, fn: ast.AST,
+                      _stack: Optional[Set[ast.AST]] = None
+                      ) -> List[C.AxisContext]:
+        """Every named-axis context ``fn`` can execute under: direct
+        wraps, callers' contexts, and the lexically enclosing function's
+        contexts (a def nested in a shard_map body runs under its axes)."""
+        if fn in self._ctx_memo:
+            return self._ctx_memo[fn]
+        stack = _stack if _stack is not None else set()
+        if fn in stack:
+            return []
+        stack.add(fn)
+        out: List[C.AxisContext] = list(self._direct_ctx.get(fn, ()))
+        node = self.node_of.get(fn)
+        encl = node.module.enclosing_function(fn) if node else None
+        if encl is not None:
+            out.extend(self.axis_contexts(encl, stack))
+        for caller in self._callers.get(fn, ()):
+            out.extend(self.axis_contexts(caller, stack))
+        stack.discard(fn)
+        if _stack is None:          # only memoize complete computations
+            self._ctx_memo[fn] = out
+        return out

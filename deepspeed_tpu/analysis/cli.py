@@ -14,10 +14,12 @@ import os
 import sys
 from typing import List, Optional
 
-from . import rules as _rules  # noqa: F401  (imports register the rules)
+from . import rules as _rules  # noqa: F401  (imports register TPU001–010)
+from . import rules_collective as _rules2  # noqa: F401  (TPU011–013)
 from .baseline import Baseline, DEFAULT_BASELINE
 from .core import RULES, Severity, lint_paths
-from .reporters import report_json, report_rules, report_text
+from .reporters import (report_json, report_rules, report_sarif,
+                        report_text, write_sarif)
 
 
 def _find_baseline(paths: List[str]) -> Optional[str]:
@@ -49,7 +51,15 @@ def build_parser() -> argparse.ArgumentParser:
         description="JAX/TPU-aware static analysis for deepspeed_tpu")
     p.add_argument("paths", nargs="*", default=None,
                    help="files or directories (default: deepspeed_tpu/)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
+    p.add_argument("--sarif", metavar="PATH",
+                   help="additionally write a SARIF 2.1.0 report to PATH "
+                        "(for CI PR annotation), regardless of --format")
+    p.add_argument("--fix", action="store_true",
+                   help="apply autofixes for the mechanical rules "
+                        "(TPU008 spec canonicalization, TPU010 "
+                        "named_scope wrapping), then re-lint")
     p.add_argument("--baseline", metavar="PATH",
                    help=f"baseline file (default: nearest {DEFAULT_BASELINE})")
     p.add_argument("--no-baseline", action="store_true",
@@ -89,6 +99,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     baseline_path = args.baseline or _find_baseline(paths)
     root = os.path.dirname(os.path.abspath(baseline_path)) \
         if baseline_path else os.getcwd()
+
+    if args.fix:
+        from .fixes import fix_paths
+        n, files = fix_paths(
+            paths, select=args.select, ignore=args.ignore, root=root,
+            baseline_path=None if args.no_baseline else baseline_path)
+        print(f"graftlint: applied {n} fix(es) in {len(files)} file(s)",
+              file=sys.stderr)
+        for fpath in files:
+            print(f"  fixed {os.path.relpath(fpath, root)}",
+                  file=sys.stderr)
+
     findings = lint_paths(paths, select=args.select, ignore=args.ignore,
                           root=root)
 
@@ -105,8 +127,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         bl.apply(findings)
         stale = bl.stale_entries()
 
+    if args.sarif:
+        write_sarif(args.sarif, findings, stale)
     if args.format == "json":
         report_json(findings, stale)
+    elif args.format == "sarif":
+        report_sarif(findings, stale)
     else:
         report_text(findings, stale, show_suppressed=args.show_suppressed)
 

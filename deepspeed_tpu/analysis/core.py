@@ -54,6 +54,10 @@ class Finding:
     suppressed: bool = False
     baselined: bool = False
     justification: str = ""        # from the matching baseline entry
+    # the AST node the rule anchored to — carried for the autofixers
+    # (fixes.py), never serialized
+    node: Optional[ast.AST] = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     def key(self) -> Tuple[str, str, str, str]:
         """Identity used for baseline matching: stable across pure
@@ -112,6 +116,7 @@ class Rule:
             message=message,
             symbol=module.enclosing_qualname(node),
             line_text=module.line_text(line),
+            node=node,
         )
 
 
@@ -196,6 +201,9 @@ class ModuleInfo:
             parse_suppressions(source)
         from .jitscope import JitScope
         self.scope = JitScope(self)
+        # attached by lint_modules(): the project-wide callgraph.ProjectIndex
+        # (None when a ModuleInfo is built standalone)
+        self.project = None
 
     # -- navigation -----------------------------------------------------------
 
@@ -266,27 +274,44 @@ def lint_paths(paths: Iterable[str],
     """Lint every .py under ``paths``. Returns ALL findings — including
     suppressed ones (marked) so reporters can count them; baseline matching
     happens in the CLI layer."""
+    return lint_modules(paths, select=select, ignore=ignore, root=root)[0]
+
+
+def lint_modules(paths: Iterable[str],
+                 select: Optional[Set[str]] = None,
+                 ignore: Optional[Set[str]] = None,
+                 root: Optional[str] = None
+                 ) -> Tuple[List[Finding], List["ModuleInfo"]]:
+    """Two-phase lint. Phase 1 parses EVERY module in the run and builds
+    the project-wide call graph / symbol index (callgraph.ProjectIndex) —
+    the interprocedural rules (TPU011+) see all of it through
+    ``module.project``. Phase 2 runs the rules per module as before.
+    Also returns the parsed modules so ``--fix`` can edit them."""
     root = root or os.getcwd()
     rules = [r for code, r in sorted(RULES.items())
              if (select is None or code in select)
              and (ignore is None or code not in ignore)]
     findings: List[Finding] = []
+    modules: List[ModuleInfo] = []
     for fpath in iter_python_files(paths):
         try:
             with open(fpath, "r", encoding="utf-8") as f:
                 source = f.read()
             rel = os.path.relpath(os.path.abspath(fpath), root)
-            module = ModuleInfo(fpath, source, rel)
+            modules.append(ModuleInfo(fpath, source, rel))
         except (SyntaxError, UnicodeDecodeError) as e:
             findings.append(Finding(
                 rule="GL000", severity=Severity.ERROR,
                 path=fpath.replace(os.sep, "/"),
                 line=getattr(e, "lineno", 1) or 1, col=0,
                 message=f"could not parse: {e.__class__.__name__}: {e}"))
-            continue
+    from .callgraph import ProjectIndex
+    index = ProjectIndex(modules)
+    for module in modules:
+        module.project = index
         for rule in rules:
             for finding in rule.check(module):
                 finding.suppressed = module.is_suppressed(finding)
                 findings.append(finding)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings
+    return findings, modules

@@ -1,0 +1,221 @@
+"""graftlint autofixes (``--fix``) for the mechanical rules.
+
+Only rules whose fix is a pure syntactic rewrite with exactly one right
+answer are fixable — the analyzer must never guess at semantics:
+
+TPU008  PartitionSpec canonicalization: drop trailing ``None`` entries,
+        unwrap single-name tuples, rewrite empty-tuple entries to
+        ``None`` — producing the compiler's canonical form, which is the
+        whole point of the rule.
+TPU010  wrap the statement launching ``pl.pallas_call`` in
+        ``with jax.named_scope("<enclosing-fn>"):`` (adding ``import
+        jax`` when the module lacks it).
+
+Fixes are applied as source-span edits computed from the parsed AST.
+Within one round, overlapping edits are dropped (outermost wins) and the
+CLI re-lints + re-fixes until a round applies nothing — which also makes
+``--fix`` idempotent by construction: a fixed file produces no findings,
+so a second run edits nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding, ModuleInfo
+
+#: rules --fix knows how to rewrite
+FIXABLE = ("TPU008", "TPU010")
+
+
+class Edit:
+    """Replace source[start:end) (character offsets) with ``text``."""
+
+    __slots__ = ("start", "end", "text")
+
+    def __init__(self, start: int, end: int, text: str):
+        self.start = start
+        self.end = end
+        self.text = text
+
+
+def _offsets(source: str) -> List[int]:
+    """Char offset of the start of each 1-indexed line."""
+    offs = [0]
+    for line in source.splitlines(keepends=True):
+        offs.append(offs[-1] + len(line))
+    return offs
+
+
+def _span(source: str, offs: List[int], node: ast.AST) -> Tuple[int, int]:
+    start = offs[node.lineno - 1] + node.col_offset
+    end = offs[node.end_lineno - 1] + node.end_col_offset
+    return start, end
+
+
+def _seg(source: str, node: ast.AST) -> str:
+    return ast.get_source_segment(source, node) or ast.unparse(node)
+
+
+# ------------------------------------------------------------------ TPU008
+
+def _fix_spec(module: ModuleInfo, call: ast.Call,
+              offs: List[int]) -> Optional[Edit]:
+    """Canonicalize a P(...) literal in place."""
+    if call.keywords:
+        return None                 # unusual spelling: leave it alone
+    src = module.source
+    args: List[str] = []
+    for a in call.args:
+        if isinstance(a, ast.Tuple) and len(a.elts) == 1:
+            args.append(_seg(src, a.elts[0]))
+        elif isinstance(a, ast.Tuple) and not a.elts:
+            args.append("None")
+        else:
+            args.append(_seg(src, a))
+    while args and args[-1] == "None":
+        args.pop()
+    new = f"{_seg(src, call.func)}({', '.join(args)})"
+    start, end = _span(src, offs, call)
+    if src[start:end] == new:
+        return None
+    return Edit(start, end, new)
+
+
+# ------------------------------------------------------------------ TPU010
+
+def _enclosing_stmt(module: ModuleInfo, node: ast.AST) -> Optional[ast.stmt]:
+    cur: Optional[ast.AST] = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = module.parent(cur)
+    return cur
+
+
+def _fix_named_scope(module: ModuleInfo, call: ast.Call,
+                     offs: List[int]) -> Optional[Edit]:
+    """Indent the launching statement under a named_scope ``with``."""
+    stmt = _enclosing_stmt(module, call)
+    if stmt is None:
+        return None
+    src = module.source
+    first = module.lines[stmt.lineno - 1]
+    indent = first[:len(first) - len(first.lstrip())]
+    fn = module.enclosing_function(call)
+    name = getattr(fn, "name", None) or "pallas_kernel"
+    body = [f"{indent}with jax.named_scope(\"{name}\"):"]
+    for ln in range(stmt.lineno, stmt.end_lineno + 1):
+        body.append("    " + module.lines[ln - 1])
+    start = offs[stmt.lineno - 1]
+    end = offs[stmt.end_lineno - 1] + len(module.lines[stmt.end_lineno - 1])
+    return Edit(start, end, "\n".join(body))
+
+
+def _needs_jax_import(module: ModuleInfo) -> bool:
+    return module.scope.imports.aliases.get("jax") != "jax"
+
+
+def _import_jax_edit(module: ModuleInfo, offs: List[int]) -> Edit:
+    """Insert ``import jax`` after the last top-level import (or at the
+    top, past a module docstring)."""
+    line = 0
+    for node in module.tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            line = max(line, node.end_lineno)
+        elif line == 0 and isinstance(node, ast.Expr) and isinstance(
+                node.value, ast.Constant) and isinstance(
+                node.value.value, str):
+            line = node.end_lineno      # docstring
+    pos = offs[line] if line < len(offs) else len(module.source)
+    return Edit(pos, pos, "import jax\n")
+
+
+# ------------------------------------------------------------------ driver
+
+def compute_edits(module: ModuleInfo,
+                  findings: List[Finding]) -> List[Edit]:
+    """One round of non-overlapping edits for this module's fixable
+    findings. Overlaps (a P-literal inside a statement being wrapped)
+    resolve outermost-first; the CLI's fix loop picks up the rest on the
+    next round."""
+    offs = _offsets(module.source)
+    edits: List[Edit] = []
+    wrapped_stmts = set()
+    want_jax_import = False
+    for f in findings:
+        if f.node is None:
+            continue
+        if f.rule == "TPU008":
+            e = _fix_spec(module, f.node, offs)
+            if e:
+                edits.append(e)
+        elif f.rule == "TPU010":
+            stmt = _enclosing_stmt(module, f.node)
+            if stmt is None or id(stmt) in wrapped_stmts:
+                continue
+            e = _fix_named_scope(module, f.node, offs)
+            if e:
+                wrapped_stmts.add(id(stmt))
+                edits.append(e)
+                want_jax_import = _needs_jax_import(module) or want_jax_import
+    if want_jax_import:
+        edits.append(_import_jax_edit(module, offs))
+    # outermost-first on overlap: sort by (start, -end) and drop any edit
+    # that overlaps one already kept
+    edits.sort(key=lambda e: (e.start, -e.end))
+    kept: List[Edit] = []
+    for e in edits:
+        if any(e.start < k.end and k.start < e.end for k in kept):
+            continue
+        kept.append(e)
+    return kept
+
+
+def apply_edits(source: str, edits: List[Edit]) -> str:
+    for e in sorted(edits, key=lambda e: e.start, reverse=True):
+        source = source[:e.start] + e.text + source[e.end:]
+    return source
+
+
+def fix_paths(paths, select=None, ignore=None, root=None,
+              baseline_path: Optional[str] = None,
+              max_rounds: int = 5) -> Tuple[int, List[str]]:
+    """Lint/fix/re-lint until a round applies nothing. Returns (#edits
+    applied, sorted changed file paths). Suppressed and baselined
+    findings are the author's recorded judgment and are left untouched."""
+    from .baseline import Baseline
+    from .core import lint_modules
+    total = 0
+    changed: Dict[str, bool] = {}
+    for _ in range(max_rounds):
+        findings, modules = lint_modules(paths, select=select,
+                                         ignore=ignore, root=root)
+        if baseline_path:
+            Baseline.load(baseline_path).apply(findings)
+        by_path: Dict[str, List[Finding]] = {}
+        for f in findings:
+            if f.rule in FIXABLE and not f.suppressed and not f.baselined:
+                by_path.setdefault(f.path, []).append(f)
+        if not by_path:
+            break
+        applied_this_round = 0
+        for module in modules:
+            todo = by_path.get(module.rel_path)
+            if not todo:
+                continue
+            edits = compute_edits(module, todo)
+            if not edits:
+                continue
+            new_source = apply_edits(module.source, edits)
+            try:
+                ast.parse(new_source)
+            except SyntaxError:     # never write a file we broke
+                continue
+            with open(module.path, "w", encoding="utf-8") as fh:
+                fh.write(new_source)
+            changed[module.path] = True
+            applied_this_round += len(edits)
+        total += applied_this_round
+        if not applied_this_round:
+            break
+    return total, sorted(changed)

@@ -67,6 +67,82 @@ def report_json(findings: List[Finding], stale: List[dict],
     out.write("\n")
 
 
+_SARIF_LEVELS = {Severity.ERROR: "error", Severity.WARNING: "warning",
+                 Severity.INFO: "note"}
+
+
+def sarif_document(findings: List[Finding], stale: List[dict]) -> dict:
+    """SARIF 2.1.0 run for CI PR annotation. Suppressed/baselined
+    findings are included WITH a ``suppressions`` entry (SARIF viewers
+    hide them but keep the audit trail); a run is "finding-free" when no
+    result lacks one."""
+    rules_meta = [{
+        "id": code,
+        "name": rule.name,
+        "shortDescription": {"text": rule.summary},
+        "defaultConfiguration": {"level": _SARIF_LEVELS[rule.severity]},
+    } for code, rule in sorted(RULES.items())]
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.rule,
+            "level": _SARIF_LEVELS.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                },
+                "logicalLocations": [{"fullyQualifiedName": f.symbol}],
+            }],
+            "partialFingerprints": {"graftlint/v1": f.fingerprint()},
+        }
+        if f.suppressed or f.baselined:
+            res["suppressions"] = [{
+                "kind": "inSource" if f.suppressed else "external",
+                "justification": f.justification or
+                ("inline graftlint: disable" if f.suppressed else ""),
+            }]
+        results.append(res)
+    invocation = {"executionSuccessful": True}
+    if stale:
+        invocation["toolExecutionNotifications"] = [{
+            "level": "note",
+            "message": {"text": f"stale baseline entry: {e['rule']} "
+                                f"{e['path']} ({e['symbol']})"},
+        } for e in stale]
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                   "master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "https://github.com/deepspeed_tpu/docs/LINT.md",
+                "rules": rules_meta,
+            }},
+            "invocations": [invocation],
+            "results": results,
+        }],
+    }
+
+
+def report_sarif(findings: List[Finding], stale: List[dict],
+                 stream=None) -> None:
+    out = stream or sys.stdout
+    json.dump(sarif_document(findings, stale), out, indent=2)
+    out.write("\n")
+
+
+def write_sarif(path: str, findings: List[Finding],
+                stale: List[dict]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        report_sarif(findings, stale, stream=f)
+
+
 def report_rules(stream=None) -> None:
     out = stream or sys.stdout
     for code, rule in sorted(RULES.items()):

@@ -1,0 +1,395 @@
+"""graftlint rules TPU011–TPU013: interprocedural collective safety.
+
+All three ride the project-wide call graph (callgraph.py) and the
+collective catalog (collectives.py). The bug class: a collective is a
+RENDEZVOUS — every participating rank must execute the same sequence of
+them — so any code shape that lets SOME ranks skip, reorder, or repeat
+one wedges the others in the matched collective until the 117 stall
+watchdog fires. PRs 3–4 fixed three live instances of this at runtime
+(rank-conditional save paths skipping an allgather, barriers ordered
+after rank-0-only publishes, rank-local raises before a barrier); these
+rules make the whole class visible before anything runs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from . import collectives as C
+from .core import Finding, ModuleInfo, Rule, Severity, register
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_EXITS = (ast.Return, ast.Raise, ast.Continue, ast.Break)
+
+
+def _project(module: ModuleInfo):
+    return getattr(module, "project", None)
+
+
+def _unparse(node: ast.AST, limit: int = 60) -> str:
+    s = ast.unparse(node)
+    return s if len(s) <= limit else s[:limit - 3] + "..."
+
+
+@register
+class DivergentCollectiveRule(Rule):
+    """TPU011 — collective reachable on some ranks only.
+
+    Two shapes, both resolved through the call graph:
+
+    (a) a collective call (or a call to a function whose UNGUARDED body
+        transitively reaches one) inside a ``rank == N`` /
+        ``process_index()`` branch — only the matching ranks dispatch it;
+        everyone else blocks in the matched collective forever.
+    (b) a rank-guarded early exit (``if rank != 0: return``) ahead of a
+        collective later in the same function — the exiting ranks never
+        arrive at the rendezvous.
+
+    World-size probes (``process_count``/``get_world_size``) evaluate the
+    same on every rank and are NOT guards — ``comm.barrier``'s own
+    ``if jax.process_count() > 1`` gate is the sanctioned idiom.
+    """
+
+    code = "TPU011"
+    name = "divergent-collective"
+    severity = Severity.ERROR
+    summary = "collective reachable only under a rank guard"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        index = _project(module)
+        if index is None:
+            return
+        # (a) guarded collective / guarded call reaching one
+        for call in module.all_calls:
+            guard = index.rank_guard(module, call)
+            if guard is None:
+                continue
+            q = index.collective_name(module, call)
+            if q:
+                yield self.finding(
+                    module, call,
+                    f"collective {C.short_name(q)} executes only under "
+                    f"rank guard '{_unparse(guard.test)}' (line "
+                    f"{guard.lineno}): the other ranks block forever in "
+                    "the matched collective. Hoist it out of the guard, "
+                    "or guard every rank's matching call identically")
+                continue
+            target = index.resolve_call(module, call)
+            if target is None:
+                continue
+            reach = index.reachable_collectives(target)
+            if reach:
+                q, (rpath, rline, rqual) = next(iter(sorted(reach.items())))
+                yield self.finding(
+                    module, call,
+                    f"call to {target.qualname}() under rank guard "
+                    f"'{_unparse(guard.test)}' reaches collective "
+                    f"{C.short_name(q)} ({rpath}:{rline} in {rqual}): "
+                    "only the guarded ranks dispatch it — the rest hang. "
+                    "Move the collective out of the rank-conditional path")
+        # (b) rank-guarded early exit ahead of a collective
+        yield from self._guarded_exits(module, index)
+
+    def _guarded_exits(self, module: ModuleInfo, index) -> Iterator[Finding]:
+        for fn in module.scope._defs:
+            exits: List[ast.If] = []
+            for node in module.nodes_by_fn.get(fn, ()):
+                if isinstance(node, ast.If) and index.is_rank_test(
+                        module, node.test, fn):
+                    for arm in (node.body, node.orelse):
+                        if arm and any(isinstance(s, (ast.Return, ast.Raise))
+                                       for s in arm):
+                            exits.append(node)
+                            break
+            if not exits:
+                continue
+            events = _collective_events(module, index, fn)
+            for guard in exits:
+                after = [e for e in events if e[0].lineno > guard.lineno
+                         and index.rank_guard(module, e[0]) is None]
+                for call, q, via in after[:1]:    # one finding per guard
+                    via_txt = f" (via {via})" if via else ""
+                    yield self.finding(
+                        module, call,
+                        f"collective {C.short_name(q)}{via_txt} is "
+                        f"unreachable for ranks taking the early exit "
+                        f"under rank guard '{_unparse(guard.test)}' (line "
+                        f"{guard.lineno}): the exiting ranks never reach "
+                        "the rendezvous. Exit after the collective, or "
+                        "make every rank take the same path")
+
+
+def _collective_events(module: ModuleInfo, index, fn
+                       ) -> List[Tuple[ast.Call, str, Optional[str]]]:
+    """Source-ordered collective events in ``fn``: direct collective
+    calls, plus calls into project functions whose unguarded bodies reach
+    one. The third element names the callee for transitive events."""
+    events: List[Tuple[ast.Call, str, Optional[str]]] = []
+    seen: Set[ast.Call] = set()
+    for call, q, _g in index.direct_collectives(module, fn):
+        events.append((call, q, None))
+        seen.add(call)
+    for call, target, _g in index.call_edges(module, fn):
+        if call in seen:
+            continue
+        reach = index.reachable_collectives(target)
+        if reach:
+            q = next(iter(sorted(reach)))
+            events.append((call, q, f"{target.qualname}()"))
+    events.sort(key=lambda e: (e[0].lineno, e[0].col_offset))
+    return events
+
+
+@register
+class MeshAxisValidityRule(Rule):
+    """TPU012 — axis_name not declared by any enclosing mesh context.
+
+    A literal ``axis_name`` handed to a collective must be declared by an
+    enclosing ``shard_map``/``pmap``. Resolution is interprocedural: a
+    helper that does ``lax.psum(x, "model")`` is checked against the axis
+    sets of every shard_map context that reaches it through the call
+    graph (or lexically). When no context is statically known, the name
+    is checked against the PROJECT axis universe (every axis declared in
+    any shard_map/pmap/Mesh/``*_AXES`` constant) — which catches the typo
+    class outright. Contexts whose axes aren't statically visible
+    (``axis_names={self.axis}``, mesh-derived axes) disable the check
+    rather than guess, and the universe fallback is skipped entirely
+    when the run declares NO axes (a subset lint of helper files has no
+    basis to call anything a typo — full-package runs always have the
+    mesh declarations in scope).
+    """
+
+    code = "TPU012"
+    name = "mesh-axis-validity"
+    severity = Severity.ERROR
+    summary = "collective axis_name not declared by any enclosing mesh"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        index = _project(module)
+        if index is None:
+            return
+        for call in module.all_calls:
+            q = index.qualify(module, call.func)
+            if not (C.collective_kind(q) or q in C.LAX_AXIS_USERS):
+                continue
+            names = C.literal_axes(C.axis_arg(call, q))
+            if not names:
+                continue
+            fn = module.enclosing_function(call)
+            ctxs = index.axis_contexts(fn) if fn is not None else []
+            if any(c == C.UNKNOWN for c in ctxs):
+                continue            # axes not statically visible: no guess
+            if ctxs:
+                declared = frozenset().union(*ctxs)
+                missing = names - declared
+                if missing:
+                    yield self.finding(
+                        module, call,
+                        f"axis {sorted(missing)} passed to "
+                        f"{C.short_name(q)} is not declared by any "
+                        f"shard_map/pmap context reaching this function "
+                        f"(declared: {sorted(declared)}): this fails at "
+                        "trace time — or resolves against an unintended "
+                        "outer mesh")
+            elif index.axis_universe:
+                # the universe is only judging evidence when this run
+                # DECLARES axes: a subset lint (`lint.sh --changed`, a
+                # single helper file) that contains no declarations at
+                # all has no basis to call anything a typo
+                missing = names - index.axis_universe
+                if missing:
+                    yield self.finding(
+                        module, call,
+                        f"axis {sorted(missing)} passed to "
+                        f"{C.short_name(q)} is not declared anywhere in "
+                        f"this lint run (known axes: "
+                        f"{sorted(index.axis_universe)}): likely a typo "
+                        "for one of the mesh axis names")
+
+
+@register
+class CollectiveOrderRule(Rule):
+    """TPU013 — control flow that can reorder or skip paired collectives.
+
+    Ranks must execute the same collective SEQUENCE. Flags (1) a
+    conditional ``return``/``raise`` between two collective events in one
+    function (a rank-local failure path that exits after collective A but
+    before its paired B leaves the other ranks waiting in B — the exact
+    pre-PR-3 sharded-save shape, where a rank's write failure raised
+    before the allgather), (2) a conditional ``continue``/``break`` ahead
+    of a collective in the same loop, and (3) a collective inside a
+    ``while`` loop with a data-dependent bound (iteration counts can
+    differ per rank, so the collective COUNT diverges). Rank-guarded
+    exits are TPU011's domain and are not re-flagged here. The sanctioned
+    fix is the ok-flag idiom: catch the local failure, fold it into a
+    value every rank contributes to the collective, and act on the
+    aggregate afterwards.
+    """
+
+    code = "TPU013"
+    name = "collective-order-divergence"
+    severity = Severity.WARNING
+    summary = "conditional exit/loop can desequence paired collectives"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        index = _project(module)
+        if index is None:
+            return
+        for fn in module.scope._defs:
+            events = [e for e in _collective_events(module, index, fn)
+                      if index.rank_guard(module, e[0]) is None]
+            if not events:
+                continue
+            yield from self._conditional_exits(module, index, fn, events)
+            yield from self._while_loops(module, index, fn, events)
+
+    # -- (1)+(2) conditional exits ---------------------------------------
+
+    def _conditional_exits(self, module, index, fn, events
+                           ) -> Iterator[Finding]:
+        for node in module.nodes_by_fn.get(fn, ()):
+            if not isinstance(node, _EXITS):
+                continue
+            if not self._conditional(module, fn, node):
+                continue
+            if self._rank_guarded_path(module, index, fn, node):
+                continue            # TPU011's shape (b), already reported
+            if isinstance(node, (ast.Return, ast.Raise)):
+                if isinstance(node, ast.Return) and node.value is not None \
+                        and self._returns_collective(module, index, node):
+                    # dispatch idiom (comm.all_reduce): `if op == SUM:
+                    # return lax.psum(...)` — the exit doesn't SKIP a
+                    # collective, it selects which one to execute
+                    continue
+                before = [e for e in events if e[0].lineno < node.lineno]
+                after = [e for e in events if e[0].lineno > node.lineno]
+                if before and after:
+                    a, b = before[-1], after[0]
+                    kind = type(node).__name__.lower()
+                    a_via = f" via {a[2]}" if a[2] else ""
+                    b_via = f" via {b[2]}" if b[2] else ""
+                    yield self.finding(
+                        module, node,
+                        f"conditional {kind} between paired collectives "
+                        f"{C.short_name(a[1])}{a_via} (line {a[0].lineno}) "
+                        f"and {C.short_name(b[1])}{b_via} "
+                        f"(line {b[0].lineno}): a "
+                        "rank taking this exit skips the second "
+                        "collective while its peers wait in it. Fold the "
+                        "failure into a value all ranks contribute (ok-"
+                        "flag idiom) and act on the aggregate")
+            else:   # continue / break
+                loop = self._enclosing_loop(module, fn, node)
+                if loop is None:
+                    continue
+                in_loop = [e for e in events
+                           if self._inside(module, fn, e[0], loop)
+                           and e[0].lineno > node.lineno]
+                if in_loop:
+                    b = in_loop[0]
+                    kind = type(node).__name__.lower()
+                    yield self.finding(
+                        module, node,
+                        f"conditional {kind} ahead of collective "
+                        f"{C.short_name(b[1])} (line {b[0].lineno}) in "
+                        "the same loop: ranks taking it skip that "
+                        "iteration's collective and fall out of step "
+                        "with their peers")
+
+    @staticmethod
+    def _returns_collective(module, index, node: ast.Return) -> bool:
+        for n in ast.walk(node.value):
+            if isinstance(n, ast.Call):
+                if index.collective_name(module, n):
+                    return True
+                target = index.resolve_call(module, n)
+                if target is not None and \
+                        index.reachable_collectives(target):
+                    return True
+        return False
+
+    # -- (3) data-dependent while bounds ---------------------------------
+
+    def _while_loops(self, module, index, fn, events) -> Iterator[Finding]:
+        call_locals = self._call_assigned_locals(module, fn)
+        for node in module.nodes_by_fn.get(fn, ()):
+            if not isinstance(node, ast.While):
+                continue
+            if not self._data_dependent(node.test, call_locals):
+                continue
+            inside = [e for e in events
+                      if self._inside(module, fn, e[0], node)]
+            for call, q, via in inside[:1]:
+                via_txt = f" (via {via})" if via else ""
+                yield self.finding(
+                    module, call,
+                    f"collective {C.short_name(q)}{via_txt} inside a "
+                    f"while loop with data-dependent bound "
+                    f"'{_unparse(node.test)}': ranks whose loop runs a "
+                    "different number of iterations execute a different "
+                    "collective count and deadlock. Make the trip count "
+                    "rank-uniform (reduce the predicate first)")
+
+    # -- helpers ----------------------------------------------------------
+
+    @staticmethod
+    def _conditional(module, fn, node) -> bool:
+        cur = module.parent(node)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.If, ast.ExceptHandler, ast.IfExp)):
+                return True
+            if isinstance(cur, _FN):
+                return False
+            cur = module.parent(cur)
+        return False
+
+    @staticmethod
+    def _rank_guarded_path(module, index, fn, node) -> bool:
+        return index.rank_guard(module, node) is not None
+
+    @staticmethod
+    def _enclosing_loop(module, fn, node):
+        cur = module.parent(node)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+                return cur
+            if isinstance(cur, _FN):
+                return None
+            cur = module.parent(cur)
+        return None
+
+    @staticmethod
+    def _inside(module, fn, node, container) -> bool:
+        cur = node
+        while cur is not None and cur is not fn:
+            if cur is container:
+                return True
+            cur = module.parent(cur)
+        return False
+
+    @staticmethod
+    def _call_assigned_locals(module, fn) -> Set[str]:
+        names: Set[str] = set()
+        for node in module.nodes_by_fn.get(fn, ()):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                for t in node.targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            names.add(leaf.id)
+        return names
+
+    @classmethod
+    def _data_dependent(cls, test: ast.AST, call_locals: Set[str]) -> bool:
+        """A while bound whose value can differ per rank: the test calls
+        something, or references a local produced by a call. ``while
+        True`` and pure-parameter bounds are rank-uniform enough."""
+        if isinstance(test, ast.Constant):
+            return False
+        for n in ast.walk(test):
+            if isinstance(n, ast.Call):
+                return True
+            if isinstance(n, ast.Name) and n.id in call_locals:
+                return True
+        return False

@@ -8,6 +8,13 @@ axes; process bootstrap is ``jax.distributed.initialize``. The facade keeps the
 reference's op-level accounting surface (CommsLogger / log_summary), recording
 traffic at trace time (per-op wall timing inside a compiled program is not
 meaningful under XLA — the whole point is fusion/overlap).
+
+Every collective defined here is cataloged by graftlint's collective model
+(analysis/collectives.py FACADE_COLLECTIVES), which drives the
+interprocedural safety rules TPU011–TPU013 (rank-divergent reachability,
+axis validity, ordering) — add any new collective wrapper to that catalog
+so callers get the same static guarantees through the facade as through
+``jax.lax`` directly.
 """
 
 from __future__ import annotations

@@ -212,6 +212,12 @@ def parse_args(argv=None):
     p.add_argument("--connect-timeout", "--connect_timeout", type=int,
                    default=15, dest="connect_timeout",
                    help="ssh -o ConnectTimeout per dispatch attempt")
+    p.add_argument("--log-dir", "--log_dir", default="", dest="log_dir",
+                   help="persist each rank's prefixed stdout/stderr to "
+                        "<log_dir>/<host>.rank<k>.log alongside the live "
+                        "prefixed stream (local ranks switch to captured "
+                        "pipes); truncated per run, appended across "
+                        "connect retries")
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -303,7 +309,8 @@ def build_world_supervisor(active: "OrderedDict[str, List[int]]", args,
                 remote=True))
     return RunSupervisor(specs,
                          grace_secs=args.grace_secs,
-                         connect_retries=args.connect_retries)
+                         connect_retries=args.connect_retries,
+                         log_dir=getattr(args, "log_dir", "") or None)
 
 
 def elastic_active_world(args, members: List[str]

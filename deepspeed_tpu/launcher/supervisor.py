@@ -19,6 +19,10 @@ This module supervises all ranks CONCURRENTLY:
   A rank whose remote shell already started (it printed the
   :data:`STARTED_SENTINEL` line) is NEVER retried — re-dispatching a rank
   that may have run user code would double-run the job.
+- **per-host log persistence** (``log_dir``): every rank's prefixed
+  output is mirrored to ``<log_dir>/<host>.rank<k>.log`` alongside the
+  live prefixed stream (local ranks switch to captured pipes), so the
+  post-mortem for a torn-down pod doesn't depend on terminal scrollback.
 - **preemption-aware aggregation**: the overall rc is computed from the
   ranks that exited VOLUNTARILY (before teardown signaled them): a
   genuine crash rc wins, else a preemption (``PREEMPTION_EXIT_CODE``,
@@ -106,7 +110,8 @@ class RunSupervisor:
                  connect_backoff: float = 0.5,
                  connect_backoff_max: float = 10.0,
                  popen_fn: Optional[Callable[..., subprocess.Popen]] = None,
-                 stream=None):
+                 stream=None,
+                 log_dir: Optional[str] = None):
         self.specs = list(specs)
         self.grace_secs = float(grace_secs)
         self.connect_retries = int(connect_retries)
@@ -114,6 +119,14 @@ class RunSupervisor:
         self.connect_backoff_max = float(connect_backoff_max)
         self._popen = popen_fn or subprocess.Popen
         self._stream = stream if stream is not None else sys.stdout
+        # per-host log persistence: with log_dir set, every rank's output
+        # (local ranks included — they switch to captured pipes) is also
+        # written to <log_dir>/<host>.rank<k>.log, truncated on the first
+        # dispatch attempt and appended across connect retries, so a
+        # post-mortem doesn't depend on scrollback
+        self.log_dir = log_dir
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
         self.status = [_RankStatus() for _ in self.specs]
         self._procs: List[Optional[subprocess.Popen]] = [None] * len(self.specs)
         self._lock = threading.Lock()
@@ -174,31 +187,87 @@ class RunSupervisor:
 
     # ---------------------------------------------------------- rank monitor
 
-    def _forward_output(self, idx: int, proc: subprocess.Popen) -> None:
-        """Reader for a remote rank's merged stdout/stderr: recognizes the
-        started sentinel and prefixes every other line with the host."""
+    def rank_log_path(self, idx: int) -> Optional[str]:
+        if not self.log_dir:
+            return None
+        return os.path.join(self.log_dir,
+                            f"{self.specs[idx].host}.rank{idx}.log")
+
+    def _open_rank_log(self, idx: int):
+        path = self.rank_log_path(idx)
+        if path is None:
+            return None
+        mode = "w" if self.status[idx].attempts <= 1 else "a"
+        try:
+            return open(path, mode, encoding="utf-8", errors="replace")
+        except OSError as e:
+            logger.warning("supervisor: cannot open rank log %s: %s",
+                           path, e)
+            return None
+
+    def _forward_output(self, idx: int, proc: subprocess.Popen,
+                        log=None) -> None:
+        """Reader for a rank's merged stdout/stderr: recognizes the
+        started sentinel, prefixes every other line with the host, and
+        mirrors the prefixed lines into the rank's log file when
+        persistence is on."""
         st = self.status[idx]
         host = self.specs[idx].host
-        for line in proc.stdout:
-            if STARTED_SENTINEL in line:
-                st.started = True
-                continue
-            try:
-                self._stream.write(f"[{host}] {line}")
-                self._stream.flush()
-            except (ValueError, OSError):
-                pass        # parent stream closed mid-teardown
+        try:
+            for line in proc.stdout:
+                if STARTED_SENTINEL in line:
+                    st.started = True
+                    continue
+                prefixed = f"[{host}] {line}"
+                if log is not None:
+                    try:
+                        log.write(prefixed)
+                        log.flush()
+                    except (ValueError, OSError):
+                        try:
+                            log.close()   # ENOSPC etc: stop logging, but
+                        except OSError:   # release the descriptor now
+                            pass
+                        log = None
+                try:
+                    self._stream.write(prefixed)
+                    self._stream.flush()
+                except (ValueError, OSError):
+                    pass    # parent stream closed mid-teardown
+        finally:
+            if log is not None:
+                try:
+                    log.close()
+                except OSError:
+                    pass
 
     def _launch_once(self, idx: int) -> subprocess.Popen:
         spec = self.specs[idx]
-        if spec.remote:
-            # the ssh dispatch failpoint: tests simulate connection
-            # failures deterministically (raise mode == ConnectTimeout)
-            chaos.failpoint("launch.ssh")
-            proc = self._popen(spec.cmd, stdout=subprocess.PIPE,
-                               stderr=subprocess.STDOUT, text=True)
+        log = self._open_rank_log(idx)
+        if spec.remote or log is not None:
+            try:
+                if spec.remote:
+                    # the ssh dispatch failpoint: tests simulate connection
+                    # failures deterministically (raise mode == ConnectTimeout)
+                    chaos.failpoint("launch.ssh")
+                env = {**os.environ, **spec.env} \
+                    if (not spec.remote and spec.env) else None
+                proc = self._popen(spec.cmd, stdout=subprocess.PIPE,
+                                   stderr=subprocess.STDOUT, text=True,
+                                   env=env)
+            except BaseException:
+                # connect retries re-open the log per attempt: releasing
+                # it here keeps a failing rank from accumulating handles
+                if log is not None:
+                    try:
+                        log.close()
+                    except OSError:
+                        pass
+                raise
+            if not spec.remote:
+                self.status[idx].started = True
             reader = threading.Thread(target=self._forward_output,
-                                      args=(idx, proc),
+                                      args=(idx, proc, log),
                                       name=f"dstpu-out-{idx}", daemon=True)
             reader.start()
             proc._dstpu_reader = reader

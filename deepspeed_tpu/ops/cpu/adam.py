@@ -71,6 +71,7 @@ class DeepSpeedCPUAdam:
             self._lib.ds_cpu_adam_step(
                 step, lr, self.betas[0], self.betas[1], self.eps,
                 self.weight_decay, int(self.adamw_mode),
+                # graftlint: disable=TPU001 (host C++ kernel: grad_scale is a python float; all buffers are host numpy)
                 int(self.bias_correction), float(grad_scale),
                 p.ctypes.data_as(ctypes.c_void_p),
                 g.ctypes.data_as(ctypes.c_void_p),
@@ -122,6 +123,7 @@ class DeepSpeedCPUAdagrad:
         if self._lib is not None:
             import ctypes
             self._lib.ds_cpu_adagrad_step(
+                # graftlint: disable=TPU001 (host C++ kernel: grad_scale is a python float; all buffers are host numpy)
                 lr, self.eps, self.weight_decay, float(grad_scale),
                 p.ctypes.data_as(ctypes.c_void_p),
                 g.ctypes.data_as(ctypes.c_void_p),

@@ -1,9 +1,12 @@
 #!/usr/bin/env bash
 # graftlint CI entrypoint: machine-readable lint over the package.
 #
-#   scripts/lint.sh                 # JSON report on stdout, exit 1 on gating findings
-#   scripts/lint.sh --format text   # human-readable
-#   scripts/lint.sh path/to/file.py # lint a subset
+#   scripts/lint.sh                   # JSON report on stdout, exit 1 on gating findings
+#   scripts/lint.sh --format text     # human-readable
+#   scripts/lint.sh path/to/file.py   # lint a subset
+#   scripts/lint.sh --changed         # fast mode: only .py files changed vs main
+#   scripts/lint.sh --sarif out.sarif # additionally write SARIF 2.1.0 (CI PR annotation)
+#   scripts/lint.sh --fix             # apply autofixes (TPU008/TPU010), then lint
 #
 # The checked-in baseline (.graftlint.json) is applied automatically; a
 # finding not in the baseline and not suppressed inline fails the run.
@@ -12,12 +15,32 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 FORMAT="json"
+CHANGED=0
+EXTRA=()
 ARGS=()
 while [[ $# -gt 0 ]]; do
   case "$1" in
     --format) FORMAT="$2"; shift 2 ;;
+    --changed) CHANGED=1; shift ;;
+    --sarif) EXTRA+=("--sarif" "$2"); shift 2 ;;
+    --fix) EXTRA+=("--fix"); shift ;;
     *) ARGS+=("$1"); shift ;;
   esac
 done
 
-exec python -m deepspeed_tpu.analysis "${ARGS[@]:-deepspeed_tpu}" --format "$FORMAT"
+if [[ "$CHANGED" == "1" ]]; then
+  # fast mode: lint only package .py files that differ from main (committed
+  # or working-tree). Falls back to the full package when main is unknown.
+  BASE="$(git merge-base HEAD main 2>/dev/null || echo "")"
+  if [[ -n "$BASE" ]]; then
+    mapfile -t FILES < <( { git diff --name-only --diff-filter=d "$BASE" -- 'deepspeed_tpu/*.py' 'deepspeed_tpu/**/*.py'; \
+                            git diff --name-only --diff-filter=d -- 'deepspeed_tpu/*.py' 'deepspeed_tpu/**/*.py'; } | sort -u )
+    if [[ ${#FILES[@]} -eq 0 ]]; then
+      echo "graftlint: no package files changed vs main" >&2
+      exit 0
+    fi
+    ARGS+=("${FILES[@]}")
+  fi
+fi
+
+exec python -m deepspeed_tpu.analysis "${ARGS[@]:-deepspeed_tpu}" --format "$FORMAT" ${EXTRA[@]+"${EXTRA[@]}"}

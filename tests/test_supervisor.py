@@ -160,6 +160,70 @@ def test_local_rank_receives_spec_env():
     assert sup.run() == 0
 
 
+# ----------------------------------------------- per-host log persistence
+
+def test_log_dir_persists_each_ranks_output(tmp_path):
+    """--log-dir writes <host>.rank<k>.log per rank — local ranks switch
+    to captured pipes so their output lands in the file AND the live
+    prefixed stream."""
+    buf = io.StringIO()
+    log_dir = str(tmp_path / "logs")
+    sup = RunSupervisor([
+        _spec("print('alpha out'); import sys; print('alpha err', "
+              "file=sys.stderr)", "h0"),
+        _spec("print('beta out')", "h1"),
+    ], stream=buf, log_dir=log_dir)
+    assert sup.run() == 0
+    log0 = (tmp_path / "logs" / "h0.rank0.log").read_text()
+    log1 = (tmp_path / "logs" / "h1.rank1.log").read_text()
+    assert "[h0] alpha out" in log0
+    assert "[h0] alpha err" in log0          # stderr merged
+    assert "[h1] beta out" in log1
+    assert "beta" not in log0                # no cross-rank bleed
+    # live prefixing still happens alongside the files
+    assert "[h0] alpha out" in buf.getvalue()
+    assert "[h1] beta out" in buf.getvalue()
+
+
+def test_log_dir_remote_rank_swallows_sentinel_but_logs_payload(tmp_path):
+    buf = io.StringIO()
+    log_dir = str(tmp_path / "logs")
+    sup = RunSupervisor(
+        [_spec(f"print('{STARTED_SENTINEL}'); print('payload ran')",
+               "w7", remote=True)],
+        stream=buf, log_dir=log_dir)
+    assert sup.run() == 0
+    assert sup.status[0].started
+    log = (tmp_path / "logs" / "w7.rank0.log").read_text()
+    assert "[w7] payload ran" in log
+    assert STARTED_SENTINEL not in log       # sentinel is supervisor meta
+    assert STARTED_SENTINEL not in buf.getvalue()
+
+
+def test_log_dir_appends_across_connect_retries(tmp_path):
+    """A retried dispatch must not truncate what the failed attempt
+    logged (mode 'w' first attempt, 'a' afterwards)."""
+    chaos.arm("launch.ssh", "raise", times=1)
+    log_dir = str(tmp_path / "logs")
+    sup = RunSupervisor(
+        [_spec(f"print('{STARTED_SENTINEL}'); print('attempt output')",
+               "h0", remote=True)],
+        connect_backoff=0.01, stream=io.StringIO(), log_dir=log_dir)
+    assert sup.run() == 0
+    assert sup.status[0].attempts == 2
+    log = (tmp_path / "logs" / "h0.rank0.log").read_text()
+    assert "attempt output" in log
+
+
+def test_no_log_dir_keeps_local_ranks_unpiped(tmp_path):
+    """Without log_dir, local ranks inherit the launcher's stdio (no
+    capture thread) — the pre-existing behavior."""
+    sup = RunSupervisor([_spec("print('inherit')", "h0")],
+                        stream=io.StringIO())
+    assert sup.run() == 0
+    assert sup.rank_log_path(0) is None
+
+
 def test_watchdog_restarts_after_stop():
     """start() after stop() must arm a REAL monitor thread (a stale stop
     flag would leave the engine believing it is protected)."""
