@@ -221,22 +221,59 @@ class ProjectIndex:
     # ------------------------------------------------------- rank guards
 
     def _fn_rank_locals(self, module, fn: Optional[ast.AST]) -> Set[str]:
-        """Names in ``fn`` assigned from a rank probe (``p =
-        jax.process_index()``)."""
+        """Names in ``fn`` carrying rank identity: assigned from a rank
+        probe (``p = jax.process_index()``), aliased from another rank
+        local (``me = p``), or — the boolean-local depth — assigned a
+        BOOLEAN expression over one (``is_master = rank == 0``,
+        ``lead = p == 0 and not dry_run``). Boolean-ness is required for
+        expression RHSes: ``msg = f"rank {rank}"`` carries a rank-derived
+        *value*, not a rank-divergent *predicate*, and treating every
+        tainted local as a guard would drown the rule in FPs. Computed to
+        a fixpoint so ``is_master = rank == 0; lead = is_master`` chains
+        resolve."""
         key = fn if fn is not None else module
         if key in self._rank_locals:
             return self._rank_locals[key]
         names: Set[str] = set()
-        for node in module.nodes_by_fn.get(fn, ()):
-            if isinstance(node, ast.Assign) and isinstance(
-                    node.value, ast.Call) and self._is_rank_call(
-                    module, node.value):
+        assigns = [n for n in module.nodes_by_fn.get(fn, ())
+                   if isinstance(n, ast.Assign)]
+        changed = True
+        while changed:
+            changed = False
+            for node in assigns:
+                if not self._is_rank_rhs(module, node.value, names):
+                    continue
                 for t in node.targets:
                     for leaf in ast.walk(t):
-                        if isinstance(leaf, ast.Name):
+                        if isinstance(leaf, ast.Name) and \
+                                leaf.id not in names:
                             names.add(leaf.id)
+                            changed = True
         self._rank_locals[key] = names
         return names
+
+    def _is_rank_rhs(self, module, value: ast.AST, known: Set[str]) -> bool:
+        """Does assigning ``value`` make the target rank-divergent?
+        (a) the RHS IS a rank read — a probe call, a rank-named
+        name/attribute, or an already-known rank local (plain aliasing);
+        (b) the RHS is a boolean expression (Compare/BoolOp/not) that
+        READS one anywhere inside."""
+        def reads_rank(n: ast.AST) -> bool:
+            if isinstance(n, ast.Call):
+                return self._is_rank_call(module, n)
+            if isinstance(n, ast.Name):
+                return bool(_RANK_NAME.match(n.id)) or n.id in known
+            if isinstance(n, ast.Attribute):
+                return bool(_RANK_NAME.match(n.attr))
+            return False
+
+        if reads_rank(value):
+            return True
+        if isinstance(value, (ast.Compare, ast.BoolOp)) or (
+                isinstance(value, ast.UnaryOp)
+                and isinstance(value.op, ast.Not)):
+            return any(reads_rank(n) for n in ast.walk(value))
+        return False
 
     def _is_rank_call(self, module, call: ast.Call) -> bool:
         q = self.qualify(module, call.func)

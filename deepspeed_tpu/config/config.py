@@ -323,22 +323,30 @@ class NonFiniteGuardConfig(DeepSpeedConfigModel):
 
 
 class WatchdogConfig(DeepSpeedConfigModel):
-    """TPU-native (round-4): in-worker stall watchdog. A wedged rank in a
-    multi-controller job silently deadlocks every collective in the pod;
-    with ``stall_timeout > 0`` the engine heartbeats the watchdog on every
-    optimizer step, and a longer gap dumps all thread stacks and exits the
+    """TPU-native (rounds 4+6): in-worker PHASE-AWARE watchdog. A wedged
+    rank in a multi-controller job silently deadlocks every collective in
+    the pod; the engine reports lifecycle phases (RESTORE → COMPILE →
+    STEP → SAVE, runtime/heartbeat.py) and each phase gets its own
+    deadline — a gap beyond it dumps all thread stacks and exits the
     distinct stall rc (runtime/watchdog.py: STALL_EXIT_CODE) so the
     launcher-side supervisor tears the world down and the elastic agent
-    restarts — counted against its budget, unlike a preemption. The
-    watchdog suspends during checkpoint saves and the preemption grace
-    window (slow IO is not a hang). The related bound on
-    ``jax.distributed.initialize`` is NOT a ds_config knob — it must act
-    before any config is parsed: set ``DSTPU_INIT_TIMEOUT`` (forwarded to
-    remote hosts by dstpu), ``launch.py --init_timeout``, or the
-    ``initialization_timeout=`` kwarg of ``init_distributed``.
-    See docs/RESILIENCE.md."""
-    stall_timeout: float = 0.0    # seconds without a step heartbeat; 0 = off
-    poll_interval: float = 0.0    # check cadence; 0 = stall_timeout / 4
+    restarts — counted against its budget, unlike a preemption. 0 leaves
+    a phase unbounded. ``stall_timeout`` bounds steady-state STEP gaps;
+    ``compile_timeout`` the first-train_batch-entry → first-completed-step
+    window (the compile hang the round-4 watchdog could not see);
+    ``restore_timeout`` a checkpoint load; ``save_timeout`` a checkpoint
+    write (0 keeps the round-4 suspend-through-saves behavior). The
+    watchdog still suspends through the preemption grace window. The
+    related bound on ``jax.distributed.initialize`` (the INIT phase) is
+    NOT a ds_config knob — it must act before any config is parsed: set
+    ``DSTPU_INIT_TIMEOUT`` (forwarded to remote hosts by dstpu),
+    ``launch.py --init_timeout``, or the ``initialization_timeout=``
+    kwarg of ``init_distributed``. See docs/RESILIENCE.md."""
+    stall_timeout: float = 0.0    # STEP: secs without a step heartbeat; 0 = off
+    poll_interval: float = 0.0    # check cadence; 0 = min active deadline / 4
+    compile_timeout: float = 0.0  # COMPILE: first entry -> first step; 0 = off
+    restore_timeout: float = 0.0  # RESTORE: load_checkpoint bound; 0 = off
+    save_timeout: float = 0.0     # SAVE: save bound; 0 = unbounded (suspend)
 
 
 class ProgressiveLayerDropConfig(DeepSpeedConfigModel):

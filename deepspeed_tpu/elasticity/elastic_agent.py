@@ -22,9 +22,22 @@ Stall contract (round-4): a worker the stall watchdog shot
 of a run that wedges deterministically would burn the pod forever. The
 agent tracks it separately (``stalls``) so operators can tell "restarted
 because wedged" from "restarted because crashed". The run the agent
-monitors may be a single worker Popen or a launcher-side
-``RunSupervisor`` (duck-typed: poll/wait/terminate/kill), which is how
-``dstpu --elastic`` stacks agent-over-supervisor-over-ranks.
+monitors may be a single worker Popen, a launcher-side ``RunSupervisor``
+or a scheduler-side ``BackendSupervisor`` (duck-typed:
+poll/wait/terminate/kill), which is how ``dstpu --elastic`` stacks
+agent-over-supervisor-over-ranks on every launcher.
+
+Degraded-world contract (round-6): when a COUNTED failure carries host
+attribution — the supervisor's ``failed_hosts()`` facade method, plus
+heartbeat evidence (``heartbeat_dir``: ranks whose last word is STALLED
+or whose record went stale) — the agent strikes those hosts; a host
+reaching ``blacklist_after`` strikes is QUARANTINED and the next world
+is re-formed from the survivors, so losing a host costs one restart
+instead of the run. Quarantine never shrinks the world below
+``min_nodes``: when it would, the weakest candidate is paroled instead
+(a flaky host beats no pod at all). The surviving world is published to
+``active_hostfile`` ("host slots=N" lines, atomic rewrite) for operators
+and for scheduler backends that fan out from a hostfile.
 """
 
 from __future__ import annotations
@@ -53,7 +66,11 @@ class DSElasticAgent:
                  check_interval: float = 1.0,
                  min_nodes: int = 1,
                  confirm_polls: int = 2,
-                 teardown_grace: float = 30.0):
+                 teardown_grace: float = 30.0,
+                 heartbeat_dir: Optional[str] = None,
+                 heartbeat_timeout: float = 0.0,
+                 blacklist_after: int = 2,
+                 active_hostfile: Optional[str] = None):
         """launch_fn(active_hosts) -> Popen for one training run.
 
         ``confirm_polls``: how many CONSECUTIVE identical polls must agree
@@ -65,7 +82,14 @@ class DSElasticAgent:
         take before the agent SIGKILLs — must COVER the run's own
         SIGTERM->grace->SIGKILL window (RunSupervisor's grace_secs, i.e.
         the emergency-checkpoint budget), or the agent's kill races the
-        in-flight preemption saves it exists to protect."""
+        in-flight preemption saves it exists to protect.
+
+        ``heartbeat_dir`` + ``blacklist_after``: degraded-world resume —
+        see the module docstring. ``heartbeat_timeout`` (optional) also
+        counts ranks whose last record LAGS the channel's freshest record
+        by more than that many seconds at failure time as evidence
+        against their host (never wall-clock age: by read time the dead
+        world has frozen every record)."""
         self.launch_fn = launch_fn
         self.hostfile = hostfile
         self.max_restarts = max_restarts
@@ -73,14 +97,37 @@ class DSElasticAgent:
         self.min_nodes = min_nodes
         self.confirm_polls = max(1, confirm_polls)
         self.teardown_grace = float(teardown_grace)
+        self.heartbeat_dir = heartbeat_dir
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.blacklist_after = max(1, int(blacklist_after))
+        self.active_hostfile = active_hostfile
         self.restarts = 0
         self.membership_changes = 0
         self.preemptions = 0
         self.stalls = 0
+        self.strikes: Dict[str, int] = {}
+        self.blacklisted: set = set()
 
     def _members(self) -> List[str]:
         pool = self._read_members()
-        return pool if pool else ["localhost"]
+        members = pool if pool else ["localhost"]
+        survivors = [h for h in members if h not in self.blacklisted]
+        if len(survivors) < self.min_nodes:
+            # quarantine must not starve the pod below min_nodes: parole
+            # the least-struck hosts back in rather than waiting forever
+            parole = sorted((h for h in members if h in self.blacklisted),
+                            key=lambda h: self.strikes.get(h, 0))
+            while len(survivors) < self.min_nodes and parole:
+                host = parole.pop(0)
+                self.blacklisted.discard(host)
+                self.strikes[host] = 0
+                logger.warning(
+                    "elastic agent: paroling blacklisted host %s — the "
+                    "surviving world would drop below min_nodes=%d",
+                    host, self.min_nodes)
+                survivors = [h for h in members
+                             if h not in self.blacklisted]
+        return survivors
 
     def _read_members(self) -> Optional[List[str]]:
         """Hostfile membership, or None on a transient failure (unreadable
@@ -94,6 +141,97 @@ class DSElasticAgent:
             return None
         return list(pool) if pool else None
 
+    # ------------------------------------------------------ degraded world
+
+    def _publish_active_world(self, members: List[str]) -> None:
+        """Atomically rewrite the active hostfile with the surviving
+        world ("host slots=N"; slots looked up from the operator's
+        hostfile, defaulting to 1) — the file scheduler backends fan out
+        from and operators watch."""
+        if not self.active_hostfile:
+            return
+        try:
+            pool = fetch_hostfile(self.hostfile)
+        except (OSError, ValueError):
+            pool = {}
+        lines = "".join(f"{h} slots={pool.get(h, 1)}\n" for h in members)
+        try:
+            tmp = self.active_hostfile + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(lines)
+            os.replace(tmp, self.active_hostfile)
+        except OSError as e:
+            logger.warning("elastic agent: cannot publish active hostfile "
+                           "%s: %s", self.active_hostfile, e)
+
+    def _failure_evidence(self, proc, members: List[str]) -> List[str]:
+        """Hosts implicated in a counted failure: the supervisor's own
+        attribution first, then the heartbeat channel (ranks whose last
+        word is STALLED, or whose record went stale)."""
+        from ..runtime import heartbeat as hb
+        implicated: List[str] = []
+        # the world ranks were ACTUALLY assigned over: launch_fn may narrow
+        # the agent's confirmed membership further (--include/--exclude/
+        # --num_nodes), so rank->host recovery must index the launched
+        # world — both supervisors expose it as rank_hosts — or rank 1's
+        # evidence lands on an innocent filtered-out neighbor
+        launched = list(getattr(proc, "rank_hosts", None) or members)
+
+        def _rec_host(rec: dict):
+            # records SHOULD carry hostfile-vocabulary names (launch.py
+            # exports DSTPU_HEARTBEAT_HOST), but a record written by an
+            # out-of-band worker self-reports gethostname(); the shared
+            # recovery falls back to the rank's position in the launched
+            # world so the evidence still lands on a strikable member
+            return hb.rec_host(rec, launched, known_hosts=members)
+
+        failed_hosts = getattr(proc, "failed_hosts", None)
+        if callable(failed_hosts):
+            try:
+                implicated.extend(h for h in failed_hosts()
+                                  if h and h not in implicated)
+            except Exception as e:      # attribution is best-effort
+                logger.warning("elastic agent: failed_hosts() raised: %s", e)
+        if self.heartbeat_dir:
+            for rec in hb.terminal_records(self.heartbeat_dir).values():
+                if rec.get("phase") == hb.PHASE_STALLED:
+                    host = _rec_host(rec)
+                    if host and host not in implicated:
+                        implicated.append(host)
+            if self.heartbeat_timeout > 0:
+                # post-mortem staleness: the world is DOWN by the time the
+                # agent reads the channel, so every record is frozen and
+                # wall-clock age would implicate the whole (innocent)
+                # world — the same frozen-record bug RunSupervisor's
+                # at-detection snapshot exists to avoid. A rank that went
+                # silent BEFORE the world died instead LAGS the freshest
+                # record by more than the timeout; measure against that.
+                records = hb.read_heartbeats(self.heartbeat_dir)
+                freshest = max((float(r.get("ts", 0.0))
+                                for r in records.values()), default=0.0)
+                for rec in records.values():
+                    if rec.get("phase") in hb.TERMINAL_PHASES:
+                        continue
+                    lag = freshest - float(rec.get("ts", 0.0))
+                    if lag > self.heartbeat_timeout:
+                        host = _rec_host(rec)
+                        if host and host not in implicated:
+                            implicated.append(host)
+        return [h for h in implicated if h in members]
+
+    def _record_failures(self, proc, members: List[str]) -> None:
+        for host in self._failure_evidence(proc, members):
+            self.strikes[host] = self.strikes.get(host, 0) + 1
+            if self.strikes[host] >= self.blacklist_after and \
+                    host not in self.blacklisted:
+                self.blacklisted.add(host)
+                logger.error(
+                    "elastic agent: quarantining host %s after %d failure "
+                    "strike(s) — the next world re-forms from the "
+                    "survivors", host, self.strikes[host])
+
+    # -------------------------------------------------------------- monitor
+
     def run(self) -> int:
         """Supervise until a run exits 0 (or restarts are exhausted).
         Returns the final exit code (reference: _invoke_run's monitor loop,
@@ -105,8 +243,17 @@ class DSElasticAgent:
                                len(members), self.min_nodes)
                 time.sleep(self.check_interval)
                 continue
+            self._publish_active_world(members)
             log_dist(f"elastic agent: launching over {len(members)} nodes "
-                     f"(restart {self.restarts})", ranks=[0])
+                     f"(restart {self.restarts}, "
+                     f"{len(self.blacklisted)} quarantined)", ranks=[0])
+            if self.heartbeat_dir:
+                # evidence for the PREVIOUS attempt was read in
+                # _record_failures; scope the channel to this attempt so
+                # a stale STALLED record can't re-strike a host or turn a
+                # clean relaunch's rc into 117
+                from ..runtime import heartbeat as hb
+                hb.clear_channel(self.heartbeat_dir)
             proc = self.launch_fn(members)
             rc = self._monitor(proc, members)
             if rc == 0:
@@ -130,6 +277,9 @@ class DSElasticAgent:
                 logger.warning("elastic agent: worker stalled (rc=%d, "
                                "stall %d); restarting (counted against "
                                "max_restarts)", rc, self.stalls)
+            # counted failure: strike the implicated hosts so a repeat
+            # offender is quarantined and the world re-forms without it
+            self._record_failures(proc, members)
             self.restarts += 1
             if self.restarts > self.max_restarts:
                 logger.error("elastic agent: max_restarts exceeded (rc=%d)",
@@ -153,6 +303,9 @@ class DSElasticAgent:
             if rc is not None:
                 return rc
             observed = self._read_members()
+            if observed is not None:
+                observed = [h for h in observed
+                            if h not in self.blacklisted]
             if observed is None or observed == members:
                 pending, agree = None, 0
             else:
@@ -167,10 +320,11 @@ class DSElasticAgent:
                              ranks=[0])
                     proc.terminate()
                     try:
-                        # +5s headroom: the run's OWN teardown (grace for
-                        # emergency checkpoints, then SIGKILL) must finish
-                        # before the agent escalates
-                        proc.wait(timeout=self.teardown_grace + 5.0)
+                        # +10s headroom: the run's OWN teardown — the
+                        # backend kill-path call (bounded <= 5s), then
+                        # grace for emergency checkpoints, then SIGKILL —
+                        # must finish before the agent escalates
+                        proc.wait(timeout=self.teardown_grace + 10.0)
                     except subprocess.TimeoutExpired:
                         proc.kill()
                         proc.wait()

@@ -70,15 +70,55 @@ def resolve_node_rank(args) -> int:
 def main(argv=None):
     args = parse_args(argv)
     import jax
+    node_rank = 0
+    if args.nnodes > 1:
+        node_rank = resolve_node_rank(args)
+    # the heartbeat channel starts attesting BEFORE the rendezvous: a
+    # rank wedged inside jax.distributed.initialize is visible to
+    # launcher-side monitors (and `dstpu health`) as INIT, not as a rank
+    # that never existed
+    from ..runtime import heartbeat as hb
+    if args.world_info:
+        from .runner import decode_world_info
+        hosts = list(decode_world_info(args.world_info))
+        if 0 <= node_rank < len(hosts):
+            # records must name hosts in the OPERATOR's hostfile
+            # vocabulary (blacklist/attribution compare against it), not
+            # gethostname()'s FQDN/alias; env so the engine's own writer
+            # (same process, after runpy) inherits the name
+            import os
+            os.environ[hb.HEARTBEAT_HOST_ENV] = hosts[node_rank]
+    writer = hb.HeartbeatWriter.from_env(rank=node_rank)
+    if writer is not None:
+        writer.write(hb.PHASE_INIT, 0, force=True)
     if args.nnodes > 1:
         from ..runtime.watchdog import init_deadline
         with init_deadline(args.init_timeout):
             jax.distributed.initialize(
                 coordinator_address=args.coordinator,
                 num_processes=args.nnodes,
-                process_id=resolve_node_rank(args))
+                process_id=node_rank)
+    if writer is not None:
+        # hand this writer (refresher included) to the engine via the
+        # process registry: the engine's from_env ADOPTS it instead of
+        # creating a second writer for the same file, and until an engine
+        # exists the refresher keeps the INIT record fresh through the
+        # user script's import/setup window — closing here would let a
+        # slow setup read as launcher-side silence and tear down a
+        # healthy launch
+        hb.set_process_writer(writer)
     sys.argv = [args.user_script] + args.user_args
-    runpy.run_path(args.user_script, run_name="__main__")
+    try:
+        runpy.run_path(args.user_script, run_name="__main__")
+    except SystemExit as e:
+        if writer is not None and e.code in (0, None):
+            writer.stamp_terminal(hb.PHASE_EXIT, lock_timeout=5.0)
+        raise
+    if writer is not None:
+        # clean completion without engine.close() (or without any engine
+        # at all): conclude the record so a frozen non-terminal phase
+        # can't read as heartbeat silence after the process is gone
+        writer.stamp_terminal(hb.PHASE_EXIT, lock_timeout=5.0)
 
 
 if __name__ == "__main__":

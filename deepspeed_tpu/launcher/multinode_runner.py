@@ -6,14 +6,27 @@ per-backend launch command). Each runner turns (environment exports, active
 resource pool, user command) into ONE argv the scheduler executes; TPU hosts
 run one process per host (jax.distributed wires ranks), so the per-GPU rank
 plumbing of the reference collapses into node-level dispatch.
+
+Round-6 supervision contract: besides ``get_cmd`` each runner now
+describes its OWN teardown and observability surfaces so
+``launcher.supervisor.BackendSupervisor`` can treat the scheduler like a
+supervised world instead of an opaque Popen:
+
+- ``get_kill_cmd``: the backend-native way to reach the REMOTE ranks
+  (``scancel`` the allocation, ``pdsh -w ... pkill`` the bootstraps) —
+  signaling the local scheduler process alone may orphan them;
+- ``route_line``: demultiplex the scheduler's merged output stream into
+  per-rank/host keys (``pdsh`` prefixes ``host:``, ``srun --label``
+  prefixes ``taskid:``) for ``--log-dir`` persistence.
 """
 
 from __future__ import annotations
 
 import os
+import re
 import shlex
 from abc import ABC, abstractmethod
-from typing import Dict, List
+from typing import Dict, List, Optional, Tuple
 
 
 class MultiNodeRunner(ABC):
@@ -37,6 +50,19 @@ class MultiNodeRunner(ABC):
     def backend_exists(self) -> bool:
         return True
 
+    def get_kill_cmd(self, environment: Dict[str, str],
+                     active_resources: Dict[str, int]
+                     ) -> Optional[List[str]]:
+        """Backend-native teardown argv reaching the REMOTE ranks, or
+        None when signaling the scheduler process is already sufficient
+        (mpirun propagates SIGTERM to its children)."""
+        return None
+
+    def route_line(self, line: str) -> Optional[Tuple[str, str]]:
+        """(log key, payload) for one merged-output line, or None when
+        this backend's stream carries no per-rank attribution."""
+        return None
+
     def _user_cmd(self, environment: Dict[str, str],
                   active_resources: Dict[str, int]) -> List[str]:
         """Per-node bootstrap through launch.py (jax.distributed rendezvous;
@@ -51,6 +77,12 @@ class MultiNodeRunner(ABC):
                  f"--coordinator={coordinator}:{port}",
                  f"--world_info={self.world_info_base64}",
                  self.user_script] + self.user_arguments)
+
+
+#: the per-host bootstrap every backend dispatches — the pattern the
+#: pdsh kill path pkills (killing the bootstrap tears down the user
+#: script it exec'd into; matching the module name avoids collateral)
+_BOOTSTRAP_PATTERN = "deepspeed_tpu.launcher.launch"
 
 
 class PDSHRunner(MultiNodeRunner):
@@ -71,9 +103,22 @@ class PDSHRunner(MultiNodeRunner):
                  env_exports + "cd " + shlex.quote(os.getcwd()) + "; "]
                 + self._user_cmd(environment, active_resources))
 
+    def get_kill_cmd(self, environment, active_resources):
+        hosts = ",".join(active_resources)
+        return ["pdsh", "-S", "-w", hosts,
+                f"pkill -TERM -f {_BOOTSTRAP_PATTERN}"]
+
+    #: pdsh prefixes every forwarded line with "host: "
+    _PREFIX = re.compile(r"^(\S+?): (.*\n?)$")
+
+    def route_line(self, line):
+        m = self._PREFIX.match(line)
+        return (m.group(1), m.group(2)) if m else None
+
 
 class OpenMPIRunner(MultiNodeRunner):
-    """reference: multinode_runner.py:116 — mpirun with one proc per host."""
+    """reference: multinode_runner.py:116 — mpirun with one proc per host.
+    No kill_cmd: mpirun forwards SIGTERM to every remote rank itself."""
 
     name = "openmpi"
 
@@ -105,6 +150,9 @@ class SlurmRunner(MultiNodeRunner):
     def get_cmd(self, environment, active_resources):
         total = len(active_resources)
         cmd = ["srun", "-n", str(total), "--ntasks-per-node=1",
+               # per-rank attribution in the merged stream ("taskid: ")
+               # — what route_line demultiplexes for --log-dir
+               "--label",
                # the filtered pool IS the node list (the include syntax's
                # ':slot' parts are not valid slurm node names)
                "--nodelist", ",".join(active_resources)]
@@ -114,12 +162,37 @@ class SlurmRunner(MultiNodeRunner):
             cmd += [f"--export=ALL,{exports}"]
         return cmd + self._user_cmd(environment, active_resources)
 
+    def get_kill_cmd(self, environment, active_resources):
+        # inside an allocation (sbatch/salloc) SLURM_JOB_ID names the job
+        # scancel can reach every node of; outside one there is nothing
+        # to cancel beyond the srun process itself
+        job_id = environment.get("SLURM_JOB_ID",
+                                 os.environ.get("SLURM_JOB_ID", ""))
+        if not job_id:
+            return None
+        return ["scancel", "--signal=TERM", job_id]
+
+    #: srun --label prefixes every line with "taskid: "
+    _PREFIX = re.compile(r"^(\d+): (.*\n?)$")
+
+    def route_line(self, line):
+        m = self._PREFIX.match(line)
+        return (f"rank{m.group(1)}", m.group(2)) if m else None
+
 
 class MVAPICHRunner(OpenMPIRunner):
     """reference: multinode_runner.py:218 — mpirun_rsh with MV2 env; the
-    TPU-relevant delta from OpenMPI is just the launcher binary + env names."""
+    TPU-relevant delta from OpenMPI is the launcher binary + the MV2_*
+    environment the reference validates/injects (force TCP-friendly
+    defaults; debug backtraces on)."""
 
     name = "mvapich"
+
+    #: env the reference's runner injects when absent (mvapich needs the
+    #: MV2_* family set explicitly; unlike OpenMPI there is no -x flag —
+    #: mpirun_rsh takes bare K=V argv pairs)
+    MV2_DEFAULTS = {"MV2_SMP_USE_CMA": "0",
+                    "MV2_DEBUG_SHOW_BACKTRACE": "1"}
 
     def backend_exists(self) -> bool:
         import shutil
@@ -129,7 +202,8 @@ class MVAPICHRunner(OpenMPIRunner):
         total = len(active_resources)
         cmd = ["mpirun_rsh", "-np", str(total), "-hostfile",
                getattr(self.args, "hostfile", "/job/hostfile")]
-        for k, v in {**environment, **self.exports}.items():
+        env = {**self.MV2_DEFAULTS, **environment, **self.exports}
+        for k, v in env.items():
             cmd.append(f"{k}={v}")
         return cmd + self._user_cmd(environment, active_resources)
 

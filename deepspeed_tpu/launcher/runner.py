@@ -22,7 +22,6 @@ import base64
 import json
 import os
 import shlex
-import subprocess
 import sys
 from collections import OrderedDict
 from typing import Dict, List, Optional
@@ -217,13 +216,76 @@ def parse_args(argv=None):
                         "<log_dir>/<host>.rank<k>.log alongside the live "
                         "prefixed stream (local ranks switch to captured "
                         "pipes); truncated per run, appended across "
-                        "connect retries")
+                        "connect retries. Scheduler backends "
+                        "(pdsh/slurm) demultiplex their merged stream by "
+                        "the per-rank prefix into <log_dir>/<key>.log")
+    # -- heartbeat channel (round-6; docs/RESILIENCE.md) ---------------------
+    p.add_argument("--heartbeat-dir", "--heartbeat_dir", default="",
+                   dest="heartbeat_dir",
+                   help="shared directory for per-rank liveness records "
+                        "(exported to workers as DSTPU_HEARTBEAT_DIR); "
+                        "enables launcher-side per-rank liveness on EVERY "
+                        "backend incl. pdsh/slurm/openmpi, blacklist-"
+                        "driven degraded resume under --elastic, and "
+                        "`dstpu health <dir>`")
+    p.add_argument("--heartbeat-timeout", "--heartbeat_timeout", type=float,
+                   default=0.0, dest="heartbeat_timeout",
+                   help="seconds of heartbeat silence (a rank that stops "
+                        "attesting liveness) before the supervisor tears "
+                        "the launch down as a stall (rc 117); 0 disables "
+                        "silence detection (records still written)")
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
+def health_main(argv) -> int:
+    """``dstpu health <heartbeat-dir>`` — the operator's one-glance pod
+    view: per-rank phase, step, record age, host and pid from the
+    heartbeat channel. Exit 0 when every rank is live or concluded
+    cleanly, 1 when any rank's last word is STALLED or the channel is
+    empty (nothing attesting = nothing provably alive)."""
+    import time as _time
+    from ..runtime import heartbeat as hb
+    p = argparse.ArgumentParser(prog="dstpu health")
+    p.add_argument("heartbeat_dir")
+    p.add_argument("--stale-after", type=float, default=60.0,
+                   help="flag records older than this many seconds")
+    a = p.parse_args(argv)
+    records = hb.read_heartbeats(a.heartbeat_dir)
+    if not records:
+        print(f"no heartbeat records under {a.heartbeat_dir}")
+        return 1
+    now = _time.time()
+    rows = [("RANK", "HOST", "PHASE", "STEP", "AGE", "PID", "")]
+    bad = False
+    for rank in sorted(records):
+        rec = records[rank]
+        age = hb.record_age(rec, now)
+        phase = str(rec.get("phase"))
+        note = ""
+        if phase == hb.PHASE_STALLED:
+            note, bad = "wedged (rc 117)", True
+        elif phase == hb.PHASE_PREEMPTED:
+            note = "preempted (rc 114)"
+        elif phase == hb.PHASE_EXIT:
+            note = "clean exit"
+        elif age > a.stale_after:
+            note, bad = f"SILENT > {a.stale_after:.0f}s", True
+        rows.append((str(rank), str(rec.get("host")), phase,
+                     str(rec.get("step")), f"{age:.1f}s",
+                     str(rec.get("pid")), note))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return 1 if bad else 0
+
+
 def main(argv=None):
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "health":
+        sys.exit(health_main(argv[1:]))
     args = parse_args(argv)
     if args.autotuning:
         if not args.deepspeed_config:
@@ -253,18 +315,35 @@ def main(argv=None):
     if args.num_nodes > 0:
         active = OrderedDict(list(active.items())[:args.num_nodes])
     exports = collect_env_exports()
+    _apply_heartbeat_exports(args, exports)
     if args.launcher in ("pdsh", "openmpi", "slurm", "mvapich"):
-        cmd = _backend_cmd(args, active, exports)
-        sys.exit(subprocess.call(cmd))
+        # scheduler backends run as ONE process, but no longer as an
+        # UNSUPERVISED one: BackendSupervisor adds heartbeat-driven
+        # per-rank liveness, the backend's own kill path on first
+        # confirmed failure, and rc 114/117 reconstruction (round 6)
+        sys.exit(build_backend_supervisor(active, args, exports).run())
     # ssh/local: concurrent per-rank supervision — first failure tears the
     # world down, connect failures retry, rc 114 survives aggregation
     # (reference: launch.py terminate_process_tree, rebuilt fail-fast)
     sys.exit(build_world_supervisor(active, args, exports).run())
 
 
-def _backend_cmd(args, active, exports) -> List[str]:
-    """ONE scheduler command — the backend fans out itself (reference:
-    multinode_runner.py get_cmd per backend)."""
+def _apply_heartbeat_exports(args, exports: Dict[str, str]) -> None:
+    """--heartbeat-dir reaches every worker as DSTPU_HEARTBEAT_DIR (the
+    DSTPU_ prefix already rides collect_env_exports to remote hosts) and
+    the launcher's own environment (loopback ranks + backend schedulers
+    inherit it)."""
+    hb_dir = getattr(args, "heartbeat_dir", "") or ""
+    if not hb_dir:
+        return
+    hb_dir = os.path.abspath(hb_dir)
+    os.makedirs(hb_dir, exist_ok=True)
+    exports["DSTPU_HEARTBEAT_DIR"] = hb_dir
+    os.environ["DSTPU_HEARTBEAT_DIR"] = hb_dir
+
+
+def _backend_runner_env(args, active, exports):
+    """(runner, env) for a scheduler backend launch."""
     from .multinode_runner import build_runner
     hosts = list(active)
     coordinator = args.master_addr or hosts[0]
@@ -275,7 +354,33 @@ def _backend_cmd(args, active, exports) -> List[str]:
     env = {"DSTPU_WORLD_INFO": world_info,
            "DSTPU_COORDINATOR": coordinator,
            "DSTPU_MASTER_PORT": str(args.master_port), **exports}
+    return runner, env
+
+
+def _backend_cmd(args, active, exports) -> List[str]:
+    """ONE scheduler command — the backend fans out itself (reference:
+    multinode_runner.py get_cmd per backend)."""
+    runner, env = _backend_runner_env(args, active, exports)
     return runner.get_cmd(env, active)
+
+
+def build_backend_supervisor(active: "OrderedDict[str, List[int]]", args,
+                             exports: Dict[str, str]):
+    """A not-yet-started BackendSupervisor over one scheduler command,
+    wired with the backend's own kill path and output routing."""
+    from .supervisor import BackendSupervisor
+    runner, env = _backend_runner_env(args, active, exports)
+    hosts = {h: active[h] for h in active}
+    return BackendSupervisor(
+        runner.get_cmd(env, hosts),
+        kill_cmd=runner.get_kill_cmd(env, hosts),
+        heartbeat_dir=getattr(args, "heartbeat_dir", "") or None,
+        heartbeat_timeout=getattr(args, "heartbeat_timeout", 0.0),
+        grace_secs=getattr(args, "grace_secs", 30.0),
+        log_dir=getattr(args, "log_dir", "") or None,
+        route_line=runner.route_line,
+        backend=runner.name,
+        rank_hosts=list(hosts))
 
 
 _LOCAL_HOSTS = ("localhost", "127.0.0.1", "::1")
@@ -310,7 +415,11 @@ def build_world_supervisor(active: "OrderedDict[str, List[int]]", args,
     return RunSupervisor(specs,
                          grace_secs=args.grace_secs,
                          connect_retries=args.connect_retries,
-                         log_dir=getattr(args, "log_dir", "") or None)
+                         log_dir=getattr(args, "log_dir", "") or None,
+                         heartbeat_dir=getattr(args, "heartbeat_dir", "")
+                         or None,
+                         heartbeat_timeout=getattr(args, "heartbeat_timeout",
+                                                   0.0))
 
 
 def elastic_active_world(args, members: List[str]
@@ -334,12 +443,22 @@ def elastic_active_world(args, members: List[str]
 
 
 def run_elastic(args) -> int:
-    """dstpu --elastic: DSElasticAgent supervising the RunSupervisor.
+    """dstpu --elastic: DSElasticAgent supervising the RunSupervisor (ssh/
+    local) or the BackendSupervisor (scheduler backends — same facade,
+    same rc contract since round 6).
 
     The agent polls the hostfile and relaunches on membership change; the
     rc contract does the rest — 114 (preemption) resumes without touching
-    --max-restarts, the stall rc and crashes count against it."""
+    --max-restarts, the stall rc and crashes count against it. With a
+    heartbeat channel the agent also quarantines repeatedly-failing hosts
+    and re-forms a SMALLER world from the survivors (degraded resume),
+    publishing it to <hostfile>.active — which is also the hostfile the
+    scheduler backends launch over, so a blacklisted host leaves their
+    worlds too."""
     from ..elasticity.elastic_agent import DSElasticAgent
+
+    active_hostfile = (args.hostfile + ".active"
+                       if os.path.isfile(args.hostfile) else None)
 
     def launch(members):
         active = elastic_active_world(args, members)
@@ -347,17 +466,29 @@ def run_elastic(args) -> int:
             sys.exit("dstpu --elastic: every confirmed member is excluded "
                      "by --include/--exclude; nothing to launch")
         exports = collect_env_exports()
+        _apply_heartbeat_exports(args, exports)
         if args.launcher in ("pdsh", "openmpi", "slurm", "mvapich"):
-            # the backend command is one OS process — a plain Popen is
-            # already the facade the agent monitors
-            return subprocess.Popen(_backend_cmd(args, active, exports))
+            backend_args = args
+            if active_hostfile and os.path.isfile(active_hostfile):
+                # the scheduler must fan out over the DEGRADED world, not
+                # the operator's full hostfile
+                backend_args = argparse.Namespace(**vars(args))
+                backend_args.hostfile = active_hostfile
+            return build_backend_supervisor(active, backend_args,
+                                            exports).start()
         return build_world_supervisor(active, args, exports).start()
 
     agent = DSElasticAgent(launch, args.hostfile,
                            max_restarts=args.max_restarts,
                            min_nodes=args.min_nodes,
                            check_interval=args.check_interval,
-                           teardown_grace=args.grace_secs)
+                           teardown_grace=args.grace_secs,
+                           heartbeat_dir=getattr(args, "heartbeat_dir", "")
+                           or None,
+                           heartbeat_timeout=getattr(args,
+                                                     "heartbeat_timeout",
+                                                     0.0),
+                           active_hostfile=active_hostfile)
     return agent.run()
 
 

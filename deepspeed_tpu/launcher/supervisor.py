@@ -52,6 +52,8 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 from ..elasticity.elastic_agent import PREEMPTION_EXIT_CODE
+from ..runtime import heartbeat as hb
+from ..runtime.watchdog import STALL_EXIT_CODE
 from ..testing import chaos
 from ..utils.logging import logger
 
@@ -99,6 +101,67 @@ class _RankStatus:
         self.finished_at: Optional[float] = None
 
 
+class HeartbeatMonitor:
+    """Launcher-side consumer of the rank heartbeat channel
+    (runtime/heartbeat.py). Answers two questions the process/pipe view
+    cannot: *which phase* is a silent remote rank actually in, and *has
+    it stopped attesting liveness* (process or host dead — in-worker
+    phase deadlines handle wedges and stamp terminal records).
+
+    ``expected_ranks``: ranks that MUST eventually write — one that has
+    produced no file ``timeout`` seconds after monitoring began counts
+    silent too (a blackholed host never says anything at all)."""
+
+    def __init__(self, heartbeat_dir: str, timeout: float,
+                 expected_ranks: Optional[Sequence[int]] = None,
+                 clock=None):
+        self.heartbeat_dir = heartbeat_dir
+        self.timeout = float(timeout)
+        self.expected = set(int(r) for r in (expected_ranks or ()))
+        self._clock = clock or time.time
+        self._started = self._clock()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.heartbeat_dir) and self.timeout > 0
+
+    def snapshot(self) -> dict:
+        return hb.read_heartbeats(self.heartbeat_dir)
+
+    def silent_ranks(self) -> List[dict]:
+        """Ranks that stopped attesting: last record non-terminal and
+        older than ``timeout`` (hb.stale_ranks — ONE staleness rule for
+        launcher and agent), or expected but never seen."""
+        now = self._clock()
+        records = self.snapshot()
+        out = hb.stale_ranks(self.heartbeat_dir, self.timeout, now,
+                             records=records)
+        if now - self._started > self.timeout:
+            for rank in sorted(self.expected - set(records)):
+                out.append({"rank": rank, "host": None, "phase": None,
+                            "step": None, "ts": None, "missing": True})
+        return out
+
+    def terminal_records(self) -> dict:
+        return hb.terminal_records(self.heartbeat_dir)
+
+
+def _grace_then_kill(proc, grace_secs: float) -> None:
+    """Post-SIGTERM escalation shared by both supervisors: poll until the
+    grace deadline (the workers' emergency-checkpoint budget), SIGKILL
+    whatever is still alive."""
+    deadline = time.monotonic() + grace_secs
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return
+        time.sleep(0.05)
+    if proc.poll() is None:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+
+
 class RunSupervisor:
     """Monitor every rank concurrently; tear the world down on first
     failure; aggregate exit codes preemption-aware."""
@@ -111,7 +174,10 @@ class RunSupervisor:
                  connect_backoff_max: float = 10.0,
                  popen_fn: Optional[Callable[..., subprocess.Popen]] = None,
                  stream=None,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 heartbeat_dir: Optional[str] = None,
+                 heartbeat_timeout: float = 0.0,
+                 heartbeat_poll: float = 1.0):
         self.specs = list(specs)
         self.grace_secs = float(grace_secs)
         self.connect_retries = int(connect_retries)
@@ -127,6 +193,20 @@ class RunSupervisor:
         self.log_dir = log_dir
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
+        # heartbeat-channel liveness (round 6): with a shared heartbeat
+        # dir, ranks whose ssh pipe is silent still attest liveness via
+        # per-rank files; a rank that stops attesting (host dead, process
+        # blackholed) triggers the same fail-fast teardown as an exit —
+        # reported as a STALL so the elastic agent counts it
+        self.heartbeat_monitor: Optional[HeartbeatMonitor] = None
+        self.heartbeat_poll = float(heartbeat_poll)
+        self.heartbeat_dir = heartbeat_dir
+        if heartbeat_dir and heartbeat_timeout > 0:
+            self.heartbeat_monitor = HeartbeatMonitor(
+                heartbeat_dir, heartbeat_timeout,
+                expected_ranks=range(len(self.specs)))
+        self._hb_stall: Optional[str] = None    # teardown evidence
+        self._hb_silent: List[dict] = []        # snapshot AT detection
         self.status = [_RankStatus() for _ in self.specs]
         self._procs: List[Optional[subprocess.Popen]] = [None] * len(self.specs)
         self._lock = threading.Lock()
@@ -145,12 +225,56 @@ class RunSupervisor:
         if self._started or not self.specs:
             return self
         self._started = True
+        if self.heartbeat_dir:
+            # the channel is run-scoped: records from a previous attempt
+            # in a reused dir must not trip silence at t=0 or leak a
+            # prior STALLED verdict into this run's evidence
+            hb.clear_channel(self.heartbeat_dir)
         for idx in range(len(self.specs)):
             t = threading.Thread(target=self._monitor_rank, args=(idx,),
                                  name=f"dstpu-rank-{idx}", daemon=True)
             self._threads.append(t)
             t.start()
+        if self.heartbeat_monitor is not None:
+            t = threading.Thread(target=self._monitor_heartbeats,
+                                 name="dstpu-heartbeat-monitor", daemon=True)
+            self._threads.append(t)
+            t.start()
         return self
+
+    def _monitor_heartbeats(self) -> None:
+        while not self._done.wait(self.heartbeat_poll):
+            if self._teardown_started.is_set():
+                return
+            # a rank whose PROCESS already finished is the rank monitor's
+            # jurisdiction (its rc decides), not silence: a clean rank
+            # that never called engine.close() leaves a frozen
+            # non-terminal record, and treating that as a wedge would
+            # tear down the still-healthy survivors as rc 117
+            silent = [r for r in self.heartbeat_monitor.silent_ranks()
+                      if not self._rank_exited(r.get("rank"))]
+            if not silent:
+                continue
+            desc = ", ".join(
+                f"rank {r.get('rank')}"
+                + (f" ({r['host']})" if r.get("host") else "")
+                + (" never wrote" if r.get("missing")
+                   else f" silent in {r.get('phase')} at step "
+                        f"{r.get('step')}")
+                for r in silent)
+            # snapshot NOW: after the teardown freezes every rank's
+            # record, re-evaluating would implicate the whole world
+            self._hb_silent = silent
+            self._hb_stall = desc
+            logger.error("supervisor: heartbeat silence — %s (timeout "
+                         "%.1fs); tearing down the world", desc,
+                         self.heartbeat_monitor.timeout)
+            self._trigger_teardown(f"heartbeat silence: {desc}")
+            return
+
+    def _rank_exited(self, rank) -> bool:
+        return (isinstance(rank, int) and 0 <= rank < len(self.status)
+                and self.status[rank].rc is not None)
 
     def run(self) -> int:
         """start() + wait(): the non-elastic launcher entry point."""
@@ -243,6 +367,10 @@ class RunSupervisor:
 
     def _launch_once(self, idx: int) -> subprocess.Popen:
         spec = self.specs[idx]
+        # keyed failpoint: a blackholed host fails EVERY dispatch to it
+        # (arm with match=<host>), driving the blacklist/degraded-resume
+        # path without touching the other hosts of the world
+        chaos.failpoint("host.blackhole", key=spec.host)
         log = self._open_rank_log(idx)
         if spec.remote or log is not None:
             try:
@@ -366,21 +494,9 @@ class RunSupervisor:
             proc.terminate()
         except OSError:
             return
-
-        def _escalate():
-            deadline = time.monotonic() + self.grace_secs
-            while time.monotonic() < deadline:
-                if proc.poll() is not None:
-                    return
-                time.sleep(0.05)
-            if proc.poll() is None:
-                try:
-                    proc.kill()
-                except OSError:
-                    pass
-
-        threading.Thread(target=_escalate, name="dstpu-late-teardown",
-                         daemon=True).start()
+        threading.Thread(target=_grace_then_kill,
+                         args=(proc, self.grace_secs),
+                         name="dstpu-late-teardown", daemon=True).start()
 
     def _trigger_teardown(self, reason: str) -> None:
         with self._lock:
@@ -435,6 +551,11 @@ class RunSupervisor:
         if crashes:
             first = min(crashes, key=lambda s: s.finished_at or 0.0)
             return first.rc
+        if self._hb_stall is not None:
+            # the teardown was triggered by heartbeat silence, not an
+            # exit: every rank is a torn-down remnant, and the honest rc
+            # is "wedged" — counted by the elastic agent, like any stall
+            return STALL_EXIT_CODE
         if any(st.rc == PREEMPTION_EXIT_CODE for st in voluntary):
             return PREEMPTION_EXIT_CODE
         if all(st.rc == 0 for st in self.status):
@@ -446,3 +567,320 @@ class RunSupervisor:
             return PREEMPTION_EXIT_CODE
         nonzero = [st.rc for st in self.status if st.rc != 0]
         return nonzero[0] if nonzero else 0
+
+    @property
+    def rank_hosts(self) -> List[str]:
+        """World-ordered host per rank (one rank per spec) — the elastic
+        agent's rank->host recovery indexes THIS, not its own hostfile
+        membership, which launch-side --include/--exclude/--num_nodes
+        filters may have narrowed further."""
+        return [spec.host for spec in self.specs]
+
+    def failed_hosts(self) -> List[str]:
+        """Hosts this run has evidence AGAINST — the elastic agent's
+        blacklist feed: voluntary nonzero exits (crash/stall rc), remote
+        ranks that never got past the connect phase (a blackholed host),
+        and ranks the heartbeat monitor called silent."""
+        out = []
+        for spec, st in zip(self.specs, self.status):
+            voluntary_failure = (st.rc not in (None, 0, PREEMPTION_EXIT_CODE)
+                                 and not st.signaled)
+            never_started = (spec.remote and not st.started
+                             and not st.signaled
+                             and st.rc == SSH_CONNECT_RC)
+            if voluntary_failure or never_started:
+                out.append(spec.host)
+        if self._hb_stall is not None:
+            # the snapshot taken when silence was DETECTED — not a fresh
+            # silent_ranks() call: by attribution time the teardown has
+            # frozen every survivor's record, and re-evaluating would
+            # strike the whole (innocent) world
+            for rec in self._hb_silent:
+                host = hb.rec_host(rec, self.rank_hosts)
+                if host and host not in out:
+                    out.append(host)
+        return out
+
+
+class BackendSupervisor:
+    """Supervision for the SCHEDULER-dispatched launchers (pdsh / slurm /
+    openmpi / mvapich).
+
+    Those backends fan the world out through ONE scheduler command; the
+    launcher sees a single Popen whose pipe says nothing about per-rank
+    liveness, whose teardown semantics belong to the scheduler, and whose
+    exit code flattens the rc 114/117 contract (``pdsh -S`` returns the
+    LARGEST rc, ``srun`` whatever its step policy picks). This class
+    restores the three supervision properties the ssh path has had since
+    round 4:
+
+    - **per-rank liveness** via the heartbeat channel: a rank that stops
+      attesting (host dead, process blackholed) triggers teardown after
+      ``heartbeat_timeout`` — through the backend's OWN kill path
+      (``kill_cmd``: ``scancel``, ``pdsh -w ... pkill``) first, because
+      SIGTERM to the scheduler process alone may orphan remote ranks;
+    - **fail-fast teardown** with the same SIGTERM → ``grace_secs`` →
+      SIGKILL contract as RunSupervisor (the grace window is the workers'
+      emergency-checkpoint budget);
+    - **preemption-aware rc reconstruction**: the workers' terminal
+      heartbeat records (STALLED / PREEMPTED) overrule the scheduler's
+      flattened rc, so ``dstpu --elastic`` treats a preempted slurm world
+      exactly like a preempted ssh world (resume, uncounted).
+
+    ``route_line`` (from the backend's MultiNodeRunner) demultiplexes the
+    scheduler's merged output — ``pdsh``'s ``host:`` / ``srun --label``'s
+    ``rank:`` prefixes — into per-key files under ``log_dir``, mirroring
+    the PR-5 ssh-path log persistence.
+
+    Exposes the same Popen-like facade as RunSupervisor (``poll`` /
+    ``wait`` / ``terminate`` / ``kill`` / ``returncode``) so
+    DSElasticAgent supervises either interchangeably.
+    """
+
+    def __init__(self,
+                 cmd: Sequence[str],
+                 kill_cmd: Optional[Sequence[str]] = None,
+                 heartbeat_dir: Optional[str] = None,
+                 heartbeat_timeout: float = 0.0,
+                 heartbeat_poll: float = 1.0,
+                 grace_secs: float = 30.0,
+                 popen_fn: Optional[Callable[..., subprocess.Popen]] = None,
+                 run_fn: Optional[Callable[..., object]] = None,
+                 stream=None,
+                 log_dir: Optional[str] = None,
+                 route_line: Optional[Callable[[str],
+                                              Optional[tuple]]] = None,
+                 backend: str = "backend",
+                 rank_hosts: Optional[Sequence[str]] = None):
+        self.cmd = list(cmd)
+        # hostfile-ordered host per rank: lets silence/stall evidence be
+        # attributed even for a rank that NEVER wrote a record (node dead
+        # before launch.py ran — there is no self-reported host to read)
+        self.rank_hosts = list(rank_hosts) if rank_hosts else []
+        self.kill_cmd = list(kill_cmd) if kill_cmd else None
+        self.grace_secs = float(grace_secs)
+        self.heartbeat_poll = float(heartbeat_poll)
+        self.backend = backend
+        self._popen = popen_fn or subprocess.Popen
+        self._run_cmd = run_fn or subprocess.run
+        self._stream = stream if stream is not None else sys.stdout
+        self.log_dir = log_dir
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+        self.route_line = route_line
+        self.heartbeat_monitor: Optional[HeartbeatMonitor] = None
+        if heartbeat_dir and heartbeat_timeout > 0:
+            # expected_ranks closes the never-wrote blind spot: a host
+            # dead BEFORE launch.py runs produces no record at all, and
+            # without the expectation the launch would hang unsupervised
+            self.heartbeat_monitor = HeartbeatMonitor(
+                heartbeat_dir, heartbeat_timeout,
+                expected_ranks=(range(len(self.rank_hosts))
+                                if self.rank_hosts else None))
+        self._heartbeat_dir = heartbeat_dir
+        self._hb_stall: Optional[str] = None
+        self._silent_hosts: List[str] = []
+        self._proc: Optional[subprocess.Popen] = None
+        self._done = threading.Event()
+        self._teardown_started = threading.Event()
+        self._started = False
+        self.returncode: Optional[int] = None
+
+    # -------------------------------------------------------------- lifecycle
+
+    def start(self) -> "BackendSupervisor":
+        if self._started:
+            return self
+        self._started = True
+        if self._heartbeat_dir:
+            # run-scoped channel: a prior attempt's STALLED record in a
+            # reused dir must not reconstruct THIS run's clean rc as 117,
+            # and its stale records must not trip silence at t=0
+            hb.clear_channel(self._heartbeat_dir)
+        capture = bool(self.log_dir) or self._stream is not sys.stdout
+        if capture:
+            self._proc = self._popen(self.cmd, stdout=subprocess.PIPE,
+                                     stderr=subprocess.STDOUT, text=True)
+            threading.Thread(target=self._forward_output,
+                             name="dstpu-backend-out", daemon=True).start()
+        else:
+            self._proc = self._popen(self.cmd)
+        threading.Thread(target=self._monitor, name="dstpu-backend-monitor",
+                         daemon=True).start()
+        return self
+
+    def run(self) -> int:
+        return self.start().wait()
+
+    # ----------------------------------------------------- Popen-like facade
+
+    def poll(self) -> Optional[int]:
+        return self.returncode if self._done.is_set() else None
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        if not self._done.wait(timeout):
+            raise subprocess.TimeoutExpired(cmd="BackendSupervisor",
+                                            timeout=timeout)
+        return self.returncode
+
+    def terminate(self) -> None:
+        self._trigger_teardown("terminate() requested")
+
+    def kill(self) -> None:
+        self._teardown_started.set()
+        p = self._proc
+        if p is not None and p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
+
+    def _rank_host(self, rec: dict) -> Optional[str]:
+        """A record's host, falling back to the hostfile-ordered mapping
+        for ranks that never self-reported one (shared helper — see
+        heartbeat.rec_host)."""
+        return hb.rec_host(rec, self.rank_hosts)
+
+    def failed_hosts(self) -> List[str]:
+        """Blacklist feed: hosts whose ranks went heartbeat-silent or
+        stamped a STALLED terminal record."""
+        out = list(self._silent_hosts)
+        if self._heartbeat_dir:
+            for rec in hb.terminal_records(self._heartbeat_dir).values():
+                if rec.get("phase") == hb.PHASE_STALLED:
+                    host = self._rank_host(rec)
+                    if host and host not in out:
+                        out.append(host)
+        return out
+
+    # -------------------------------------------------------------- internals
+
+    def _log_path(self, key: str) -> str:
+        return os.path.join(self.log_dir, f"{key}.log")
+
+    def _forward_output(self) -> None:
+        """Mirror the scheduler's merged stream, demultiplexing per-rank
+        prefixes into per-key files when log persistence is on."""
+        logs = {}
+        try:
+            for line in self._proc.stdout:
+                try:
+                    self._stream.write(line)
+                    self._stream.flush()
+                except (ValueError, OSError):
+                    pass
+                if not self.log_dir:
+                    continue
+                key, payload = self.backend, line
+                if self.route_line is not None:
+                    routed = self.route_line(line)
+                    if routed is not None:
+                        key, payload = routed
+                log = logs.get(key)
+                if log is None:
+                    try:
+                        log = open(self._log_path(key), "w",
+                                   encoding="utf-8", errors="replace")
+                    except OSError as e:
+                        logger.warning("backend supervisor: cannot open "
+                                       "%s: %s", self._log_path(key), e)
+                        log = False      # do not retry every line
+                    logs[key] = log
+                if log:
+                    try:
+                        log.write(payload)
+                        log.flush()
+                    except (ValueError, OSError):
+                        try:
+                            log.close()
+                        except OSError:
+                            pass
+                        logs[key] = False
+        finally:
+            for log in logs.values():
+                if log:
+                    try:
+                        log.close()
+                    except OSError:
+                        pass
+
+    def _monitor(self) -> None:
+        while True:
+            rc = self._proc.poll()
+            if rc is not None:
+                break
+            if (self.heartbeat_monitor is not None
+                    and not self._teardown_started.is_set()):
+                silent = self.heartbeat_monitor.silent_ranks()
+                if silent:
+                    desc = ", ".join(
+                        f"rank {r.get('rank')}"
+                        + (f" ({r['host']})" if r.get("host") else "")
+                        for r in silent)
+                    self._hb_stall = desc
+                    self._silent_hosts = [
+                        h for h in (self._rank_host(r) for r in silent)
+                        if h]
+                    logger.error(
+                        "backend supervisor (%s): heartbeat silence — %s "
+                        "(timeout %.1fs); tearing the launch down via the "
+                        "scheduler kill path", self.backend, desc,
+                        self.heartbeat_monitor.timeout)
+                    self._trigger_teardown(f"heartbeat silence: {desc}")
+            if self._done.wait(self.heartbeat_poll):
+                return
+        self.returncode = self._reconstruct_rc(rc)
+        self._done.set()
+
+    def _trigger_teardown(self, reason: str) -> None:
+        if self._teardown_started.is_set():
+            return
+        self._teardown_started.set()
+        threading.Thread(target=self._do_teardown, args=(reason,),
+                         name="dstpu-backend-teardown", daemon=True).start()
+
+    def _do_teardown(self, reason: str) -> None:
+        """The scheduler's own kill path first (it reaches the REMOTE
+        ranks; signaling the local scheduler proc alone may orphan them),
+        then SIGTERM → grace → SIGKILL on the scheduler process itself."""
+        logger.warning("backend supervisor (%s): teardown (%s), grace %.1fs",
+                       self.backend, reason, self.grace_secs)
+        if self.kill_cmd:
+            try:
+                # bounded SHORT of grace_secs: the kill command is a
+                # scheduler CLI call that works in seconds or not at all,
+                # and it runs BEFORE the grace wait — an unbounded (or
+                # grace-sized) hang here would stretch total teardown to
+                # ~2x grace and blow past the elastic agent's
+                # teardown_grace budget, SIGKILLing mid-emergency-save
+                self._run_cmd(self.kill_cmd,
+                              timeout=max(1.0, min(self.grace_secs, 5.0)))
+            except (OSError, subprocess.SubprocessError) as e:
+                logger.warning("backend supervisor: kill command failed: %s",
+                               e)
+        p = self._proc
+        if p is None:
+            return
+        try:
+            p.terminate()
+        except OSError:
+            return
+        _grace_then_kill(p, self.grace_secs)
+
+    def _reconstruct_rc(self, scheduler_rc: int) -> int:
+        """The scheduler flattened the per-rank rcs; the workers' terminal
+        heartbeat records carry what actually happened. Stall evidence
+        (incl. a silence-triggered teardown) wins — a wedge is a counted
+        failure; then preemption; then the scheduler's own verdict."""
+        terminal = (hb.terminal_records(self._heartbeat_dir)
+                    if self._heartbeat_dir else {})
+        phases = {rec.get("phase") for rec in terminal.values()}
+        if self._hb_stall is not None or hb.PHASE_STALLED in phases:
+            return STALL_EXIT_CODE
+        if scheduler_rc == 0:
+            return 0
+        if scheduler_rc in (PREEMPTION_EXIT_CODE, STALL_EXIT_CODE):
+            return scheduler_rc       # the contract survived the backend
+        if hb.PHASE_PREEMPTED in phases:
+            return PREEMPTION_EXIT_CODE
+        return scheduler_rc

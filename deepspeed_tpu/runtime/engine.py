@@ -46,6 +46,7 @@ from ..utils.partitioning import build_tp_specs
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from ..testing import chaos
 from . import checkpointing as ckpt_lib
+from . import heartbeat as hb
 from .loss_scaler import LossScaler
 from .lr_schedules import LRScheduler, build_schedule
 from .state import TrainState
@@ -526,22 +527,45 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.micro_steps = 0
 
-        # stall watchdog (round-4; docs/RESILIENCE.md): heartbeat on every
-        # optimizer step; a gap beyond stall_timeout dumps all stacks and
+        # phase-aware watchdog + rank heartbeat channel (rounds 4+6;
+        # docs/RESILIENCE.md): the engine reports lifecycle phases
+        # (RESTORE -> COMPILE -> STEP -> SAVE), each with its own deadline;
+        # a gap beyond the current phase's deadline dumps all stacks and
         # exits STALL_EXIT_CODE so the supervisor can tear the world down.
-        # NOT started here: the clock arms at the FIRST completed step —
-        # XLA compile time (minutes at scale) must never read as a stall;
-        # a hang before step 1 is init_deadline's jurisdiction.
+        # The heartbeat writer (opt-in via DSTPU_HEARTBEAT_DIR, exported by
+        # dstpu --heartbeat-dir) mirrors every phase/step transition to a
+        # per-rank file so LAUNCHER-side monitors get liveness even for
+        # ranks whose ssh pipe (or scheduler) is silent.
+        self.heartbeat = hb.HeartbeatWriter.from_env(
+            rank=jax.process_index())
+        self._step_phase_reached = False
         self.watchdog = None
         wd = self.config.watchdog
-        if wd.stall_timeout > 0:
+        pre_step = {hb.PHASE_COMPILE: wd.compile_timeout,
+                    hb.PHASE_RESTORE: wd.restore_timeout,
+                    hb.PHASE_SAVE: wd.save_timeout}
+        if wd.stall_timeout > 0 or any(t > 0 for t in pre_step.values()):
             from .watchdog import StallWatchdog
             self.watchdog = StallWatchdog(
-                wd.stall_timeout,
-                poll_interval=wd.poll_interval or None)
-            log_dist(f"stall watchdog configured: timeout "
-                     f"{wd.stall_timeout}s (arms at the first step)",
-                     ranks=[0])
+                wd.stall_timeout or 0.0,
+                poll_interval=wd.poll_interval or None,
+                phase_timeouts=pre_step,
+                heartbeat=self.heartbeat,
+                phase=hb.PHASE_INIT)
+            if any(t > 0 for t in pre_step.values()):
+                # pre-step deadlines need the monitor BEFORE the first
+                # completed step — the round-4 blind spot (a compile or
+                # restore hang) is exactly what they bound. The INIT
+                # phase itself stays unbounded here (init_deadline's
+                # jurisdiction); the clock starts mattering at the first
+                # phase transition.
+                self.watchdog.start()
+            log_dist(f"watchdog configured: stall={wd.stall_timeout}s "
+                     f"compile={wd.compile_timeout}s "
+                     f"restore={wd.restore_timeout}s "
+                     f"save={wd.save_timeout}s", ranks=[0])
+        if self.heartbeat is not None:
+            self.heartbeat.write(hb.PHASE_INIT, 0, force=True)
 
         # progressive layer drop + eigenvalue (reference: engine hooks for
         # runtime/progressive_layer_drop.py + runtime/eigenvalue.py) ---------
@@ -953,6 +977,29 @@ class DeepSpeedEngine:
         self._rng, out = jax.random.split(self._rng)
         return out
 
+    # --- lifecycle phase reporting (watchdog deadlines + heartbeat file) ----
+
+    def _report_phase(self, phase: str) -> None:
+        """Move the watchdog clock into ``phase`` and mirror the
+        transition to the per-rank heartbeat file (phase transitions
+        always write; only same-phase repeats are throttled)."""
+        if self.watchdog is not None:
+            self.watchdog.start().enter_phase(phase, step=self.global_steps)
+        if self.heartbeat is not None:
+            self.heartbeat.write(phase, self.global_steps, force=True)
+
+    def _phase_scope(self, phase: str):
+        """Bracket a bounded lifecycle section (RESTORE/SAVE): the phase's
+        own deadline applies inside, and the previous phase resumes with a
+        fresh clock on exit."""
+        import contextlib
+        if self.heartbeat is not None:
+            self.heartbeat.write(phase, self.global_steps, force=True)
+        if self.watchdog is not None:
+            self.watchdog.start()
+            return self.watchdog.phase_scope(phase)
+        return contextlib.nullcontext()
+
     def train_batch(self, batch) -> Dict[str, Any]:
         """Run one full global batch (all gas microbatches) in one compiled step.
 
@@ -970,6 +1017,13 @@ class DeepSpeedEngine:
         chaos.failpoint("run.kill")
         chaos.failpoint("run.preempt")
         chaos.failpoint("run.hang")
+        if not self._step_phase_reached:
+            # the window from the FIRST train_batch entry to the first
+            # completed step is COMPILE (XLA compile + sharded-restore
+            # materialization) — bounded by watchdog.compile_timeout, a
+            # hang the round-4 step-armed clock could never see
+            self._report_phase(hb.PHASE_COMPILE)
+            chaos.failpoint("run.compile_hang")
         from ..parallel.mesh import BATCH_AXES
         if self.curriculum is not None:
             batch = self.curriculum(batch, self.global_steps)
@@ -1059,6 +1113,8 @@ class DeepSpeedEngine:
             # evaluation progress is liveness too: a long validation pass
             # between optimizer steps must not read as a training stall
             self.watchdog.beat()
+        if self.heartbeat is not None:
+            self.heartbeat.write(hb.PHASE_STEP, self.global_steps)
         return out
 
     # --- micro-batch API (reference forward/backward/step contract) ----------
@@ -1134,6 +1190,8 @@ class DeepSpeedEngine:
             # micro-API liveness: scoring loops (eval-mode forward, no
             # step()) must not read as a training stall
             self.watchdog.beat()
+        if self.heartbeat is not None:
+            self.heartbeat.write(hb.PHASE_STEP, self.global_steps)
         return loss
 
     __call__ = forward
@@ -1208,11 +1266,17 @@ class DeepSpeedEngine:
 
     def _after_step(self, metrics):  # graftlint: hotpath
         self.global_steps += 1
+        self._step_phase_reached = True
         if self.watchdog is not None:
             # step progress IS the liveness signal (dispatch completed; a
             # wedged collective never reaches this line). start() is
-            # idempotent — the first completed step arms the clock.
-            self.watchdog.start().beat()
+            # idempotent — the first completed step arms the clock, and
+            # entering STEP retires the COMPILE deadline.
+            self.watchdog.start().enter_phase(hb.PHASE_STEP,
+                                              step=self.global_steps)
+        if self.heartbeat is not None:
+            # throttled: same-phase records within min_interval are dropped
+            self.heartbeat.write(hb.PHASE_STEP, self.global_steps)
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self._last_metrics = metrics
@@ -1476,13 +1540,11 @@ class DeepSpeedEngine:
                               tag: Optional[str],
                               client_state: Optional[dict] = None):
         """Shared body of the periodic save and the preemption-time
-        emergency save (which forces a synchronous engine). Runs with the
-        stall watchdog suspended: save time is IO-bound and legitimately
-        unbounded by step time."""
-        import contextlib
-        suspend = (self.watchdog.suspended() if self.watchdog is not None
-                   else contextlib.nullcontext())
-        with suspend:
+        emergency save (which forces a synchronous engine). Runs in the
+        SAVE phase: save time is IO-bound and legitimately unbounded by
+        step time (save_timeout=0, the default, keeps it unbounded; a
+        positive save_timeout bounds a save wedged on dead storage)."""
+        with self._phase_scope(hb.PHASE_SAVE):
             tag = tag or f"global_step{self.global_steps}"
             client_state = dict(client_state or {})
             client_state["global_steps"] = self.global_steps
@@ -1512,6 +1574,13 @@ class DeepSpeedEngine:
         interpreter teardown) and stop the stall watchdog."""
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self.heartbeat is not None:
+            # terminal record: launcher-side monitors must read a closed
+            # engine as "concluded", not "went silent". Bounded lock: a
+            # refresher wedged on dead storage must not hang the clean
+            # shutdown it is merely annotating
+            self.heartbeat.write(hb.PHASE_EXIT, self.global_steps,
+                                 force=True, lock_timeout=2.0)
         if hasattr(self, "checkpoint_engine"):
             return self.checkpoint_engine.close()
         return True
@@ -1582,11 +1651,24 @@ class DeepSpeedEngine:
                 # watchdog must not shoot us mid-emergency-save (never
                 # resumed: this process only leaves via exit_fn)
                 self.watchdog.suspend()
+            # the grace timer arms BEFORE any other work: everything past
+            # this point (the heartbeat stamp, the save itself) can block
+            # on dead storage, and only the timer guarantees the rc-114
+            # exit still happens
             watchdog = threading.Timer(
                 max(grace_secs, 0.1),
                 lambda: exit_fn(PREEMPTION_EXIT_CODE))
             watchdog.daemon = True
             watchdog.start()
+            if self.heartbeat is not None:
+                # terminal evidence: scheduler backends flatten rc 114, so
+                # the PREEMPTED record is how BackendSupervisor restores it.
+                # Bounded lock: the signal may have landed INSIDE a
+                # step-path heartbeat.write on this same thread — a
+                # blocking re-acquire of that non-reentrant lock would
+                # deadlock the handler
+                self.heartbeat.write(hb.PHASE_PREEMPTED, self.global_steps,
+                                     force=True, lock_timeout=2.0)
             log_dist(f"preemption (signal {signum}): emergency checkpoint "
                      f"to {save_dir} within {grace_secs}s", ranks=[0])
             try:
@@ -1605,6 +1687,16 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_module_only: bool = False):
+        # RESTORE phase: a restore wedged on dead storage or a hung
+        # sharded materialization is bounded by watchdog.restore_timeout
+        # (and visible as RESTORE in the heartbeat channel) instead of
+        # hanging the rank silently before its first step
+        with self._phase_scope(hb.PHASE_RESTORE):
+            return self._load_checkpoint_impl(load_dir, tag,
+                                              load_module_only)
+
+    def _load_checkpoint_impl(self, load_dir: str, tag: Optional[str],
+                              load_module_only: bool):
         if self.offload is not None:
             return self._load_checkpoint_offload(load_dir, tag, load_module_only)
         loaded, client_state = ckpt_lib.load_checkpoint(

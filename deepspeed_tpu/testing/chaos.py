@@ -31,6 +31,7 @@ Spec syntax (env var or ``arm()``)::
     DSTPU_CHAOS="run.hang:hang"               # block forever (wedged rank)
     DSTPU_CHAOS="ckpt.write:sleep:ms=300"     # delay, then continue
     DSTPU_CHAOS="run.preempt:sigterm"         # SIGTERM self (preemption)
+    DSTPU_CHAOS="host.blackhole:raise:match=w2"  # keyed: only host w2
 
 Run-supervision modes (round-4): ``hang`` blocks the calling thread
 forever — the userspace approximation of a wedged collective, what the
@@ -81,10 +82,11 @@ _MODES = ("raise", "kill", "hang", "sleep", "sigterm")
 
 class _FailPoint:
     __slots__ = ("name", "mode", "skip", "times", "hits", "fired", "code",
-                 "ms")
+                 "ms", "match")
 
     def __init__(self, name: str, mode: str, skip: int = 0, times: int = 1,
-                 code: Optional[int] = None, ms: int = 0):
+                 code: Optional[int] = None, ms: int = 0,
+                 match: Optional[str] = None):
         if mode not in _MODES:
             raise ValueError(f"chaos mode must be one of {_MODES}, "
                              f"got {mode!r}")
@@ -94,6 +96,7 @@ class _FailPoint:
         self.times = times
         self.code = KILL_EXIT_CODE if code is None else code
         self.ms = ms        # sleep mode: delay in milliseconds
+        self.match = match  # keyed failpoints: fire only when key == match
         self.hits = 0       # total traversals of this failpoint
         self.fired = 0      # times it actually failed
 
@@ -113,6 +116,9 @@ def parse_spec(spec: str) -> Dict[str, _FailPoint]:
         kwargs = {}
         for f in fields[2:]:
             k, _, v = f.partition("=")
+            if k == "match":            # keyed failpoints take a STRING
+                kwargs[k] = v           # (e.g. match=worker-2 on
+                continue                # host.blackhole)
             if k not in ("skip", "times", "code", "ms"):
                 raise ValueError(f"bad chaos spec option {f!r} in {part!r}")
             kwargs[k] = int(v)
@@ -132,11 +138,14 @@ def _load_env_once() -> None:
 
 
 def arm(name: str, mode: str = "raise", skip: int = 0, times: int = 1,
-        code: Optional[int] = None, ms: int = 0) -> None:
-    """Programmatically arm a failpoint (in-process tests)."""
+        code: Optional[int] = None, ms: int = 0,
+        match: Optional[str] = None) -> None:
+    """Programmatically arm a failpoint (in-process tests). ``match``
+    restricts a KEYED failpoint to one key — e.g. ``host.blackhole``
+    with ``match="worker-2"`` only fires for that host's dispatch."""
     with _lock:
         _armed[name] = _FailPoint(name, mode, skip=skip, times=times,
-                                  code=code, ms=ms)
+                                  code=code, ms=ms, match=match)
 
 
 def disarm(name: Optional[str] = None) -> None:
@@ -175,7 +184,7 @@ def armed() -> List[str]:
         return sorted(_armed)
 
 
-def failpoint(name: str) -> None:
+def failpoint(name: str, key: Optional[str] = None) -> None:
     """Declare a failpoint. No-op unless a test armed ``name``.
 
     ``raise`` mode raises :class:`ChaosError` (an IOError). ``kill`` mode
@@ -184,6 +193,11 @@ def failpoint(name: str) -> None:
     machine dying. ``hang`` blocks this thread forever (a wedged rank);
     ``sleep`` delays ``ms`` milliseconds then continues; ``sigterm``
     raises SIGTERM in this process (drives the preemption handler).
+
+    ``key`` marks a KEYED site (the dispatching host, a rank id): a spec
+    armed with ``match=K`` fires — and counts hits — only when
+    ``key == K``, so one armed ``host.blackhole`` can take out a single
+    host of a multi-host world.
     """
     if not _env_loaded:
         _load_env_once()
@@ -192,6 +206,8 @@ def failpoint(name: str) -> None:
     with _lock:
         fp = _armed.get(name)
         if fp is None:
+            return
+        if fp.match is not None and key != fp.match:
             return
         fp.hits += 1
         if fp.hits <= fp.skip or fp.fired >= fp.times:

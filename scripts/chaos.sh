@@ -1,10 +1,13 @@
 #!/usr/bin/env bash
 # Fault-injection suite, standalone: crash a real checkpoint save at every
 # named failpoint (plus kill-mid-write and SIGTERM subprocess tests), prove
-# resume, and drive the round-4 run-supervision matrix — fail-fast teardown,
-# stall watchdog stack-dump/rc, connect retries, rc-114 end-to-end through
-# dstpu --elastic, and the per-rank failpoint in the REAL 2-process sharded
-# save. Includes the `slow`-marked engine-in-child tests tier-1 skips.
+# resume, and drive the run-supervision matrices — fail-fast teardown,
+# phase-aware watchdog (compile-hang stack-dump/rc-117), heartbeat-loss and
+# heartbeat-silence detection (RunSupervisor + BackendSupervisor incl. the
+# backend kill path), blackholed-host blacklisting with degraded-world
+# elastic resume, connect retries, rc-114 end-to-end through dstpu
+# --elastic, and the per-rank failpoint in the REAL 2-process sharded save.
+# Includes the `slow`-marked engine-in-child tests tier-1 skips.
 # See docs/RESILIENCE.md for the failpoint catalog and exit-code contract.
 #
 #   scripts/chaos.sh              # full crash-safety + supervision suite
@@ -19,5 +22,8 @@ unset DSTPU_CHAOS
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py \
     tests/test_supervisor.py \
+    tests/test_heartbeat.py \
+    tests/test_multinode_runner.py \
+    tests/test_launcher_elastic.py \
     "tests/test_multiprocess.py::test_two_process_sharded_save_with_per_rank_failpoint" \
     -q -p no:cacheprovider "$@"

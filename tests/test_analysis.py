@@ -682,6 +682,83 @@ def test_tpu011_positive_lax_collective_in_guard(tmp_path):
     assert "TPU011" in codes(findings)
 
 
+def test_tpu011_positive_boolean_local_rank_guard(tmp_path):
+    """Round-6 depth: the guard hides behind a boolean local
+    (``is_master = rank == 0``) — the spelling the name-match missed."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax.experimental import multihost_utils
+
+        def publish(tag, rank):
+            is_master = rank == 0
+            if is_master:
+                multihost_utils.sync_global_devices("publish-" + tag)
+    """)
+    (f,) = [f for f in findings if f.rule == "TPU011"]
+    assert f.symbol == "publish"
+
+
+def test_tpu011_positive_boolean_local_from_probe_call(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax.experimental import multihost_utils
+
+        def save():
+            lead = jax.process_index() == 0
+            if lead:
+                multihost_utils.sync_global_devices("save")
+    """)
+    assert "TPU011" in codes(findings)
+
+
+def test_tpu011_positive_boolean_local_chain_and_early_exit(tmp_path):
+    """Alias chains resolve to a fixpoint, and a boolean-local guard on
+    an early return ahead of a collective is the hang shape too."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax.experimental import multihost_utils
+
+        def save(rank):
+            is_master = rank == 0
+            should_write = is_master
+            if not should_write:
+                return None
+            multihost_utils.sync_global_devices("save")
+    """)
+    (f,) = [f for f in findings if f.rule == "TPU011"]
+    assert f.symbol == "save"
+
+
+def test_tpu011_negative_boolean_local_from_world_size(tmp_path):
+    """World-size booleans evaluate identically on every rank — the
+    sanctioned ``comm.barrier`` idiom must survive the new depth."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax.experimental import multihost_utils
+
+        def barrier(name):
+            is_dist = jax.process_count() > 1
+            if is_dist:
+                multihost_utils.sync_global_devices(name)
+    """)
+    assert "TPU011" not in codes(findings, gating_only=False)
+
+
+def test_tpu011_negative_rank_derived_value_is_not_a_guard(tmp_path):
+    """A rank-derived VALUE (an f-string, arithmetic) is not a
+    rank-divergent predicate — taint without boolean-ness must not flag."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax.experimental import multihost_utils
+
+        def save(rank):
+            prefix = f"rank-{rank}"
+            if prefix:
+                multihost_utils.sync_global_devices("all-ranks-save")
+    """)
+    assert "TPU011" not in codes(findings, gating_only=False)
+
+
 def test_tpu011_negative_guard_without_collective(tmp_path):
     """The SANCTIONED shape (checkpointing.py): rank-0-only host work,
     then an UNGUARDED barrier every rank reaches."""

@@ -190,6 +190,7 @@ def test_uniform_window_mistral_rides_kernel_under_scan(flash_spy):
     assert kw["window"] == 32
 
 
+@pytest.mark.slow
 def test_masked_bert_trains_through_kernel(flash_spy):
     """fwd+bwd: grads of a masked encoder step flow through the kernel's
     custom VJP and match the reference-impl grads."""
@@ -223,6 +224,7 @@ def test_masked_bert_trains_through_kernel(flash_spy):
                                    err_msg=str(path_f))
 
 
+@pytest.mark.slow
 def test_prefill_rides_flash_kernel(flash_spy):
     """Generation prefill (empty cache) runs the flash kernel and matches
     the jnp cache path token-for-token."""

@@ -126,6 +126,7 @@ def _lm_batch(i):
         0, 1024, (8, 32))}
 
 
+@pytest.mark.slow
 def test_cross_topology_roundtrip(tmp_path):
     require_devices(8)
     """Save under pure dp=8, restore under tp=2 x sp=2 x dp=2: the loaded

@@ -129,6 +129,7 @@ def test_head_pruning_zeroes_head_blocks():
     assert sum(head_zero) == 2
 
 
+@pytest.mark.slow
 def test_layer_reduction_student_init():
     from deepspeed_tpu.models import build_model
     model, cfg = build_model("gpt2-tiny", num_layers=4, dtype=jnp.float32,

@@ -103,6 +103,7 @@ def test_apply_seqlen_curriculum_truncates():
     assert out["scalar"].shape == (4,)
 
 
+@pytest.mark.slow
 def test_engine_seqlen_curriculum_ramps(tmp_path):
     """Training with a seqlen curriculum: the compiled step consumes ramping
     sequence lengths and the loss improves (reference 'Done' criterion)."""
@@ -137,6 +138,7 @@ def test_engine_seqlen_curriculum_ramps(tmp_path):
     assert np.mean(full[-3:]) < full[0]
 
 
+@pytest.mark.slow
 def test_random_ltd_model_trains():
     """Middle layers process a random token subset; grads stay finite and
     training proceeds (reference: data_routing/random_ltd)."""

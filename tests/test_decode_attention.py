@@ -98,6 +98,7 @@ def test_decode_fallback_guards():
                          jnp.asarray(10), interpret=True)
 
 
+@pytest.mark.slow
 def test_generation_uses_jnp_path_on_cpu_and_matches():
     """On the CPU backend the decode path takes the jnp route; this pins the
     restructured carry-cache scan (in-place KV update) to the same numerics

@@ -60,6 +60,7 @@ def test_pld_schedule_math():
     assert pld.get_state()["progressive_layer_drop"]
 
 
+@pytest.mark.slow
 def test_pld_model_trains_and_drops():
     """PLD engine run: theta ramps down, layers drop stochastically in
     training, eval is deterministic full-depth."""
@@ -180,6 +181,7 @@ def test_moq_eigenvalue_rescale():
     engine.train_batch(random_batch(8))               # still trains
 
 
+@pytest.mark.slow
 def test_profile_trace(tmp_path):
     """engine.profile_trace captures an xplane trace (SURVEY §5 tracing)."""
     import glob

@@ -1,0 +1,370 @@
+"""Rank heartbeat channel (round 6): writer atomicity/bounds/throttle,
+reader staleness + terminal evidence, HeartbeatMonitor silence detection,
+and the phase-aware watchdog (per-phase deadlines, the single rc-117
+path, heartbeat-stamped stalls).
+
+Everything here is plain-python and sub-second — the engine-in-child
+halves live in test_supervisor.py's slow matrix (scripts/chaos.sh).
+"""
+
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.launcher.supervisor import HeartbeatMonitor
+from deepspeed_tpu.runtime import heartbeat as hb
+from deepspeed_tpu.runtime import watchdog as wdg
+from deepspeed_tpu.runtime.watchdog import STALL_EXIT_CODE, StallWatchdog
+from deepspeed_tpu.testing import chaos
+
+
+def _writer(tmp_path, rank=0, host="w0", **kw):
+    kw.setdefault("refresh_interval", 0)     # tests control time
+    return hb.HeartbeatWriter(str(tmp_path), rank, host=host, **kw)
+
+
+# ------------------------------------------------------------------ writer
+
+def test_writer_record_schema_and_atomicity(tmp_path):
+    w = _writer(tmp_path, rank=3, host="worker-3")
+    assert w.write(hb.PHASE_INIT, 0, force=True)
+    records = hb.read_heartbeats(str(tmp_path))
+    rec = records[3]
+    assert rec["rank"] == 3 and rec["host"] == "worker-3"
+    assert rec["phase"] == hb.PHASE_INIT and rec["step"] == 0
+    assert rec["pid"] == os.getpid() and rec["ts"] > 0
+    # atomic publish: no torn tmp debris next to the rank file
+    assert os.listdir(str(tmp_path)) == ["rank3.hb"]
+
+
+def test_writer_bounds_record_count(tmp_path):
+    w = _writer(tmp_path, keep_records=5, min_interval=0.0)
+    for i in range(20):
+        w.write(hb.PHASE_STEP, i, force=True)
+    lines = open(w.path).read().splitlines()
+    assert len(lines) == 5
+    assert json.loads(lines[-1])["step"] == 19     # newest last
+
+
+def test_writer_throttles_same_phase_but_not_transitions(tmp_path):
+    t = [1000.0]
+    w = _writer(tmp_path, min_interval=10.0, clock=lambda: t[0])
+    assert w.write(hb.PHASE_STEP, 1)
+    t[0] += 1.0
+    assert not w.write(hb.PHASE_STEP, 2)           # same phase, too soon
+    assert w.write(hb.PHASE_SAVE, 2)               # transition writes
+    assert w.write(hb.PHASE_STEP, 2, force=True)   # force writes
+
+
+def test_hb_write_failpoint_silences_rank_without_crashing(tmp_path):
+    """Acceptance: heartbeat loss is harmless to the worker and looks
+    exactly like silence to the reader."""
+    w = _writer(tmp_path)
+    assert w.write(hb.PHASE_STEP, 5, force=True)
+    chaos.arm("hb.write", "raise", times=100)
+    assert not w.write(hb.PHASE_STEP, 6, force=True)    # swallowed
+    assert chaos.fired("hb.write")
+    rec = hb.read_heartbeats(str(tmp_path))[0]
+    assert rec["step"] == 5                             # last good record
+
+
+def test_refresher_restamps_without_appending(tmp_path):
+    w = hb.HeartbeatWriter(str(tmp_path), 0, host="w0",
+                           refresh_interval=0.05)
+    w.write(hb.PHASE_COMPILE, 0, force=True)
+    ts0 = hb.read_heartbeats(str(tmp_path))[0]["ts"]
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        rec = hb.read_heartbeats(str(tmp_path))[0]
+        if rec["ts"] > ts0:
+            break
+        time.sleep(0.02)
+    w.close()
+    assert rec["ts"] > ts0                       # liveness re-attested
+    assert rec["phase"] == hb.PHASE_COMPILE
+    assert len(open(w.path).read().splitlines()) == 1   # re-stamp, not append
+
+
+def test_write_lock_timeout_never_blocks_exit_paths(tmp_path):
+    """A refresher wedged in dead-storage I/O holds the writer lock
+    forever (open/fsync on a hard NFS mount blocks, it does not raise);
+    a terminal stamp from an exit path must time out and drop the
+    record, never block the exit behind diagnostics."""
+    w = _writer(tmp_path)
+    w.write(hb.PHASE_STEP, 1, force=True)
+    w._lock.acquire()                    # the wedged holder
+    try:
+        t0 = time.monotonic()
+        assert not w.write(hb.PHASE_STALLED, 1, force=True,
+                           lock_timeout=0.1)
+        assert not w.stamp_terminal(hb.PHASE_EXIT, lock_timeout=0.1)
+        assert time.monotonic() - t0 < 5
+        assert w._stop.is_set()          # terminal intent still recorded
+    finally:
+        w._lock.release()
+    # the last good record stands — silence carries the verdict now
+    assert hb.read_heartbeats(str(tmp_path))[0]["phase"] == hb.PHASE_STEP
+
+
+def test_steady_state_rewrites_skip_fsync(tmp_path, monkeypatch):
+    """Only phase transitions and terminal stamps pay the fsync: the
+    steady-state STEP re-writes hit the shared filesystem every second
+    from the training hot path, and fsync there is charged to step
+    time. An unsynced re-stamp lost to a host crash reads as silence —
+    what a dead host should read as."""
+    real_fsync = os.fsync
+    calls = []
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (calls.append(fd), real_fsync(fd))[1])
+    w = _writer(tmp_path, min_interval=0.0)
+    w.write(hb.PHASE_STEP, 1)                    # transition: durable
+    assert len(calls) == 1
+    w.write(hb.PHASE_STEP, 2)                    # steady state: cheap
+    w.write(hb.PHASE_STEP, 3, force=True)
+    assert len(calls) == 1
+    w.write(hb.PHASE_SAVE, 3)                    # transition: durable
+    assert len(calls) == 2
+    w.write(hb.PHASE_STALLED, 3, force=True)     # terminal: durable
+    assert len(calls) == 3
+
+
+def test_terminal_phase_stops_refresher(tmp_path):
+    w = hb.HeartbeatWriter(str(tmp_path), 0, refresh_interval=0.05)
+    w.write(hb.PHASE_STEP, 3, force=True)
+    w.write(hb.PHASE_EXIT, 3, force=True)
+    ts0 = hb.read_heartbeats(str(tmp_path))[0]["ts"]
+    time.sleep(0.25)
+    assert hb.read_heartbeats(str(tmp_path))[0]["ts"] == ts0
+
+
+# ------------------------------------------------------------------ readers
+
+def test_stale_ranks_ignores_terminal_records(tmp_path):
+    t = [1000.0]
+    live = _writer(tmp_path, rank=0, clock=lambda: t[0])
+    done = _writer(tmp_path, rank=1, clock=lambda: t[0])
+    live.write(hb.PHASE_STEP, 10, force=True)
+    done.write(hb.PHASE_PREEMPTED, 10, force=True)
+    stale = hb.stale_ranks(str(tmp_path), timeout=5.0, now=1100.0)
+    assert [r["rank"] for r in stale] == [0]     # terminal != silent
+
+
+def test_terminal_records_reads_last_word(tmp_path):
+    w = _writer(tmp_path, rank=2, host="w2")
+    w.write(hb.PHASE_STEP, 9, force=True)
+    w.write(hb.PHASE_STALLED, 9, force=True)
+    term = hb.terminal_records(str(tmp_path))
+    assert term[2]["phase"] == hb.PHASE_STALLED
+    assert term[2]["host"] == "w2"
+
+
+def test_monitor_flags_silent_and_missing_ranks(tmp_path):
+    t = [1000.0]
+    w = _writer(tmp_path, rank=0, host="w0", clock=lambda: t[0])
+    w.write(hb.PHASE_STEP, 4, force=True)
+    mon = HeartbeatMonitor(str(tmp_path), timeout=5.0,
+                           expected_ranks=[0, 1], clock=lambda: t[0])
+    assert mon.silent_ranks() == []              # everyone fresh enough
+    t[0] += 10.0                                 # both exceed the timeout
+    silent = mon.silent_ranks()
+    assert [r["rank"] for r in silent] == [0, 1]
+    assert silent[0]["host"] == "w0"
+    assert silent[1].get("missing") is True      # rank 1 never wrote
+
+
+def test_read_heartbeats_survives_garbage_files(tmp_path):
+    (tmp_path / "rank0.hb").write_text("not json\n")
+    (tmp_path / "rank1.hb").write_text("")
+    w = _writer(tmp_path, rank=2)
+    w.write(hb.PHASE_STEP, 1, force=True)
+    assert list(hb.read_heartbeats(str(tmp_path))) == [2]
+
+
+def test_clear_channel_scopes_dir_to_one_run(tmp_path):
+    """clear_channel removes every rank record (and stranded tmp) but
+    nothing else, and survives a directory that doesn't exist."""
+    w = _writer(tmp_path, rank=0)
+    w.write(hb.PHASE_STALLED, 7, force=True)
+    (tmp_path / "rank1.hb.tmp").write_text("torn")
+    (tmp_path / "notes.txt").write_text("keep me")
+    hb.clear_channel(str(tmp_path))
+    assert hb.read_heartbeats(str(tmp_path)) == {}
+    assert hb.terminal_records(str(tmp_path)) == {}
+    assert not (tmp_path / "rank1.hb.tmp").exists()
+    assert (tmp_path / "notes.txt").read_text() == "keep me"
+    hb.clear_channel(str(tmp_path / "missing"))  # no raise
+
+
+def test_writer_host_prefers_hostfile_vocabulary_env(tmp_path,
+                                                     monkeypatch):
+    """launch.py exports the operator's hostfile name for this rank;
+    records must carry IT (the blacklist compares against hostfile
+    members), not gethostname()'s FQDN/alias."""
+    monkeypatch.setenv(hb.HEARTBEAT_HOST_ENV, "worker-3")
+    w = _writer(tmp_path, rank=3, host=None)
+    w.write(hb.PHASE_STEP, 1, force=True)
+    assert hb.read_heartbeats(str(tmp_path))[3]["host"] == "worker-3"
+
+
+# -------------------------------------------------- phase-aware watchdog
+
+def test_watchdog_compile_deadline_fires_with_phase_in_message():
+    rcs, buf = [], io.StringIO()
+    wd = StallWatchdog(stall_timeout=0.0, poll_interval=0.02,
+                       exit_fn=rcs.append, stream=buf,
+                       phase_timeouts={hb.PHASE_COMPILE: 0.15}).start()
+    try:
+        wd.enter_phase(hb.PHASE_COMPILE)
+        deadline = time.monotonic() + 10
+        while not rcs and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        wd.stop()
+    assert rcs == [STALL_EXIT_CODE]
+    assert "COMPILE" in buf.getvalue()
+    assert "compile_timeout" in buf.getvalue()
+
+
+def test_watchdog_unbounded_phase_never_fires():
+    rcs = []
+    wd = StallWatchdog(stall_timeout=0.1, poll_interval=0.02,
+                       exit_fn=rcs.append, stream=io.StringIO(),
+                       phase_timeouts={hb.PHASE_COMPILE: 0.0}).start()
+    try:
+        wd.enter_phase(hb.PHASE_COMPILE)     # compile_timeout=0: unbounded
+        time.sleep(0.4)
+    finally:
+        wd.stop()
+    assert rcs == []
+
+
+def test_watchdog_phase_transition_resets_clock():
+    """Time spent in COMPILE must not be charged to the STEP deadline."""
+    rcs = []
+    wd = StallWatchdog(stall_timeout=0.3, poll_interval=0.02,
+                       exit_fn=rcs.append, stream=io.StringIO(),
+                       phase_timeouts={hb.PHASE_COMPILE: 10.0}).start()
+    try:
+        wd.enter_phase(hb.PHASE_COMPILE)
+        time.sleep(0.25)                      # would be most of 0.3s
+        wd.enter_phase(hb.PHASE_STEP)
+        time.sleep(0.2)                       # < stall_timeout from entry
+        assert rcs == []
+        wd.beat()
+    finally:
+        wd.stop()
+    assert rcs == []
+
+
+def test_watchdog_phase_scope_restores_previous_phase():
+    wd = StallWatchdog(stall_timeout=5.0, poll_interval=0.05,
+                       exit_fn=lambda rc: None, stream=io.StringIO())
+    wd.enter_phase(hb.PHASE_STEP)
+    with wd.phase_scope(hb.PHASE_SAVE):
+        assert wd.phase == hb.PHASE_SAVE
+    assert wd.phase == hb.PHASE_STEP
+
+
+def test_watchdog_save_deadline_bounds_wedged_save():
+    rcs, buf = [], io.StringIO()
+    wd = StallWatchdog(stall_timeout=0.0, poll_interval=0.02,
+                       exit_fn=rcs.append, stream=buf,
+                       phase_timeouts={hb.PHASE_SAVE: 0.15}).start()
+    try:
+        with wd.phase_scope(hb.PHASE_SAVE):
+            deadline = time.monotonic() + 10
+            while not rcs and time.monotonic() < deadline:
+                time.sleep(0.02)
+    finally:
+        wd.stop()
+    assert rcs == [STALL_EXIT_CODE]
+    assert "SAVE" in buf.getvalue()
+
+
+def test_watchdog_requires_some_positive_deadline():
+    with pytest.raises(ValueError):
+        StallWatchdog(stall_timeout=0.0,
+                      phase_timeouts={hb.PHASE_COMPILE: 0.0})
+
+
+def test_watchdog_fire_stamps_stalled_heartbeat(tmp_path):
+    w = _writer(tmp_path, rank=0, host="w0")
+    rcs = []
+    wd = StallWatchdog(stall_timeout=0.1, poll_interval=0.02,
+                       exit_fn=rcs.append, stream=io.StringIO(),
+                       heartbeat=w).start()
+    try:
+        wd.enter_phase(hb.PHASE_STEP, step=7)
+        deadline = time.monotonic() + 10
+        while not rcs and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        wd.stop()
+    assert rcs == [STALL_EXIT_CODE]
+    rec = hb.terminal_records(str(tmp_path))[0]
+    assert rec["phase"] == hb.PHASE_STALLED and rec["step"] == 7
+
+
+def test_watchdog_fire_exits_even_when_heartbeat_lock_is_wedged(
+        tmp_path, monkeypatch):
+    """The rc-117 exit is the one guarantee the watchdog makes: it must
+    hold even when the STALLED stamp can't be written because the writer
+    lock is held by a thread wedged in dead-storage I/O."""
+    monkeypatch.setattr(wdg, "_STAMP_LOCK_TIMEOUT", 0.1)
+    w = _writer(tmp_path)
+    w.write(hb.PHASE_COMPILE, 0, force=True)
+    rcs = []
+    w._lock.acquire()                    # the wedge
+    try:
+        t0 = time.monotonic()
+        assert wdg._fire(io.StringIO(), "wedged stamp", rcs.append,
+                         heartbeat=w, step=0)
+        assert time.monotonic() - t0 < 3
+    finally:
+        w._lock.release()
+    assert rcs == [STALL_EXIT_CODE]
+    # the stamp was dropped; the prior record stands and silence (or the
+    # scheduler rc) carries the verdict
+    assert hb.read_heartbeats(str(tmp_path))[0]["phase"] == hb.PHASE_COMPILE
+
+
+def test_single_rc117_path_suppresses_concurrent_double_fire():
+    """Satellite fix: two deadlines expiring together (init deadline vs
+    armed watchdog) must produce exactly ONE dump-and-exit."""
+    fired = []
+    gate = threading.Event()
+
+    def slow_exit(rc):
+        fired.append(rc)
+        gate.wait(2.0)       # hold the guarded section open
+
+    wds = [StallWatchdog(stall_timeout=0.05, poll_interval=0.01,
+                         exit_fn=slow_exit, stream=io.StringIO()).start()
+           for _ in range(2)]
+    try:
+        deadline = time.monotonic() + 10
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.3)      # the second deadline expires inside the hold
+        gate.set()
+        time.sleep(0.1)
+    finally:
+        gate.set()
+        for wd in wds:
+            wd.stop()
+    assert fired == [STALL_EXIT_CODE]
+
+
+def test_init_deadline_rides_the_watchdog_machinery():
+    """init_deadline is a one-phase watchdog now — same loop, same
+    guarded fire path, custom label preserved."""
+    rcs, buf = [], io.StringIO()
+    with wdg.init_deadline(0.1, what="rendezvous-probe",
+                           exit_fn=rcs.append, stream=buf):
+        time.sleep(0.4)
+    assert rcs == [STALL_EXIT_CODE]
+    assert "rendezvous-probe" in buf.getvalue()

@@ -29,6 +29,7 @@ def _ours_from(hf_model, ids, batch_extra=None):
     return np.asarray(model.apply({"params": params}, batch))
 
 
+@pytest.mark.slow
 def test_hf_gpt_neo_parity():
     """Alternating global/local attention + unscaled attn + unbiased qkv."""
     hf_cfg = transformers.GPTNeoConfig(

@@ -77,6 +77,7 @@ def test_generate_greedy_deterministic():
     np.testing.assert_array_equal(np.asarray(out1[:, :3]), np.asarray(prompt))
 
 
+@pytest.mark.slow
 def test_generate_greedy_matches_naive_loop():
     """Cached greedy decode == argmax over repeated full forwards."""
     model, cfg, params = _model_and_params(seed=3)
@@ -322,6 +323,7 @@ def test_generate_with_top_p_and_penalty_reproducible():
     assert not np.array_equal(np.asarray(g_plain), np.asarray(g_pen))
 
 
+@pytest.mark.slow
 def test_ragged_batched_prefill_matches_per_sample():
     """LEFT-padded ragged batch: each sample's greedy continuation equals
     its own unpadded single-sample generation (positions and masks are
@@ -519,6 +521,7 @@ def test_transformer_block_matches_torch_mirror():
     np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_unet_serves_through_inference_engine():
     """The assembled conditional UNet hosts in InferenceEngine like any
     module (the reference's generic_injection capability slot) and is

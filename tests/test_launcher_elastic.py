@@ -55,6 +55,7 @@ def test_openmpi_and_slurm_runner_cmds():
 
 # -- launcher end-to-end on localhost -----------------------------------------
 
+@pytest.mark.slow
 def test_launcher_end_to_end_localhost(tmp_path):
     """dstpu with a localhost hostfile + --launcher local actually runs the
     user script through the per-host bootstrap (launch.py)."""
@@ -121,6 +122,7 @@ def test_elastic_agent_restarts_on_crash(tmp_path):
     assert attempts.read_text() == "2"
 
 
+@pytest.mark.slow
 def test_elastic_agent_preemption_rc_not_counted(tmp_path):
     """A worker exiting with PREEMPTION_EXIT_CODE (what the engine's
     SIGTERM handler does after its emergency save) is a resume: relaunch
@@ -148,6 +150,7 @@ def test_elastic_agent_preemption_rc_not_counted(tmp_path):
     assert attempts.read_text() == "3"
 
 
+@pytest.mark.slow
 def test_elastic_agent_tolerates_transient_hostfile_states(tmp_path):
     """An atomic rewrite of the hostfile mid-poll (empty read, brief
     unlink, identical rewrite) must NOT look like a membership change."""
@@ -182,6 +185,185 @@ def test_elastic_agent_tolerates_transient_hostfile_states(tmp_path):
     assert len(launches) == 1
 
 
+# -- degraded-world elastic resume (round 6) ----------------------------------
+
+class _FakeRun:
+    """Popen-facade stub with supervisor-style failure attribution."""
+
+    def __init__(self, rc, failed=()):
+        self._rc = rc
+        self._failed = list(failed)
+
+    def poll(self):
+        return self._rc
+
+    def wait(self, timeout=None):
+        return self._rc
+
+    def terminate(self):
+        pass
+
+    kill = terminate
+
+    def failed_hosts(self):
+        return list(self._failed)
+
+
+def test_agent_blacklists_failing_host_and_reforms_smaller_world(tmp_path):
+    """Acceptance: a host implicated in repeated counted failures is
+    quarantined; the agent relaunches a SMALLER world from the survivors
+    and publishes it to the active hostfile."""
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("w0 slots=2\nw1 slots=1\n")
+    active = tmp_path / "hostfile.active"
+    worlds = []
+
+    def launch(members):
+        worlds.append(list(members))
+        if "w1" in members:
+            return _FakeRun(9, failed=["w1"])     # w1 crashes the world
+        return _FakeRun(0)
+
+    agent = DSElasticAgent(launch, str(hostfile), max_restarts=5,
+                           check_interval=0.02, blacklist_after=2,
+                           active_hostfile=str(active))
+    assert agent.run() == 0
+    # two strikes to quarantine, then the degraded world succeeds
+    assert worlds == [["w0", "w1"], ["w0", "w1"], ["w0"]]
+    assert agent.blacklisted == {"w1"}
+    assert agent.strikes["w1"] == 2
+    assert agent.restarts == 2
+    assert active.read_text() == "w0 slots=2\n"   # operator-visible world
+
+
+def test_agent_blacklist_respects_min_nodes_by_parole(tmp_path):
+    """Quarantine must not starve the pod below --min-nodes: with every
+    survivor needed, the offender is paroled back instead of the agent
+    waiting forever on an impossible world."""
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("w0 slots=1\nw1 slots=1\n")
+    worlds = []
+
+    def launch(members):
+        worlds.append(list(members))
+        if len(worlds) < 3:
+            return _FakeRun(9, failed=["w1"])
+        return _FakeRun(0)
+
+    agent = DSElasticAgent(launch, str(hostfile), max_restarts=5,
+                           min_nodes=2, check_interval=0.02,
+                           blacklist_after=1)
+    assert agent.run() == 0
+    # w1 is struck and quarantined, but min_nodes=2 paroles it right back
+    assert all(w == ["w0", "w1"] for w in worlds)
+    assert agent.blacklisted == set()
+
+
+def test_failure_evidence_indexes_launched_world_not_members(tmp_path):
+    """launch_fn may narrow the agent's confirmed membership further
+    (--include/--exclude/--num_nodes): rank->host recovery for a record
+    with an out-of-vocabulary self-reported host must index the world
+    ranks were ACTUALLY assigned over (proc.rank_hosts), or the strike
+    lands on an innocent filtered-out neighbor."""
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    from deepspeed_tpu.runtime import heartbeat as hb
+    hb_dir = tmp_path / "hb"
+    # rank 0 of the LAUNCHED world (w2 only — w1 was filtered out) died
+    # stalled, self-reporting a name the hostfile never uses
+    w = hb.HeartbeatWriter(str(hb_dir), 0, host="w2.internal.example",
+                           refresh_interval=0)
+    w.write(hb.PHASE_STALLED, 7, force=True)
+    agent = DSElasticAgent(lambda m: None, str(tmp_path / "hostfile"),
+                           heartbeat_dir=str(hb_dir))
+
+    class Proc:
+        rank_hosts = ["w2"]              # the narrowed launched world
+
+    assert agent._failure_evidence(Proc(), ["w1", "w2"]) == ["w2"]
+    # without rank_hosts the fallback degrades to the members list
+    assert agent._failure_evidence(object(), ["w2"]) == ["w2"]
+
+
+def test_run_elastic_forwards_heartbeat_knobs(tmp_path, monkeypatch):
+    """--heartbeat-timeout must reach the agent: its lag-based silence
+    evidence is gated on it, and the 0.0 default silently disables the
+    documented path."""
+    from deepspeed_tpu.elasticity import elastic_agent as ea
+    from deepspeed_tpu.launcher import runner
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("localhost slots=1\n")
+    seen = {}
+
+    class FakeAgent:
+        def __init__(self, launch_fn, hostfile, **kw):
+            seen.update(kw)
+
+        def run(self):
+            return 0
+
+    monkeypatch.setattr(ea, "DSElasticAgent", FakeAgent)
+    args = types.SimpleNamespace(
+        hostfile=str(hostfile), max_restarts=3, min_nodes=1,
+        check_interval=0.1, grace_secs=1.0,
+        heartbeat_dir=str(tmp_path / "hb"), heartbeat_timeout=7.5)
+    assert runner.run_elastic(args) == 0
+    assert seen["heartbeat_dir"] == str(tmp_path / "hb")
+    assert seen["heartbeat_timeout"] == 7.5
+
+
+@pytest.mark.slow
+def test_agent_blacklists_blackholed_host_via_real_supervisor(tmp_path):
+    """End to end through RunSupervisor + keyed chaos: a blackholed host
+    fails every dispatch, is quarantined after one strike, and the
+    degraded relaunch picks up the prior run's on-disk progress."""
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    from deepspeed_tpu.launcher.supervisor import RankSpec, RunSupervisor
+    from deepspeed_tpu.testing import chaos
+    chaos.arm("host.blackhole", "raise", times=100, match="w1")
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("w0 slots=1\nw1 slots=1\n")
+    progress = tmp_path / "progress"
+    worlds = []
+
+    def launch(members):
+        worlds.append(list(members))
+        if len(worlds) == 1:
+            # w0 records progress, then idles in killable slices; w1's
+            # blackholed dispatch keeps retrying for ~2s — long past the
+            # write — before its exhaustion fails the world and the
+            # teardown reaps w0
+            code = (f"import time\n"
+                    f"open({str(progress)!r}, 'w').write('ckpt')\n"
+                    "for _ in range(600):\n"
+                    "    time.sleep(0.05)\n")
+            specs = [RankSpec("w0", [sys.executable, "-c", code]),
+                     RankSpec("w1", ["true"], remote=True)]
+            return RunSupervisor(specs, grace_secs=0.5, connect_retries=6,
+                                 connect_backoff=0.15,
+                                 connect_backoff_max=0.15).start()
+        # the degraded relaunch: w0 proves it sees the prior run's marker
+        # (rc 3, not a hang, if the first run was torn down before writing)
+        code = (f"import os, sys\n"
+                f"sys.exit(0 if os.path.exists({str(progress)!r}) else 3)\n")
+        specs = [RankSpec("w0", [sys.executable, "-c", code])]
+        return RunSupervisor(specs, grace_secs=0.5, connect_retries=0,
+                             connect_backoff=0.01).start()
+
+    agent = DSElasticAgent(launch, str(hostfile), max_restarts=3,
+                           check_interval=0.05, blacklist_after=1)
+    try:
+        assert agent.run() == 0
+    finally:
+        chaos.disarm()
+    assert worlds == [["w0", "w1"], ["w0"]]
+    assert agent.blacklisted == {"w1"}
+    assert agent.restarts == 1
+    assert progress.read_text() == "ckpt"         # resumed, not restarted
+
+
+@pytest.mark.slow
 def test_elastic_agent_restarts_on_membership_change(tmp_path):
     from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
     hostfile = tmp_path / "hostfile"

@@ -76,6 +76,7 @@ def test_tp_rules_cover_params():
     assert len(matched) >= 6
 
 
+@pytest.mark.slow
 def test_tp_sharded_engine_matches_unsharded():
     """2-way TP x 2-way DP on the 8-dev CPU mesh == single-device numerics."""
     kw = dict(hidden_size=64, num_layers=2, num_heads=4, vocab_size=256,
@@ -104,6 +105,7 @@ def test_tp_sharded_engine_matches_unsharded():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fused_loss_matches_unfused():
     """fused_loss=True returns the same scalar + grads as logits->causal_lm_loss,
     including ignore_index=-100 masking, at a chunk size that forces padding."""
@@ -134,6 +136,7 @@ def test_fused_loss_matches_unfused():
     assert abs(float(l1m - l2m)) < 1e-5
 
 
+@pytest.mark.slow
 def test_remat_policies_agree():
     """dots/full remat and no remat give identical losses AND gradients
     (remat only changes what is saved for backward, so grads are where a

@@ -124,6 +124,7 @@ def test_moe_transformer_trains():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_moe_with_tp_composes():
     """MoE under tensor parallelism: in SPMD, TP-replicated tokens gate
     identically on every model-rank (same logits, same rng), so there are no

@@ -1,0 +1,309 @@
+"""multinode_runner command construction + BackendSupervisor (round 6).
+
+Previously untested surface: the exact argv each scheduler backend gets
+(pdsh -S/-w host list, slurm node-name handling, mvapich env injection),
+plus the round-6 supervision deltas — kill paths, per-rank output
+routing, and the BackendSupervisor's heartbeat-driven teardown and rc
+reconstruction over a fake scheduler process.
+"""
+
+import io
+import os
+import shlex
+import sys
+import time
+import types
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import PREEMPTION_EXIT_CODE
+from deepspeed_tpu.launcher.multinode_runner import (MVAPICHRunner,
+                                                     OpenMPIRunner,
+                                                     PDSHRunner, SlurmRunner,
+                                                     build_runner)
+from deepspeed_tpu.launcher.supervisor import BackendSupervisor
+from deepspeed_tpu.runtime import heartbeat as hb
+from deepspeed_tpu.runtime.watchdog import STALL_EXIT_CODE
+
+PY = sys.executable
+
+
+def _args(**kw):
+    ns = types.SimpleNamespace(user_script="train.py", user_args=["--x", "1"],
+                               hostfile="/job/hostfile", include="")
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+# --------------------------------------------------- command construction
+
+def test_pdsh_cmd_fanout_flags_and_host_list():
+    r = PDSHRunner(_args())
+    r.add_export("XLA_FLAGS", "--flag=1")
+    cmd = r.get_cmd({"DSTPU_COORDINATOR": "w1"},
+                    {"w1": [0], "w2": [0], "w3": [0]})
+    assert cmd[0] == "pdsh"
+    assert "-S" in cmd                     # propagate the LARGEST rank rc
+    assert cmd[cmd.index("-w") + 1] == "w1,w2,w3"
+    joined = " ".join(cmd)
+    assert f"export XLA_FLAGS={shlex.quote('--flag=1')}" in joined
+    assert "cd " in joined                 # remote shells land in the cwd
+    assert "--node_rank=-1" in joined      # rank autodetected per host
+    assert cmd[-3:] == ["train.py", "--x", "1"]
+
+
+def test_slurm_cmd_strips_slot_parts_from_nodelist():
+    """The include syntax's ':slot' parts are not valid slurm node names
+    — the nodelist must carry BARE hostnames (what the filtered pool's
+    keys already are)."""
+    from deepspeed_tpu.launcher.runner import parse_inclusion_exclusion
+    pool = {"w1": 4, "w2": 4, "w3": 4}
+    active = parse_inclusion_exclusion(pool, include_str="w1:0,2@w3")
+    cmd = SlurmRunner(_args()).get_cmd({"E": "v"}, active)
+    nodelist = cmd[cmd.index("--nodelist") + 1]
+    assert nodelist == "w1,w3"
+    assert ":" not in nodelist
+    assert "--ntasks-per-node=1" in cmd
+    assert "--label" in cmd                # per-rank output attribution
+    assert any(c.startswith("--export=ALL,") and "E=v" in c for c in cmd)
+
+
+def test_openmpi_cmd_one_rank_per_node_and_env_x_flags():
+    cmd = OpenMPIRunner(_args(hostfile="/tmp/hf")).get_cmd(
+        {"E": "v"}, {"a": [0], "b": [0]})
+    assert cmd[:3] == ["mpirun", "-n", "2"]
+    assert cmd[cmd.index("--hostfile") + 1] == "/tmp/hf"
+    assert cmd[cmd.index("--map-by") + 1] == "ppr:1:node"
+    assert "-x" in cmd and "E=v" in cmd
+
+
+def test_mvapich_env_detection_and_injection(monkeypatch):
+    """mpirun_rsh takes bare K=V argv (no -x): the MV2 defaults are
+    injected when absent, never clobbering explicit settings."""
+    r = MVAPICHRunner(_args(hostfile="/tmp/hf"))
+    cmd = r.get_cmd({"E": "v"}, {"a": [0], "b": [0]})
+    assert cmd[:3] == ["mpirun_rsh", "-np", "2"]
+    assert cmd[cmd.index("-hostfile") + 1] == "/tmp/hf"
+    assert "MV2_SMP_USE_CMA=0" in cmd and "MV2_DEBUG_SHOW_BACKTRACE=1" in cmd
+    assert "E=v" in cmd
+    # explicit env beats the injected default
+    cmd = r.get_cmd({"MV2_SMP_USE_CMA": "1"}, {"a": [0]})
+    assert "MV2_SMP_USE_CMA=1" in cmd and "MV2_SMP_USE_CMA=0" not in cmd
+    # backend detection probes for mpirun_rsh, not mpirun
+    probed = []
+    monkeypatch.setattr("shutil.which",
+                        lambda name: probed.append(name) or None)
+    assert not r.backend_exists()
+    assert probed == ["mpirun_rsh"]
+
+
+def test_build_runner_rejects_unknown_backend():
+    with pytest.raises(ValueError, match="unknown launcher"):
+        build_runner("nope", _args())
+
+
+# ------------------------------------------------------------- kill paths
+
+def test_pdsh_kill_cmd_targets_active_hosts():
+    cmd = PDSHRunner(_args()).get_kill_cmd({}, {"w1": [0], "w2": [0]})
+    assert cmd[0] == "pdsh"
+    assert cmd[cmd.index("-w") + 1] == "w1,w2"
+    assert any("pkill" in c and "deepspeed_tpu.launcher.launch" in c
+               for c in cmd)
+
+
+def test_slurm_kill_cmd_is_scancel_of_the_allocation(monkeypatch):
+    r = SlurmRunner(_args())
+    monkeypatch.delenv("SLURM_JOB_ID", raising=False)
+    assert r.get_kill_cmd({}, {"a": [0]}) is None      # no allocation
+    assert r.get_kill_cmd({"SLURM_JOB_ID": "1234"}, {"a": [0]}) == \
+        ["scancel", "--signal=TERM", "1234"]
+    monkeypatch.setenv("SLURM_JOB_ID", "77")
+    assert r.get_kill_cmd({}, {"a": [0]}) == ["scancel", "--signal=TERM",
+                                              "77"]
+
+
+def test_openmpi_has_no_separate_kill_path():
+    # mpirun forwards SIGTERM to its children itself
+    assert OpenMPIRunner(_args()).get_kill_cmd({}, {"a": [0]}) is None
+
+
+# ---------------------------------------------------------- output routing
+
+def test_route_line_per_backend():
+    assert PDSHRunner(_args()).route_line("w2: hello\n") == ("w2", "hello\n")
+    assert PDSHRunner(_args()).route_line("no prefix here\n") is None
+    assert SlurmRunner(_args()).route_line("3: payload\n") == \
+        ("rank3", "payload\n")
+    assert SlurmRunner(_args()).route_line("w2: named\n") is None
+    assert OpenMPIRunner(_args()).route_line("anything\n") is None
+
+
+# ------------------------------------------------------- BackendSupervisor
+
+def test_backend_supervisor_clean_run_routes_logs(tmp_path):
+    """A pdsh-style merged stream demultiplexes into per-host files and
+    still mirrors to the live stream."""
+    buf = io.StringIO()
+    script = ("import sys\n"
+              "print('w1: alpha'); print('w2: beta'); print('scheduler note')\n")
+    sup = BackendSupervisor([PY, "-c", script],
+                            log_dir=str(tmp_path / "logs"), stream=buf,
+                            route_line=PDSHRunner(_args()).route_line,
+                            backend="pdsh", heartbeat_poll=0.05)
+    assert sup.run() == 0
+    assert (tmp_path / "logs" / "w1.log").read_text() == "alpha\n"
+    assert (tmp_path / "logs" / "w2.log").read_text() == "beta\n"
+    assert "scheduler note" in (tmp_path / "logs" / "pdsh.log").read_text()
+    assert "w1: alpha" in buf.getvalue()
+
+
+@pytest.mark.slow
+def test_backend_supervisor_heartbeat_silence_triggers_backend_kill_path(
+        tmp_path):
+    """Acceptance: a heartbeat-silent simulated backend rank triggers
+    teardown THROUGH the backend's own kill command, and the run reports
+    the stall rc."""
+    hb_dir = tmp_path / "hb"
+    t = [1000.0]
+    w = hb.HeartbeatWriter(str(hb_dir), 0, host="w1", refresh_interval=0,
+                           clock=lambda: t[0])
+    marker = tmp_path / "killed"
+    t0 = time.monotonic()
+    sup = BackendSupervisor(
+        [PY, "-c", "import time; time.sleep(120)"],
+        # sh, not a fresh python: interpreter startup on a loaded CI host
+        # can exceed the kill-cmd timeout (max(grace_secs, 1.0))
+        kill_cmd=["/bin/sh", "-c", f"printf scancel > {marker}"],
+        heartbeat_dir=str(hb_dir), heartbeat_timeout=0.3,
+        heartbeat_poll=0.05, grace_secs=2.0, stream=io.StringIO()).start()
+    # the rank attests once AFTER the run starts (start() scopes the
+    # channel to this run), then goes silent forever
+    w.write(hb.PHASE_STEP, 12, force=True)
+    rc = sup.wait(timeout=60)
+    assert rc == STALL_EXIT_CODE
+    assert time.monotonic() - t0 < 30
+    assert marker.read_text() == "scancel"        # backend kill path ran
+    assert sup.failed_hosts() == ["w1"]
+
+
+@pytest.mark.slow
+def test_backend_supervisor_reconstructs_preemption_rc(tmp_path):
+    """srun flattens rc 114 into its own step rc; the workers' PREEMPTED
+    terminal records restore the contract."""
+    hb_dir = tmp_path / "hb"
+    w = hb.HeartbeatWriter(str(hb_dir), 0, host="w1", refresh_interval=0)
+    sup = BackendSupervisor(
+        [PY, "-c", "import time; time.sleep(0.4); raise SystemExit(1)"],
+        heartbeat_dir=str(hb_dir),
+        heartbeat_poll=0.05, stream=io.StringIO()).start()
+    w.write(hb.PHASE_PREEMPTED, 30, force=True)   # this run's final word
+    assert sup.wait(timeout=60) == PREEMPTION_EXIT_CODE
+
+
+@pytest.mark.slow
+def test_backend_supervisor_stalled_evidence_beats_scheduler_rc(tmp_path):
+    hb_dir = tmp_path / "hb"
+    w = hb.HeartbeatWriter(str(hb_dir), 0, host="w1", refresh_interval=0)
+    sup = BackendSupervisor(
+        [PY, "-c", "import time; time.sleep(0.4); raise SystemExit(9)"],
+        heartbeat_dir=str(hb_dir),
+        heartbeat_poll=0.05, stream=io.StringIO()).start()
+    w.write(hb.PHASE_STALLED, 8, force=True)      # this run's final word
+    assert sup.wait(timeout=60) == STALL_EXIT_CODE
+    assert sup.failed_hosts() == ["w1"]
+
+
+def test_backend_supervisor_clean_exit_wins_over_old_noise(tmp_path):
+    """The channel is run-scoped: a reused dir holding a PREVIOUS run's
+    STALLED verdict and a stale mid-step record must not reconstruct a
+    clean run's rc as 117 (the agent would restart a succeeding world
+    until max_restarts) nor trip the silence monitor at t=0."""
+    hb_dir = tmp_path / "hb"
+    prev = hb.HeartbeatWriter(str(hb_dir), 1, host="w2", refresh_interval=0,
+                              clock=lambda: 1000.0)
+    prev.write(hb.PHASE_STALLED, 40, force=True)  # last run's verdict
+    stale = hb.HeartbeatWriter(str(hb_dir), 0, host="w1", refresh_interval=0,
+                               clock=lambda: 1000.0)
+    stale.write(hb.PHASE_STEP, 12, force=True)    # ancient mid-step record
+    sup = BackendSupervisor([PY, "-c", "pass"],
+                            heartbeat_dir=str(hb_dir),
+                            heartbeat_timeout=120.0, heartbeat_poll=0.05,
+                            stream=io.StringIO())
+    assert sup.run() == 0
+    assert sup.failed_hosts() == []
+
+
+@pytest.mark.slow
+def test_backend_supervisor_detects_rank_that_never_writes(tmp_path):
+    """A host dead BEFORE launch.py ever runs produces no record at all;
+    expected_ranks (from rank_hosts) makes that silence detectable and
+    attributable in hostfile vocabulary."""
+    hb_dir = tmp_path / "hb"
+    live = hb.HeartbeatWriter(str(hb_dir), 0, host="w1",
+                              refresh_interval=0.05)
+    t0 = time.monotonic()
+    sup = BackendSupervisor([PY, "-c", "import time; time.sleep(120)"],
+                            heartbeat_dir=str(hb_dir), heartbeat_timeout=0.4,
+                            heartbeat_poll=0.05, grace_secs=0.5,
+                            rank_hosts=["w1", "w2"],
+                            stream=io.StringIO()).start()
+    live.write(hb.PHASE_STEP, 5, force=True)      # rank 0 attests; rank 1 never
+    rc = sup.wait(timeout=60)
+    live.close()
+    assert rc == STALL_EXIT_CODE
+    assert time.monotonic() - t0 < 30
+    assert sup.failed_hosts() == ["w2"]
+
+
+def test_backend_supervisor_popen_facade(tmp_path):
+    import subprocess
+    sup = BackendSupervisor([PY, "-c", "import time; time.sleep(120)"],
+                            grace_secs=0.5, heartbeat_poll=0.05,
+                            stream=io.StringIO()).start()
+    assert sup.poll() is None
+    with pytest.raises(subprocess.TimeoutExpired):
+        sup.wait(timeout=0.2)
+    sup.terminate()
+    rc = sup.wait(timeout=30)
+    assert rc != 0
+    assert sup.poll() == rc == sup.returncode
+
+
+# ------------------------------------------------- runner-side integration
+
+def test_build_backend_supervisor_wires_runner_surfaces(tmp_path,
+                                                        monkeypatch):
+    from collections import OrderedDict
+
+    from deepspeed_tpu.launcher.runner import build_backend_supervisor
+    monkeypatch.setattr("shutil.which", lambda name: "/usr/bin/" + name)
+    args = _args(launcher="pdsh", master_addr="", master_port=29500,
+                 grace_secs=7.0, log_dir="", heartbeat_dir=str(tmp_path),
+                 heartbeat_timeout=45.0)
+    active = OrderedDict([("w1", [0]), ("w2", [0])])
+    sup = build_backend_supervisor(active, args, {"DSTPU_X": "1"})
+    assert sup.cmd[0] == "pdsh"
+    assert "DSTPU_X=1" in " ".join(sup.cmd)
+    assert sup.kill_cmd[0] == "pdsh"
+    assert sup.grace_secs == 7.0
+    assert sup.heartbeat_monitor is not None
+    assert sup.heartbeat_monitor.timeout == 45.0
+    assert sup.backend == "pdsh"
+    assert not sup._started                       # not launched yet
+
+
+def test_dstpu_health_subcommand(tmp_path, capsys):
+    from deepspeed_tpu.launcher.runner import health_main
+    w0 = hb.HeartbeatWriter(str(tmp_path), 0, host="w0", refresh_interval=0)
+    w0.write(hb.PHASE_STEP, 120, force=True)
+    w1 = hb.HeartbeatWriter(str(tmp_path), 1, host="w1", refresh_interval=0)
+    w1.write(hb.PHASE_STALLED, 88, force=True)
+    rc = health_main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1                                # a wedged rank is news
+    assert "w0" in out and "STEP" in out and "120" in out
+    assert "w1" in out and "STALLED" in out and "wedged" in out
+    # empty channel: nothing provably alive
+    assert health_main([str(tmp_path / "empty")]) == 1

@@ -282,6 +282,7 @@ def test_two_process_sharded_save_with_per_rank_failpoint(tmp_path):
                for n in os.listdir(ckdir)), os.listdir(ckdir)
 
 
+@pytest.mark.slow
 def test_two_process_tp_and_pp(tmp_path):
     """TP=2 and PP=2 over two REAL OS processes x 4 global devices (2 local
     each): the reference runs its whole feature matrix under

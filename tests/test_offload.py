@@ -465,18 +465,24 @@ def test_swap_pipeline_overlap_ratio_synthetic_bandwidth():
             for f in pending:
                 f.result()
 
-    pool = SyntheticPool()
-
     def compute(j, views):
         time.sleep(COMPUTE)
 
-    t0 = time.perf_counter()
-    pipeline_pools({"state": pool}, N, compute)
-    wall = time.perf_counter() - t0
-
-    assert pool.n_transfers == 2 * N                # every leaf read+written
     serial = N * (2 * TRANSFER + COMPUTE)           # no overlap at all
     ideal = N * max(2 * TRANSFER, COMPUTE) + 2 * TRANSFER   # fill/drain
+    # best of 3: the bounds measure the PIPELINE, not the host scheduler —
+    # inside a full-suite run the accumulated daemon threads (watchdogs,
+    # refreshers, executors) can delay sleep wakeups by hundreds of ms and
+    # flake a single measurement; a no-overlap regression fails all three
+    wall = float("inf")
+    for _ in range(3):
+        pool = SyntheticPool()
+        t0 = time.perf_counter()
+        pipeline_pools({"state": pool}, N, compute)
+        wall = min(wall, time.perf_counter() - t0)
+        assert pool.n_transfers == 2 * N            # every leaf read+written
+        if wall < 1.5 * ideal:
+            break
     overlap_ratio = serial / wall
     # the pipeline must recover a real fraction of the transfer time:
     # strictly faster than serial AND within 1.5x of the ideal bound

@@ -41,6 +41,7 @@ def _spec(code, host="h", remote=False):
 
 # -------------------------------------------------------- fail-fast teardown
 
+@pytest.mark.slow
 def test_kill_one_rank_tears_down_world_within_grace():
     """Acceptance (a): one rank dies -> every other rank is torn down
     within the grace deadline, not after its natural exit."""
@@ -59,6 +60,7 @@ def test_kill_one_rank_tears_down_world_within_grace():
     assert sup.status[1].signaled and sup.status[2].signaled
 
 
+@pytest.mark.slow
 def test_sigkill_escalation_after_grace_deadline():
     """A rank that ignores SIGTERM is SIGKILLed once the grace expires."""
     stubborn = ("import signal, time\n"
@@ -84,6 +86,7 @@ def test_all_ranks_clean_is_zero():
 
 # ------------------------------------------------ preemption-aware aggregate
 
+@pytest.mark.slow
 def test_preemption_rc_survives_teardown_aggregation():
     """Acceptance (c), launcher half: one rank exits 114, the rest are
     torn down -> overall 114, not -15/"crash"."""
@@ -241,6 +244,70 @@ def test_watchdog_restarts_after_stop():
     assert rcs == [STALL_EXIT_CODE]
 
 
+# ------------------------------------------- heartbeat-channel liveness (r6)
+
+@pytest.mark.slow
+def test_heartbeat_silence_tears_down_world_as_stall(tmp_path):
+    """A rank that attested liveness and then went silent (host dead,
+    process blackholed) triggers the same fail-fast teardown as an exit
+    — and the run reports rc 117 so the agent counts it."""
+    from deepspeed_tpu.runtime import heartbeat as hb
+    hb_dir = str(tmp_path / "hb")
+    t = [1000.0]
+    w = hb.HeartbeatWriter(hb_dir, 1, host="h1", refresh_interval=0,
+                           clock=lambda: t[0])
+    w.write(hb.PHASE_STEP, 3, force=True)        # rank 1's last word
+    live = hb.HeartbeatWriter(hb_dir, 0, host="h0", refresh_interval=0.05)
+    live.write(hb.PHASE_STEP, 3, force=True)     # rank 0 keeps attesting
+    t0 = time.monotonic()
+    sup = RunSupervisor([
+        _spec("import time; time.sleep(120)", "h0"),
+        _spec("import time; time.sleep(120)", "h1"),
+    ], grace_secs=0.5, heartbeat_dir=hb_dir, heartbeat_timeout=0.5,
+        heartbeat_poll=0.05)
+    rc = sup.run()
+    live.close()
+    assert rc == STALL_EXIT_CODE
+    assert time.monotonic() - t0 < 30
+    assert "h1" in sup.failed_hosts()
+    assert "h0" not in sup.failed_hosts()
+    # attribution is a snapshot taken when silence was DETECTED: once the
+    # teardown froze h0's record, its growing age must not retroactively
+    # implicate the innocent survivor (the agent would quarantine the
+    # whole world, not the dead host)
+    time.sleep(0.6)                               # > heartbeat_timeout
+    assert "h0" not in sup.failed_hosts()
+
+
+@pytest.mark.slow
+def test_heartbeat_fresh_ranks_do_not_trigger_teardown(tmp_path):
+    from deepspeed_tpu.runtime import heartbeat as hb
+    hb_dir = str(tmp_path / "hb")
+    w = hb.HeartbeatWriter(hb_dir, 0, host="h0", refresh_interval=0.05)
+    w.write(hb.PHASE_COMPILE, 0, force=True)
+    sup = RunSupervisor([_spec("import time; time.sleep(0.5)", "h0")],
+                        heartbeat_dir=hb_dir, heartbeat_timeout=5.0,
+                        heartbeat_poll=0.05)
+    assert sup.run() == 0
+    w.close()
+
+
+def test_blackholed_host_fails_dispatch_and_is_attributed(tmp_path):
+    """host.blackhole (keyed chaos): every dispatch to ONE host fails;
+    the other rank keeps its dispatch, the world tears down, and
+    failed_hosts() names exactly the blackholed host."""
+    chaos.arm("host.blackhole", "raise", times=100, match="h1")
+    sup = RunSupervisor([
+        _spec("import time; time.sleep(120)", "h0"),
+        _spec(f"print('{STARTED_SENTINEL}')", "h1", remote=True),
+    ], grace_secs=0.5, connect_retries=1, connect_backoff=0.01,
+        stream=io.StringIO())
+    rc = sup.run()
+    assert rc == SSH_CONNECT_RC
+    assert sup.status[1].attempts == 2 and not sup.status[1].started
+    assert sup.failed_hosts() == ["h1"]
+
+
 # --------------------------------------------------- Popen facade + the agent
 
 def test_popen_facade_poll_wait_terminate():
@@ -255,6 +322,7 @@ def test_popen_facade_poll_wait_terminate():
     assert sup.poll() == rc == sup.returncode
 
 
+@pytest.mark.slow
 def test_agent_resumes_preempted_supervisor_without_counting(tmp_path):
     """Acceptance (c), agent half: worker 114 -> supervisor 114 -> agent
     resumes with max_restarts=0 still intact."""
@@ -285,6 +353,7 @@ def test_agent_resumes_preempted_supervisor_without_counting(tmp_path):
     assert attempts.read_text() == "2"
 
 
+@pytest.mark.slow
 def test_agent_counts_stall_against_max_restarts(tmp_path):
     hostfile = tmp_path / "hostfile"
     hostfile.write_text("localhost slots=1\n")
@@ -327,6 +396,7 @@ def test_watchdog_fires_on_stall_with_stack_dump():
     assert "test_supervisor" in out or "Thread" in out
 
 
+@pytest.mark.slow
 def test_watchdog_beats_and_suspension_prevent_firing():
     rcs = []
     wd = StallWatchdog(stall_timeout=0.2, poll_interval=0.02,
@@ -344,6 +414,7 @@ def test_watchdog_beats_and_suspension_prevent_firing():
     assert not wd.fired
 
 
+@pytest.mark.slow
 def test_init_deadline_noop_when_disabled_and_fires_when_hung():
     with init_deadline(0):                       # disabled: pure pass-through
         pass
@@ -369,10 +440,13 @@ def test_exit_code_contract_is_distinct():
 
 # ----------------------------------------------------------- dstpu --elastic
 
+@pytest.mark.slow
 def test_dstpu_elastic_cli_preemption_resume(tmp_path):
     """bin/dstpu --elastic end to end: worker exits 114 on the first
     attempt; with --max-restarts 0 only the preemption exemption lets the
-    relaunch happen; second attempt exits clean."""
+    relaunch happen; second attempt exits clean. Slow-marked (a ~6s CLI
+    subprocess roundtrip; scripts/chaos.sh runs it) to keep tier-1 wall
+    clock inside its budget."""
     hostfile = tmp_path / "hostfile"
     hostfile.write_text("localhost slots=1\n")
     attempts = tmp_path / "n"
@@ -396,6 +470,7 @@ def test_dstpu_elastic_cli_preemption_resume(tmp_path):
     assert attempts.read_text() == "2"
 
 
+@pytest.mark.slow
 def test_dstpu_elastic_cli_crash_exhausts_budget(tmp_path):
     hostfile = tmp_path / "hostfile"
     hostfile.write_text("localhost slots=1\n")
@@ -445,6 +520,47 @@ for i in range(50):
     e.train_batch(random_batch(8, seed=i))
 raise SystemExit(99)                      # chaos must fire before step 50
 """
+
+
+CHILD_COMPILE_HANG = """
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import deepspeed_tpu as ds
+from util import SimpleModel, random_batch
+
+cfg = {"train_batch_size": 8,
+       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+       "watchdog": {"compile_timeout": 1.5, "stall_timeout": 60,
+                    "poll_interval": 0.1}}
+e, *_ = ds.initialize(model=SimpleModel(), config=cfg,
+                      example_batch=random_batch(8))
+e.train_batch(random_batch(8))            # run.compile_hang wedges here
+raise SystemExit(99)                      # must never be reached
+"""
+
+
+@pytest.mark.slow
+def test_compile_hang_exits_stall_rc_within_compile_timeout(tmp_path):
+    """Acceptance: a rank wedged BEFORE its first completed step (the
+    round-4 blind spot) dies with rc 117 + a stack dump naming the
+    COMPILE phase, within compile_timeout + grace — and stamps a STALLED
+    terminal heartbeat for the launcher side."""
+    from deepspeed_tpu.runtime import heartbeat as hb
+    hb_dir = str(tmp_path / "hb")
+    proc, timeout = _run_child(
+        CHILD_COMPILE_HANG, tmp_path,
+        env_extra={"DSTPU_CHAOS": "run.compile_hang:hang",
+                   "DSTPU_HEARTBEAT_DIR": hb_dir})
+    t0 = time.monotonic()
+    out, err = proc.communicate(timeout=timeout)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == STALL_EXIT_CODE, (proc.returncode, err[-2000:])
+    assert "COMPILE" in err and "compile_timeout" in err
+    assert "dumping all thread stacks" in err
+    assert elapsed < 120, elapsed          # bounded, not a tier-1 hang
+    rec = hb.terminal_records(hb_dir).get(0)
+    assert rec is not None and rec["phase"] == hb.PHASE_STALLED
 
 
 @pytest.mark.slow

@@ -83,6 +83,7 @@ print("loss", float(meng.train_batch(mbatch)["loss"]))
 """
 
 
+@pytest.mark.slow
 def test_moe_step_has_no_involuntary_rematerialization(tmp_path):
     """The grouped GShard dispatch layout keeps every tensor's sharding
     transition expressible as a collective — the SPMD partitioner must not
