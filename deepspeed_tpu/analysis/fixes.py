@@ -7,6 +7,12 @@ TPU008  PartitionSpec canonicalization: drop trailing ``None`` entries,
         unwrap single-name tuples, rewrite empty-tuple entries to
         ``None`` — producing the compiler's canonical form, which is the
         whole point of the rule.
+TPU009  scan-carry cast-back: wrap the widened carry expression in
+        ``.astype(<init dtype>)`` — the init's own 16-bit dtype token is
+        the one right answer (the carry dtype must be invariant across
+        iterations), and the f32 math INSIDE the expression is preserved
+        (accumulate in an f32 island, carry 16-bit — the rule's
+        recommended idiom).
 TPU010  wrap the statement launching ``pl.pallas_call`` in
         ``with jax.named_scope("<enclosing-fn>"):`` (adding ``import
         jax`` when the module lacks it).
@@ -26,7 +32,7 @@ from typing import Dict, List, Optional, Tuple
 from .core import Finding, ModuleInfo
 
 #: rules --fix knows how to rewrite
-FIXABLE = ("TPU008", "TPU010")
+FIXABLE = ("TPU008", "TPU009", "TPU010")
 
 
 class Edit:
@@ -78,6 +84,93 @@ def _fix_spec(module: ModuleInfo, call: ast.Call,
         args.pop()
     new = f"{_seg(src, call.func)}({', '.join(args)})"
     start, end = _span(src, offs, call)
+    if src[start:end] == new:
+        return None
+    return Edit(start, end, new)
+
+
+# ------------------------------------------------------------------ TPU009
+
+def _half_token(module: ModuleInfo, call: ast.Call,
+                init: ast.AST) -> Optional[str]:
+    """The init expression's own 16-bit dtype spelled as source — the one
+    right answer for the cast-back (following a plain init name to its
+    assignments in the function enclosing the scan, exactly the dataflow
+    the rule used to decide the init is 16-bit)."""
+    from .rules import _HALF_NAMES, _qual
+
+    def scan_expr(expr: ast.AST) -> Optional[str]:
+        for n in ast.walk(expr):
+            if isinstance(n, (ast.Attribute, ast.Name)) and \
+                    _qual(module, n) in _HALF_NAMES:
+                return _seg(module.source, n)
+            if isinstance(n, ast.Constant) and n.value in ("bfloat16",
+                                                           "float16"):
+                return repr(n.value)
+        return None
+
+    tok = scan_expr(init)
+    if tok is not None or not isinstance(init, ast.Name):
+        return tok
+    encl = module.enclosing_function(call)
+    if encl is None:
+        return None
+    for node in module.fn_nodes(encl):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(leaf, ast.Name) and leaf.id == init.id
+                for t in node.targets for leaf in ast.walk(t)):
+            tok = scan_expr(node.value)
+            if tok is not None:
+                return tok
+    return None
+
+
+def _tpu009_contexts(module: ModuleInfo) -> Dict[int, Tuple[ast.AST, str]]:
+    """``id(widening-cast node)`` -> (carry expression containing it,
+    init dtype token) for every TPU009-shaped scan site. The finding
+    anchors on the CAST (the precise squiggle for the report), but the
+    rewrite wraps the WHOLE carry expression — preserving any f32 math
+    inside it as an island — so the fixer re-walks the rule's dataflow to
+    recover that enclosing expression."""
+    from .rules import ScanCarryWideningRule, _qual
+    rule = ScanCarryWideningRule()
+    out: Dict[int, Tuple[ast.AST, str]] = {}
+    for call in module.all_calls:
+        if _qual(module, call.func) not in rule._SCANS or not call.args:
+            continue
+        init = (call.args[1] if len(call.args) >= 2 else
+                next((kw.value for kw in call.keywords
+                      if kw.arg == "init"), None))
+        if init is None or not rule._init_halfish(module, call, init):
+            continue
+        token = _half_token(module, call, init)
+        if token is None:
+            continue
+        body = module.scope.resolve_local_def(call.args[0]) \
+            if isinstance(call.args[0], ast.Name) else call.args[0]
+        if not isinstance(body, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            continue
+        for carry in rule._carry_exprs(module, body):
+            wide = rule._widening_cast(module, carry)
+            if wide is None or rule._narrows_back(module, carry):
+                continue
+            out[id(wide)] = (carry, token)
+            break               # one finding per scan site, same as the rule
+    return out
+
+
+def _fix_cast_back(module: ModuleInfo, carry: ast.AST, token: str,
+                   offs: List[int]) -> Optional[Edit]:
+    """Append ``.astype(<init dtype>)`` to the carry expression. An atom
+    (name/call/attribute/subscript) chains directly; anything else is
+    parenthesized first."""
+    src = module.source
+    seg = _seg(src, carry)
+    atom = isinstance(carry, (ast.Name, ast.Attribute, ast.Call,
+                              ast.Subscript))
+    new = f"{seg}.astype({token})" if atom else f"({seg}).astype({token})"
+    start, end = _span(src, offs, carry)
     if src[start:end] == new:
         return None
     return Edit(start, end, new)
@@ -142,6 +235,7 @@ def compute_edits(module: ModuleInfo,
     edits: List[Edit] = []
     wrapped_stmts = set()
     want_jax_import = False
+    tpu009_ctx: Optional[Dict[int, Tuple[ast.AST, str]]] = None
     for f in findings:
         if f.node is None:
             continue
@@ -149,6 +243,14 @@ def compute_edits(module: ModuleInfo,
             e = _fix_spec(module, f.node, offs)
             if e:
                 edits.append(e)
+        elif f.rule == "TPU009":
+            if tpu009_ctx is None:
+                tpu009_ctx = _tpu009_contexts(module)
+            ctx = tpu009_ctx.get(id(f.node))
+            if ctx:
+                e = _fix_cast_back(module, ctx[0], ctx[1], offs)
+                if e:
+                    edits.append(e)
         elif f.rule == "TPU010":
             stmt = _enclosing_stmt(module, f.node)
             if stmt is None or id(stmt) in wrapped_stmts:

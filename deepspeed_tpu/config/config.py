@@ -313,13 +313,55 @@ class DataTypesConfig(DeepSpeedConfigModel):
 
 
 class NonFiniteGuardConfig(DeepSpeedConfigModel):
-    """TPU-native: bf16 runs have no loss scaler, but the train step already
-    skips-and-counts non-finite updates in-jit (TrainState.nonfinite_streak).
-    ``abort_after``: raise after N CONSECUTIVE non-finite steps (0 = never).
-    The host check rides the existing batched `_after_step` metrics pull, so
-    detection latency is `steps_per_print` steps and the hot path gains no
-    extra device sync."""
+    """DEPRECATED alias (round 7): the streak/abort semantics are folded
+    into the training-integrity sentinel as one code path —
+    ``abort_after`` here maps onto ``integrity.nonfinite_abort_after``
+    (which wins when both are set). Behavior is unchanged: the train step
+    skips-and-counts non-finite updates in-jit
+    (TrainState.nonfinite_streak), the host check rides the batched
+    ``_after_step`` metrics pull (detection latency ``steps_per_print``
+    steps unless the sentinel's every-step pull is on), and the abort is
+    raised after N CONSECUTIVE skipped steps (0 = never)."""
     abort_after: int = 0
+
+
+class IntegrityConfig(DeepSpeedConfigModel):
+    """TPU-native (round 7): the training-integrity sentinel
+    (runtime/sentinel.py, docs/RESILIENCE.md). ``enabled`` turns on the
+    host-side anomaly detector over per-step statistics the compiled step
+    already computes (loss, global grad norm, update norm, param norm —
+    all riding the ONE batched device_get in ``_after_step``) and the
+    remediation ladder: in-jit skip of spiked batches (``skip``), then
+    auto-rollback to the newest intact checkpoint after
+    ``rollback_after`` strikes inside ``strike_window`` steps, then abort
+    with rc 118 when the anomaly reproduces after
+    ``abort_after_rollbacks`` rollbacks. ``audit_interval`` > 0 adds the
+    cross-replica SDC audit: a bit-exact in-jit checksum of every
+    fully-replicated state leaf, compared across replicas every N steps;
+    a minority replica stamps an ``SDC`` heartbeat flag and the run
+    aborts with rc 118 so the relaunch resumes from the last
+    audited-clean checkpoint. ``nonfinite_abort_after`` is the folded-in
+    PR-3 non-finite guard (``nonfinite_guard.abort_after`` remains as a
+    deprecated alias)."""
+    enabled: bool = False
+    # -- detector ------------------------------------------------------------
+    metrics: List[str] = Field(
+        default_factory=lambda: ["loss", "grad_norm", "update_norm"])
+    window: int = 64           # rolling median/MAD sample window
+    zmax: float = 8.0          # robust z-score anomaly threshold
+    warmup_steps: int = 20     # accepted samples before any verdict
+    cooldown_steps: int = 5    # steps one anomaly event covers (one strike)
+    # -- remediation ladder --------------------------------------------------
+    skip: bool = True               # rung 1: in-jit skip past the ceiling
+    rollback_after: int = 3         # strikes in window -> rung 2
+    strike_window: int = 50         # steps
+    abort_after_rollbacks: int = 1  # reproduced post-rollback -> rung 3
+    load_dir: Optional[str] = None  # rollback source (default: last dir the
+    #                                 engine saved to / loaded from)
+    # -- SDC audit -----------------------------------------------------------
+    audit_interval: int = 0    # steps between cross-replica audits; 0 = off
+    # -- folded-in non-finite guard -----------------------------------------
+    nonfinite_abort_after: int = 0
 
 
 class WatchdogConfig(DeepSpeedConfigModel):
@@ -470,6 +512,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
     nonfinite_guard: NonFiniteGuardConfig = Field(
         default_factory=NonFiniteGuardConfig)
+    integrity: IntegrityConfig = Field(default_factory=IntegrityConfig)
     watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
     dataloader_drop_last: bool = False
     nebula: NebulaConfig = Field(default_factory=NebulaConfig)
@@ -511,6 +554,17 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
             if C.BF16_ALIAS in data and C.BF16 not in data:
                 data[C.BF16] = data.pop(C.BF16_ALIAS)
         return data
+
+    @model_validator(mode="after")
+    def _fold_nonfinite_guard(self):
+        """Deprecation shim (round 7): ``nonfinite_guard.abort_after``
+        feeds the sentinel's single code path. An explicit
+        ``integrity.nonfinite_abort_after`` wins over the alias."""
+        if self.nonfinite_guard.abort_after > 0 and \
+                self.integrity.nonfinite_abort_after == 0:
+            self.integrity.nonfinite_abort_after = \
+                self.nonfinite_guard.abort_after
+        return self
 
     def resolve_batch_sizes(self, dp_world_size: int) -> None:
         """Batch-size triangulation: any 2 of 3 determine the third.

@@ -198,6 +198,15 @@ class DSElasticAgent:
                     host = _rec_host(rec)
                     if host and host not in implicated:
                         implicated.append(host)
+            # SDC flags from the cross-replica audit: the audit aborts
+            # EVERY rank with the same rc (and launch.py marks them all
+            # INTEGRITY for health), but only the implicated rank's
+            # record carries SDC — strike that host, not the whole world
+            for rec in hb.flagged_ranks(self.heartbeat_dir,
+                                        flag="SDC").values():
+                host = _rec_host(rec)
+                if host and host not in implicated:
+                    implicated.append(host)
             if self.heartbeat_timeout > 0:
                 # post-mortem staleness: the world is DOWN by the time the
                 # agent reads the channel, so every record is frozen and

@@ -114,6 +114,28 @@ def main(argv=None):
         if writer is not None and e.code in (0, None):
             writer.stamp_terminal(hb.PHASE_EXIT, lock_timeout=5.0)
         raise
+    except Exception as e:
+        # integrity aborts (runtime/sentinel.py: TrainingIntegrityError,
+        # NonFiniteError) carry their own rc contract — rc 118 tells the
+        # supervisor/elastic agent "the run computes wrong numbers"
+        # (counted failure, distinct from crash/stall/preemption). Any
+        # heartbeat evidence (the SDC flag) was stamped before the raise.
+        code = getattr(e, "exit_code", None)
+        if isinstance(code, int) and 0 < code < 256:
+            import traceback
+            traceback.print_exc()
+            if writer is not None:
+                # mark + conclude the record: the INTEGRITY flag keeps
+                # the abort visible in `dstpu health` (a bare EXIT reads
+                # as a clean run) without striking anyone — blacklist
+                # consumers filter to SDC, the only flag naming a host —
+                # and the terminal stamp keeps a slow scheduler teardown
+                # past heartbeat_timeout from reading EVERY frozen STEP
+                # record as silence (rc 117 against all innocent hosts)
+                writer.add_flag("INTEGRITY", lock_timeout=5.0)
+                writer.stamp_terminal(hb.PHASE_EXIT, lock_timeout=5.0)
+            sys.exit(code)
+        raise
     if writer is not None:
         # clean completion without engine.close() (or without any engine
         # at all): conclude the record so a frozen non-terminal phase

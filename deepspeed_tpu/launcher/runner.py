@@ -241,10 +241,12 @@ def parse_args(argv=None):
 
 def health_main(argv) -> int:
     """``dstpu health <heartbeat-dir>`` — the operator's one-glance pod
-    view: per-rank phase, step, record age, host and pid from the
-    heartbeat channel. Exit 0 when every rank is live or concluded
-    cleanly, 1 when any rank's last word is STALLED or the channel is
-    empty (nothing attesting = nothing provably alive)."""
+    view: per-rank phase, step, record age, host, pid and integrity
+    FLAGS from the heartbeat channel. Exit 0 when every rank is live or
+    concluded cleanly, 1 when any rank's last word is STALLED, any rank
+    carries an integrity flag (e.g. ``SDC`` — its host's numbers cannot
+    be trusted), or the channel is empty (nothing attesting = nothing
+    provably alive)."""
     import time as _time
     from ..runtime import heartbeat as hb
     p = argparse.ArgumentParser(prog="dstpu health")
@@ -257,24 +259,29 @@ def health_main(argv) -> int:
         print(f"no heartbeat records under {a.heartbeat_dir}")
         return 1
     now = _time.time()
-    rows = [("RANK", "HOST", "PHASE", "STEP", "AGE", "PID", "")]
+    rows = [("RANK", "HOST", "PHASE", "STEP", "AGE", "PID", "FLAGS", "")]
     bad = False
     for rank in sorted(records):
         rec = records[rank]
         age = hb.record_age(rec, now)
         phase = str(rec.get("phase"))
+        flags = ",".join(rec.get("flags") or ()) or "-"
         note = ""
         if phase == hb.PHASE_STALLED:
             note, bad = "wedged (rc 117)", True
         elif phase == hb.PHASE_PREEMPTED:
             note = "preempted (rc 114)"
         elif phase == hb.PHASE_EXIT:
-            note = "clean exit"
+            # a flagged EXIT is a concluded integrity ABORT, not a clean run
+            note = "" if rec.get("flags") else "clean exit"
         elif age > a.stale_after:
             note, bad = f"SILENT > {a.stale_after:.0f}s", True
+        if rec.get("flags"):
+            note = (note + "; " if note else "") + "integrity flags (rc 118)"
+            bad = True
         rows.append((str(rank), str(rec.get("host")), phase,
                      str(rec.get("step")), f"{age:.1f}s",
-                     str(rec.get("pid")), note))
+                     str(rec.get("pid")), flags, note))
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
     for r in rows:
         print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())

@@ -53,6 +53,7 @@ from typing import Callable, List, Optional, Sequence
 
 from ..elasticity.elastic_agent import PREEMPTION_EXIT_CODE
 from ..runtime import heartbeat as hb
+from ..runtime.sentinel import INTEGRITY_EXIT_CODE, SDC_FLAG
 from ..runtime.watchdog import STALL_EXIT_CODE
 from ..testing import chaos
 from ..utils.logging import logger
@@ -580,10 +581,18 @@ class RunSupervisor:
         """Hosts this run has evidence AGAINST — the elastic agent's
         blacklist feed: voluntary nonzero exits (crash/stall rc), remote
         ranks that never got past the connect phase (a blackholed host),
-        and ranks the heartbeat monitor called silent."""
+        ranks the heartbeat monitor called silent, and ranks whose record
+        carries an integrity flag (a cross-replica SDC audit implicated
+        their chips — evidence MORE precise than the exit code, which
+        every rank shares when the audit aborts the world)."""
         out = []
         for spec, st in zip(self.specs, self.status):
-            voluntary_failure = (st.rc not in (None, 0, PREEMPTION_EXIT_CODE)
+            # rc 118 exempt: an integrity abort exits EVERY rank with the
+            # same code by construction (the audit is collective), so the
+            # rc names no host — only the flagged record below does.
+            # Striking on the rc would quarantine the whole innocent world
+            voluntary_failure = (st.rc not in (None, 0, PREEMPTION_EXIT_CODE,
+                                               INTEGRITY_EXIT_CODE)
                                  and not st.signaled)
             never_started = (spec.remote and not st.started
                              and not st.signaled
@@ -596,6 +605,15 @@ class RunSupervisor:
             # frozen every survivor's record, and re-evaluating would
             # strike the whole (innocent) world
             for rec in self._hb_silent:
+                host = hb.rec_host(rec, self.rank_hosts)
+                if host and host not in out:
+                    out.append(host)
+        if self.heartbeat_dir:
+            # SDC only: the generic INTEGRITY mark (launch.py stamps it on
+            # every rank of an rc-118 abort for health visibility) names
+            # no host
+            for rec in hb.flagged_ranks(self.heartbeat_dir,
+                                        flag=SDC_FLAG).values():
                 host = hb.rec_host(rec, self.rank_hosts)
                 if host and host not in out:
                     out.append(host)
@@ -742,8 +760,10 @@ class BackendSupervisor:
         return hb.rec_host(rec, self.rank_hosts)
 
     def failed_hosts(self) -> List[str]:
-        """Blacklist feed: hosts whose ranks went heartbeat-silent or
-        stamped a STALLED terminal record."""
+        """Blacklist feed: hosts whose ranks went heartbeat-silent,
+        stamped a STALLED terminal record, or carry an integrity flag
+        (the SDC audit's per-host attribution — the scheduler's flattened
+        rc cannot name the bad chip, the flagged record can)."""
         out = list(self._silent_hosts)
         if self._heartbeat_dir:
             for rec in hb.terminal_records(self._heartbeat_dir).values():
@@ -751,6 +771,11 @@ class BackendSupervisor:
                     host = self._rank_host(rec)
                     if host and host not in out:
                         out.append(host)
+            for rec in hb.flagged_ranks(self._heartbeat_dir,
+                                        flag=SDC_FLAG).values():
+                host = self._rank_host(rec)
+                if host and host not in out:
+                    out.append(host)
         return out
 
     # -------------------------------------------------------------- internals

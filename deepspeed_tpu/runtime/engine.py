@@ -47,19 +47,17 @@ from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from ..testing import chaos
 from . import checkpointing as ckpt_lib
 from . import heartbeat as hb
+from . import sentinel as sentinel_lib
 from .loss_scaler import LossScaler
 from .lr_schedules import LRScheduler, build_schedule
+# NonFiniteError moved into the sentinel ladder (round 7) — re-exported
+# here because user code and tests import it from the engine module
+from .sentinel import (NonFiniteError, TrainingIntegrityError,  # noqa: F401
+                       TrainingSentinel)
 from .state import TrainState
 from .zero.stages import ZeroShardingPolicy
 
 PyTree = Any
-
-
-class NonFiniteError(RuntimeError):
-    """The non-finite guard tripped: ``nonfinite_guard.abort_after``
-    consecutive steps produced inf/nan grads. Each of those steps was
-    skipped in-jit (params/optimizer untouched), so the last checkpoint —
-    and even the live state — is still clean to restart from."""
 
 
 def _default_loss_fn(outputs, batch):
@@ -527,6 +525,32 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.micro_steps = 0
 
+        # training-integrity sentinel (round 7; docs/RESILIENCE.md): host
+        # detector over the in-jit step statistics, remediation ladder
+        # (skip -> rollback -> abort), cross-replica SDC audit. The PR-3
+        # nonfinite_guard streak/abort lives inside observe() — one code
+        # path for every "wrong numbers" verdict.
+        self.sentinel = TrainingSentinel(self.config.integrity)
+        self._audit_fn = None
+        # the checkpoint dir the audit marker lands in and the rollback
+        # default — tracks the last save/load; an explicit
+        # integrity.load_dir always wins at rollback time (a pinned
+        # known-good archive must not be clobbered by a routine save)
+        self._ckpt_dir: Optional[str] = self.config.integrity.load_dir
+        # global batches consumed since data start: checkpointed, NOT
+        # rolled back by a sentinel rollback (the poisoned span is
+        # fast-forwarded past, never replayed); feeds
+        # fast_forward_dataloader at resume
+        self.data_position = 0
+        if self.sentinel.enabled or self.config.integrity.audit_interval > 0:
+            log_dist(
+                f"integrity sentinel: metrics={self.config.integrity.metrics} "
+                f"zmax={self.config.integrity.zmax} "
+                f"skip={self.config.integrity.skip} "
+                f"rollback_after={self.config.integrity.rollback_after} "
+                f"audit_interval={self.config.integrity.audit_interval}",
+                ranks=[0])
+
         # phase-aware watchdog + rank heartbeat channel (rounds 4+6;
         # docs/RESILIENCE.md): the engine reports lifecycle phases
         # (RESTORE -> COMPILE -> STEP -> SAVE), each with its own deadline;
@@ -750,11 +774,19 @@ class DeepSpeedEngine:
             g.astype(self.grad_accum_dtype), s), grads, self.grad_shardings)
         return grads, loss
 
-    def _finalize_step(self, state: TrainState, grads_sum, n_micro, lr_arg):
+    def _finalize_step(self, state: TrainState, grads_sum, n_micro, lr_arg,
+                       spike_limit=None):
         """Shared tail: unscale, clip, optimize, loss-scale bookkeeping.
 
         ``lr_arg``: host-computed lr (external scheduler objects); ignored when
-        the schedule is an in-jit lr_fn."""
+        the schedule is an in-jit lr_fn.
+
+        ``spike_limit``: the sentinel's grad-norm ceiling (remediation
+        ladder rung 1; +inf during warmup). A step whose raw global norm
+        exceeds it is skipped through the SAME keep-old-state path the
+        fp16 overflow skip uses — one skip semantics for scaler overflow,
+        non-finite grads, and detected spikes. ``None`` (integrity off)
+        compiles the check away entirely."""
         master = state.master if self.keep_master else state.params
         denom = n_micro * state.scale.scale
         grads = jax.tree.map(lambda g: g / denom, grads_sum)
@@ -765,6 +797,11 @@ class DeepSpeedEngine:
         # get_global_norm + clip_grad_norm_ w/ allreduce, runtime/utils.py)
         sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
         global_norm = jnp.sqrt(sq)
+        spiked = None
+        skip = overflow
+        if spike_limit is not None:
+            spiked = global_norm > spike_limit
+            skip = overflow | spiked
         clip = self.config.gradient_clipping
         if clip > 0:
             coef = jnp.minimum(clip / (global_norm + 1e-6), 1.0)
@@ -778,10 +815,10 @@ class DeepSpeedEngine:
         new_master = jax.tree.map(lambda x, s: lax.with_sharding_constraint(x, s),
                                   new_master, master_sh)
 
-        # overflow → keep old state, count a skipped step (reference: engine.step
-        # overflow path engine.py:2105-2112)
+        # skip → keep old state, count a skipped step (reference: engine.step
+        # overflow path engine.py:2105-2112; sentinel spikes ride the same arm)
         keep = lambda old, new: jax.tree.map(
-            lambda a, b: jnp.where(overflow, a, b), old, new)
+            lambda a, b: jnp.where(skip, a, b), old, new)
         new_master = keep(master, new_master)
         new_opt = keep(state.opt_state, new_opt)
 
@@ -793,34 +830,54 @@ class DeepSpeedEngine:
         else:
             new_params = new_master
 
-        # non-finite guard: consecutive skipped steps, counted in-jit (a
-        # bf16 run has no loss scaler to notice divergence; fp16 counts too
-        # — a scale already at min_scale that still overflows is the same
-        # signal). The host only reads this in _after_step's batched pull.
+        # skip streak: consecutive skipped steps of ANY kind, counted
+        # in-jit (a bf16 run has no loss scaler to notice divergence; fp16
+        # counts too — a scale already at min_scale that still overflows
+        # is the same signal; a sentinel spike skip is the same verdict).
+        # The host only reads this in _after_step's batched pull.
         prev_streak = (state.nonfinite_streak
                        if state.nonfinite_streak is not None
                        else jnp.asarray(0, jnp.int32))
-        new_streak = jnp.where(overflow, prev_streak + 1, 0).astype(jnp.int32)
+        new_streak = jnp.where(skip, prev_streak + 1, 0).astype(jnp.int32)
 
-        # overflow does not advance the optimizer step (Adam bias correction /
-        # in-jit lr schedules stay put), matching the reference's skip path
+        # a skip does not advance the optimizer step (Adam bias correction /
+        # in-jit lr schedules stay put), matching the reference's skip path;
+        # the loss scale reacts to GENUINE overflow only — a finite spike
+        # must not shrink a healthy fp16 scale
         new_state = TrainState(
-            step=state.step + 1 - overflow.astype(jnp.int32),
+            step=state.step + 1 - skip.astype(jnp.int32),
             params=new_params,
             master=new_master if self.keep_master else (),
             opt_state=new_opt,
             scale=self.loss_scaler.update(state.scale, overflow),
-            skipped_steps=state.skipped_steps + overflow.astype(jnp.int32),
+            skipped_steps=state.skipped_steps + skip.astype(jnp.int32),
             nonfinite_streak=new_streak)
-        metrics = {"grad_norm": global_norm, "lr": lr, "overflow": overflow,
+        metrics = {"grad_norm": global_norm, "lr": lr, "overflow": skip,
                    "loss_scale": state.scale.scale,
                    "nonfinite_streak": new_streak}
+        if spiked is not None:
+            metrics["anomaly_skip"] = spiked
+        integ = self.config.integrity
+        if integ.enabled:
+            # sentinel statistics, computed in-jit so they ride the one
+            # batched host pull: the update norm (0 on a skipped step) and
+            # the param norm — divergence signals a grad norm alone misses
+            if "update_norm" in integ.metrics:
+                usq = sum(jnp.sum(jnp.square((a - b).astype(jnp.float32)))
+                          for a, b in zip(jax.tree.leaves(new_master),
+                                          jax.tree.leaves(master)))
+                metrics["update_norm"] = jnp.sqrt(usq)
+            if "param_norm" in integ.metrics:
+                psq = sum(jnp.sum(jnp.square(p.astype(jnp.float32)))
+                          for p in jax.tree.leaves(new_master))
+                metrics["param_norm"] = jnp.sqrt(psq)
         return new_state, metrics
 
     def _make_train_step(self):
         gas = self.config.gradient_accumulation_steps
 
-        def train_step(state: TrainState, micros, rng, lr_arg):
+        def train_step(state: TrainState, micros, rng, lr_arg,
+                       spike_limit=None):
             # micros: [gas, global_micro, ...], dim 1 sharded over the DP axes
             rngs = jax.random.split(rng, gas)
             zero_grads = jax.tree.map(
@@ -837,7 +894,8 @@ class DeepSpeedEngine:
                 return acc, loss
 
             grads_sum, losses = lax.scan(micro_step, zero_grads, (micros, rngs))
-            new_state, metrics = self._finalize_step(state, grads_sum, float(gas), lr_arg)
+            new_state, metrics = self._finalize_step(
+                state, grads_sum, float(gas), lr_arg, spike_limit=spike_limit)
             metrics["loss"] = jnp.mean(losses)
             return new_state, metrics
 
@@ -881,6 +939,12 @@ class DeepSpeedEngine:
         scale = float(jax.device_get(state.scale.scale))
         denom = n_micro * scale
         gnorm = float(jax.device_get(raw_norm)) / denom
+        # sentinel rung 1 on the host tail (the offload optimizer runs
+        # host-side, so the skip decision can too — same semantics as the
+        # in-jit arm, same keep-old-state outcome)
+        limit = self.sentinel.spike_limit()
+        spiked = bool(limit is not None and gnorm > limit)
+        skip = overflow_h or spiked
         new_scale = self.loss_scaler.update(state.scale,
                                             jnp.asarray(overflow_h))
         clip = self.config.gradient_clipping
@@ -890,8 +954,8 @@ class DeepSpeedEngine:
         else:
             lr = float(jax.device_get(self._current_lr()))
         self._host_nonfinite_streak = (
-            self._host_nonfinite_streak + 1 if overflow_h else 0)
-        if overflow_h:
+            self._host_nonfinite_streak + 1 if skip else 0)
+        if skip:
             self.state = state.replace(
                 scale=new_scale,
                 skipped_steps=state.skipped_steps + 1,
@@ -907,9 +971,12 @@ class DeepSpeedEngine:
                 params=() if self._transient_params else new_params,
                 scale=new_scale,
                 nonfinite_streak=jnp.asarray(0, jnp.int32))
-        return {"loss": loss, "lr": lr, "grad_norm": gnorm,
-                "overflow": overflow_h, "loss_scale": scale,
-                "nonfinite_streak": self._host_nonfinite_streak}
+        out = {"loss": loss, "lr": lr, "grad_norm": gnorm,
+               "overflow": skip, "loss_scale": scale,
+               "nonfinite_streak": self._host_nonfinite_streak}
+        if limit is not None:
+            out["anomaly_skip"] = spiked
+        return out
 
     def _make_micro_grad(self):
         def micro_grad(params, scale_state, batch, rng, step):
@@ -935,8 +1002,9 @@ class DeepSpeedEngine:
         return jax.jit(fwd_loss)
 
     def _make_apply_update(self):
-        def apply_update(state, grads_sum, n_micro, lr_arg):
-            return self._finalize_step(state, grads_sum, n_micro, lr_arg)
+        def apply_update(state, grads_sum, n_micro, lr_arg, spike_limit=None):
+            return self._finalize_step(state, grads_sum, n_micro, lr_arg,
+                                       spike_limit=spike_limit)
 
         return jax.jit(apply_update, donate_argnums=(0,))
 
@@ -1017,6 +1085,16 @@ class DeepSpeedEngine:
         chaos.failpoint("run.kill")
         chaos.failpoint("run.preempt")
         chaos.failpoint("run.hang")
+        # sentinel chaos: a poisoned batch — float features scaled by
+        # `factor`, producing the finite-but-huge grad spike the integrity
+        # ladder exists to remediate (spec e.g.
+        # "sentinel.spike:flag:skip=10:times=3:factor=1000")
+        spike = chaos.flag("sentinel.spike")
+        if spike is not None:
+            batch = jax.tree.map(
+                lambda x: (np.asarray(x) * spike
+                           if np.issubdtype(np.asarray(x).dtype, np.floating)
+                           else x), batch)
         if not self._step_phase_reached:
             # the window from the FIRST train_batch entry to the first
             # completed step is COMPILE (XLA compile + sharded-restore
@@ -1086,8 +1164,14 @@ class DeepSpeedEngine:
             metrics = self._apply_offload_update(grads_sum, float(gas), loss,
                                                  raw_norm, overflow)
         else:
-            self.state, metrics = self._train_step(
-                self.state, micros, self.next_rng(), self._current_lr())
+            limit = self._spike_limit_arg()
+            if limit is None:
+                self.state, metrics = self._train_step(
+                    self.state, micros, self.next_rng(), self._current_lr())
+            else:
+                self.state, metrics = self._train_step(
+                    self.state, micros, self.next_rng(), self._current_lr(),
+                    limit)
         self.tput_timer.stop(sync=metrics["loss"])
         if self.config.wall_clock_breakdown:
             # the jitted step is one program: the breakdown the reference
@@ -1103,6 +1187,10 @@ class DeepSpeedEngine:
                          f"{self.tput_timer.avg_samples_per_sec:.1f} "
                          "samples/sec", ranks=[0])
         self._after_step(metrics)
+        # counted AFTER remediation: a sentinel rollback preserves the
+        # pipeline position (the poisoned span is never replayed), and
+        # this batch was consumed regardless of its verdict
+        self.data_position += 1
         return metrics
 
     def eval_batch(self, batch):
@@ -1249,19 +1337,25 @@ class DeepSpeedEngine:
                 grads, float(self._micro_count),
                 jnp.mean(jnp.stack(self._accum_losses)),
                 jnp.sqrt(sq), overflow)
-            self._accum_grads = None
-            self._accum_losses = []
-            self._micro_count = 0
-            self._after_step(metrics)
-            return metrics
-        n = jnp.asarray(float(self._micro_count), jnp.float32)
-        self.state, metrics = self._apply_update(self.state, self._accum_grads, n,
-                                                 self._current_lr())
-        metrics["loss"] = jnp.mean(jnp.stack(self._accum_losses))
+        else:
+            n = jnp.asarray(float(self._micro_count), jnp.float32)
+            limit = self._spike_limit_arg()
+            if limit is None:
+                self.state, metrics = self._apply_update(
+                    self.state, self._accum_grads, n, self._current_lr())
+            else:
+                self.state, metrics = self._apply_update(
+                    self.state, self._accum_grads, n, self._current_lr(),
+                    limit)
+            metrics["loss"] = jnp.mean(jnp.stack(self._accum_losses))
+        # one shared tail: _after_step (and the SDC audit's collective
+        # inside it) runs on every arm — a per-arm tail would put a
+        # conditional return between paired collectives (TPU013)
         self._accum_grads = None
         self._accum_losses = []
         self._micro_count = 0
         self._after_step(metrics)
+        self.data_position += 1
         return metrics
 
     def _after_step(self, metrics):  # graftlint: hotpath
@@ -1280,38 +1374,40 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self._last_metrics = metrics
-        if self.global_steps % self.config.steps_per_print == 0:
-            # one batched D2H pull for every scalar the logging tier reads
-            # (graftlint TPU001: per-scalar float() here was 3-4 separate
-            # blocking transfers per print step). The non-finite guard's
-            # streak rides the SAME pull — no extra sync on the hot path.
-            abort_after = self.config.nonfinite_guard.abort_after
-            keys = ("loss", "lr", "grad_norm", "loss_scale")
-            if abort_after > 0:
-                keys = keys + ("nonfinite_streak",)
-            host = jax.device_get({k: metrics[k] for k in keys
+        print_step = self.global_steps % self.config.steps_per_print == 0
+        if print_step or self.sentinel.wants_every_step:
+            # one batched D2H pull for every scalar the logging tier AND
+            # the integrity sentinel read (graftlint TPU001: per-scalar
+            # float() here was 3-4 separate blocking transfers per print
+            # step). The skip streak and the sentinel statistics ride the
+            # SAME pull — enabling the detector costs per-step cadence on
+            # this one transfer, never an extra sync.
+            host = jax.device_get({k: metrics[k]
+                                   for k in self.sentinel.metric_keys
                                    if k in metrics})
-            if abort_after > 0 and \
-                    int(host.get("nonfinite_streak", 0)) >= abort_after:
-                raise NonFiniteError(
-                    f"{int(host['nonfinite_streak'])} consecutive "
-                    f"non-finite steps at global step {self.global_steps} "
-                    f"(nonfinite_guard.abort_after={abort_after}); the run "
-                    "has diverged — restart from the last checkpoint with "
-                    "a lower lr / higher warmup")
-            if self.monitor.enabled:
-                events = [("Train/Samples/train_loss", float(host["loss"]),
-                           self.global_steps),
-                          ("Train/Samples/lr", float(host["lr"]),
-                           self.global_steps)]
-                if self.loss_scaler.enabled:
-                    events.append(("Train/Samples/loss_scale",
-                                   float(host["loss_scale"]),
-                                   self.global_steps))
-                self.monitor.write_events(events)
-            log_dist(f"step={self.global_steps} loss={float(host['loss']):.4f} "
-                     f"lr={float(host['lr']):.3e} "
-                     f"grad_norm={float(host['grad_norm']):.3f}", ranks=[0])
+            # one code path for every "wrong numbers" verdict: the folded
+            # nonfinite_guard streak abort (NonFiniteError), anomaly
+            # strikes, and the post-rollback abort all live in observe()
+            verdict = self.sentinel.observe(self.global_steps, host)
+            if print_step:
+                if self.monitor.enabled:
+                    events = [("Train/Samples/train_loss",
+                               float(host["loss"]), self.global_steps),
+                              ("Train/Samples/lr", float(host["lr"]),
+                               self.global_steps)]
+                    if self.loss_scaler.enabled:
+                        events.append(("Train/Samples/loss_scale",
+                                       float(host["loss_scale"]),
+                                       self.global_steps))
+                    self.monitor.write_events(events)
+                log_dist(f"step={self.global_steps} "
+                         f"loss={float(host['loss']):.4f} "
+                         f"lr={float(host['lr']):.3e} "
+                         f"grad_norm={float(host['grad_norm']):.3f}",
+                         ranks=[0])
+            if verdict == sentinel_lib.ROLLBACK:
+                self._sentinel_rollback()
+        self._maybe_sdc_audit()
         self._autotuning_hook()
 
     def _autotuning_hook(self):
@@ -1335,6 +1431,218 @@ class DeepSpeedEngine:
             json.dump(metrics, f)
         log_dist(f"autotuning: wrote {metric_file}, exiting", ranks=[0])
         sys.exit(0)
+
+    # --------------------------------------------- training-integrity sentinel
+
+    def _spike_limit_arg(self):
+        """The sentinel's grad-norm ceiling as a device scalar for the
+        compiled step, or None when rung 1 is off. Always a float (+inf
+        during warmup) once the rung is on, so the compiled program's arg
+        structure — and its cache entry — never changes mid-run."""
+        thr = self.sentinel.spike_limit()
+        if thr is None:
+            return None
+        return jnp.asarray(thr, jnp.float32)
+
+    def _sentinel_rollback(self):
+        """Remediation rung 2: restore the newest intact checkpoint via
+        the PR-3 verified loader; the data pipeline is NOT rewound — its
+        position survives the restore, so the poisoned span is
+        deterministically fast-forwarded past rather than replayed."""
+        load_dir = self.config.integrity.load_dir or self._ckpt_dir
+        if not load_dir:
+            raise TrainingIntegrityError(
+                "sentinel rollback requested (strikes: "
+                f"{self.sentinel.last_anomaly}) but no checkpoint directory "
+                "is known — set integrity.load_dir or save a checkpoint "
+                "before enabling the rollback rung")
+        from_step = self.global_steps
+        position = self.data_position
+        logger.error(
+            "integrity sentinel: rolling back from step %d (%s) to the "
+            "newest intact checkpoint under %s", from_step,
+            self.sentinel.last_anomaly, load_dir)
+        try:
+            # an explicit resolve (newest intact) rather than tag=None: the
+            # post-SDC audited-clean preference must not apply to an
+            # in-run anomaly rollback, where latest-intact is the target
+            tag = ckpt_lib.resolve_load_tag(
+                load_dir, check_digests=self.config.checkpoint.verify_load)
+            self.load_checkpoint(load_dir, tag=tag)
+        except (FileNotFoundError, OSError,
+                ckpt_lib.CheckpointIntegrityError) as e:
+            raise TrainingIntegrityError(
+                f"sentinel rollback from step {from_step} failed: no intact "
+                f"checkpoint under {load_dir} ({e}); aborting with rc "
+                f"{sentinel_lib.INTEGRITY_EXIT_CODE}") from e
+        self.data_position = position
+        self.sentinel.note_rollback(self.global_steps)
+        log_dist(
+            f"integrity sentinel: rolled back to step {self.global_steps} "
+            f"(tag {tag}); data pipeline continues at batch {position} — "
+            "the poisoned span is skipped, not replayed", ranks=[0])
+
+    def fast_forward_dataloader(self, loader, batches_per_step: int = 1):
+        """Deterministically position ``loader`` past the data this
+        engine's (restored) state already consumed: ``data_position``
+        global batches, checkpointed in client state. The resume path
+        after a rollback-abort or an SDC relaunch — re-feeding the
+        poisoned span would re-trigger the very anomaly the restart is
+        recovering from. ``batches_per_step`` scales for loaders yielding
+        microbatches. Returns the number of batches skipped."""
+        ff = getattr(loader, "fast_forward", None)
+        if ff is None:
+            raise TypeError(
+                f"{type(loader).__name__} has no fast_forward(n); wrap it "
+                "in deepspeed_tpu.runtime.dataloader.RepeatingLoader or use "
+                "DeepSpeedDataLoader")
+        n = self.data_position * int(batches_per_step)
+        ff(n)
+        return n
+
+    # -- cross-replica SDC audit ---------------------------------------------
+
+    def _maybe_sdc_audit(self):
+        iv = self.config.integrity.audit_interval
+        if iv <= 0 or self.global_steps % iv != 0:
+            return
+        self._run_sdc_audit()
+
+    def _audit_state_leaves(self):
+        """(path, leaf) for every FULLY-REPLICATED leaf of params + master
+        + optimizer state. Only replicated leaves are auditable: each
+        device holds its own complete copy, so a checksum program with no
+        collectives yields per-device values that MUST agree — a sharded
+        leaf's per-device bytes differ legitimately, and a global
+        reduction would mix a corrupted replica's bytes into every
+        device's answer, hiding the minority."""
+        tree = {"params": self.state.params, "master": self.state.master,
+                "opt_state": self.state.opt_state}
+        out = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is None or not getattr(
+                    sharding, "is_fully_replicated", False):
+                continue
+            if getattr(leaf, "dtype", None) is None or \
+                    leaf.dtype.itemsize not in (1, 2, 4) or leaf.ndim == 0:
+                # scalars (step counters, scale) churn every step and are
+                # cheap to recompute; the audit exists for the big state
+                continue
+            out.append((ckpt_lib.path_str(path), leaf))
+        return out
+
+    def _make_audit_fn(self):
+        """Bit-exact checksum program over the auditable leaves: bitcast
+        to unsigned words, position-weight (so two swapped elements can't
+        cancel), wraparound-sum to one uint32. No collectives — each
+        device audits its own replica's bytes."""
+        def checksum(leaves):
+            total = jnp.zeros((), jnp.uint32)
+            words = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32}
+            for x in leaves:
+                if x.dtype == jnp.bool_:
+                    x = x.astype(jnp.uint8)
+                u = lax.bitcast_convert_type(x, words[x.dtype.itemsize])
+                u = u.astype(jnp.uint32).reshape(-1)
+                # idx+1: every position gets a DISTINCT nonzero weight —
+                # an |1-style weight would give neighbors 2k/2k+1 the same
+                # one, letting a swapped or compensating pair cancel
+                idx = jnp.arange(u.size, dtype=jnp.uint32)
+                total = total + jnp.sum(u * (idx + jnp.uint32(1)))
+            return total
+
+        return jax.jit(checksum)
+
+    def _run_sdc_audit(self):
+        """One cross-replica audit: per-device checksums, a host-side
+        majority vote (cross-process via one small allgather), SDC flag +
+        abort on a minority replica. The audit's device_get happens every
+        ``audit_interval`` steps, never on the step hot path."""
+        # chaos: silent per-process bit corruption, keyed by process index
+        # ("sentinel.sdc:flag:match=1" flips a bit on rank 1 only)
+        if chaos.flag("sentinel.sdc",
+                      key=str(jax.process_index())) is not None:
+            self._inject_sdc_bitflip()
+        named = self._audit_state_leaves()
+        if not named:
+            from ..utils.logging import warning_once
+            warning_once(
+                "integrity.audit_interval is set but no state leaf is "
+                "fully replicated (ZeRO-3 shards everything): the "
+                "cross-replica SDC audit has nothing to compare")
+            return
+        if self._audit_fn is None:
+            self._audit_fn = self._make_audit_fn()
+        out = self._audit_fn(tuple(leaf for _, leaf in named))
+        local = np.asarray(
+            [[jax.process_index(), sh.device.id, int(sh.data)]
+             for sh in out.addressable_shards], np.uint32)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            world = np.asarray(multihost_utils.process_allgather(local))
+            rows = world.reshape(-1, 3)
+        else:
+            rows = local
+        pairs = [(f"proc{int(p)}/dev{int(d)}", int(v)) for p, d, v in rows]
+        bad = sentinel_lib.compare_replica_checksums(pairs)
+        if not bad:
+            self.sentinel.note_clean_audit(self.global_steps)
+            if self._ckpt_dir:
+                tag = ckpt_lib.get_latest_tag(self._ckpt_dir)
+                if tag:
+                    # the newest tag existed under a clean audit: the safe
+                    # resume point for a post-SDC relaunch
+                    sentinel_lib.write_last_audited_clean(self._ckpt_dir,
+                                                          tag)
+            return
+        mine = f"proc{jax.process_index()}/"
+        logger.error(
+            "integrity audit: cross-replica checksum MISMATCH at step %d — "
+            "implicated replicas: %s (checksums: %s)", self.global_steps,
+            bad, pairs)
+        if self.heartbeat is not None and any(k.startswith(mine)
+                                              for k in bad):
+            # blacklist evidence: the elastic agent strikes this host via
+            # the PR-6 quarantine path; bounded lock — the abort below
+            # must not wait on a wedged refresher
+            self.heartbeat.add_flag(sentinel_lib.SDC_FLAG,
+                                    step=self.global_steps,
+                                    lock_timeout=2.0)
+        raise TrainingIntegrityError(
+            f"cross-replica SDC detected at step {self.global_steps}: "
+            f"replica checksums diverged (implicated: {bad}). The live "
+            "state is not trustworthy; relaunch resumes from the last "
+            "audited-clean checkpoint")
+
+    def _inject_sdc_bitflip(self):
+        """Chaos-only: flip one bit in the LAST local device's copy of the
+        first auditable leaf — the userspace approximation of a chip
+        silently corrupting memory (every other replica keeps the true
+        bytes, which is exactly what the majority vote needs)."""
+        named = self._audit_state_leaves()
+        if not named:
+            return
+        path, leaf = next(((p, l) for p, l in named
+                           if p.startswith("params/")), named[0])
+        shards = list(leaf.addressable_shards)
+        bufs = [np.array(np.asarray(s.data)) for s in shards]
+        flat = bufs[-1].view(np.uint8).reshape(-1)
+        flat[0] ^= 1
+        arrs = [jax.device_put(b, s.device) for b, s in zip(bufs, shards)]
+        flipped = jax.make_array_from_single_device_arrays(
+            leaf.shape, leaf.sharding, arrs)
+        tree = {"params": self.state.params, "master": self.state.master,
+                "opt_state": self.state.opt_state}
+        flat_tree, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        leaves = [flipped if ckpt_lib.path_str(p) == path else l
+                  for p, l in flat_tree]
+        new_tree = jax.tree_util.tree_unflatten(treedef, leaves)
+        self.state = self.state.replace(params=new_tree["params"],
+                                        master=new_tree["master"],
+                                        opt_state=new_tree["opt_state"])
+        logger.warning("chaos sentinel.sdc: flipped one bit of %s on "
+                       "device %s", path, shards[-1].device)
 
     # ------------------------------------------------------------- accessors
 
@@ -1545,9 +1853,11 @@ class DeepSpeedEngine:
         step time (save_timeout=0, the default, keeps it unbounded; a
         positive save_timeout bounds a save wedged on dead storage)."""
         with self._phase_scope(hb.PHASE_SAVE):
+            self._ckpt_dir = save_dir      # the sentinel's rollback source
             tag = tag or f"global_step{self.global_steps}"
             client_state = dict(client_state or {})
             client_state["global_steps"] = self.global_steps
+            client_state["data_position"] = self.data_position
             if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "state_dict"):
                 client_state["lr_scheduler"] = self.lr_scheduler.state_dict()
             lazy = getattr(ckpt_engine, "wants_lazy", True)
@@ -1697,6 +2007,8 @@ class DeepSpeedEngine:
 
     def _load_checkpoint_impl(self, load_dir: str, tag: Optional[str],
                               load_module_only: bool):
+        self._ckpt_dir = load_dir          # the sentinel's rollback source
+        tag = self._prefer_audited_clean(load_dir, tag)
         if self.offload is not None:
             return self._load_checkpoint_offload(load_dir, tag, load_module_only)
         loaded, client_state = ckpt_lib.load_checkpoint(
@@ -1712,9 +2024,40 @@ class DeepSpeedEngine:
             self.state = loaded.replace(params=loaded.master, master=())
         if not load_module_only:
             self.global_steps = client_state.get("global_steps", 0)
+            # data-pipeline position: 1 global batch per step unless the
+            # checkpoint recorded better (fast_forward_dataloader consumes)
+            self.data_position = client_state.get("data_position",
+                                                  self.global_steps)
             if self.lr_scheduler is not None and "lr_scheduler" in client_state:
                 self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
         return load_dir, client_state
+
+    def _prefer_audited_clean(self, load_dir: str,
+                              tag: Optional[str]) -> Optional[str]:
+        """With the SDC audit on, a ``tag=None`` resume prefers the
+        ``last_audited_clean`` marker over ``latest``: tags written AFTER
+        the last clean cross-replica audit may carry the corruption the
+        audit later caught. An explicit tag (user intent, or the
+        sentinel's own rollback resolve) is never overridden, and a
+        marker naming a missing/corrupt tag falls back to the normal
+        newest-intact resolution."""
+        if tag is not None or self.config.integrity.audit_interval <= 0:
+            return tag
+        clean = sentinel_lib.read_last_audited_clean(load_dir)
+        if not clean:
+            return None
+        reason = ckpt_lib.verify_tag(
+            os.path.join(load_dir, clean),
+            check_digests=self.config.checkpoint.verify_load)
+        if reason is not None:
+            logger.warning(
+                "integrity: last_audited_clean names %r but it fails "
+                "verification (%s); resuming from newest intact instead",
+                clean, reason)
+            return None
+        log_dist(f"integrity: resuming from last audited-clean checkpoint "
+                 f"'{clean}'", ranks=[0])
+        return clean
 
     def _load_checkpoint_offload(self, load_dir, tag, load_module_only):
         """Offload mode: optimizer state stays host-side numpy — no device
@@ -1757,6 +2100,8 @@ class DeepSpeedEngine:
         client_state = meta.get("client_state", {})
         if not load_module_only:
             self.global_steps = client_state.get("global_steps", 0)
+            self.data_position = client_state.get("data_position",
+                                                  self.global_steps)
             if self.lr_scheduler is not None and "lr_scheduler" in client_state:
                 self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
         return load_dir, client_state

@@ -111,6 +111,8 @@ class HeartbeatWriter:
         self.min_interval = float(min_interval)
         self.refresh_interval = float(refresh_interval)
         self._records: deque = deque(maxlen=max(1, int(keep_records)))
+        self._flags: List[str] = []     # sticky marks (e.g. "SDC"), carried
+        #                                 by every subsequent record
         self._clock = clock or time.time
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -172,6 +174,8 @@ class HeartbeatWriter:
                 return False
             rec = {"rank": self.rank, "host": self.host, "pid": os.getpid(),
                    "phase": phase, "step": int(step), "ts": now}
+            if self._flags:
+                rec["flags"] = list(self._flags)
             self._records.append(rec)
             transition = phase != self._last_phase
             self._last_phase = phase
@@ -188,6 +192,23 @@ class HeartbeatWriter:
 
     def close(self) -> None:
         self._stop.set()
+
+    def add_flag(self, flag: str, step: int = 0,
+                 lock_timeout: Optional[float] = None) -> bool:
+        """Stamp a STICKY mark (e.g. ``SDC``) onto this rank's record:
+        the flag rides every record written from now on, so launcher-side
+        consumers (supervisor/agent blacklist evidence, ``dstpu health``)
+        see it no matter which record they read last. Re-writes the
+        current phase immediately (forced) so the evidence is durable
+        before the caller acts on it — integrity aborts exit right
+        after stamping."""
+        with self._lock:
+            if flag not in self._flags:
+                self._flags.append(flag)
+            phase = self._last_phase or PHASE_INIT
+            last = self._records[-1] if self._records else None
+            step = int(last.get("step", step)) if last is not None else step
+        return self.write(phase, step, force=True, lock_timeout=lock_timeout)
 
     def stamp_terminal(self, phase: str,
                        lock_timeout: Optional[float] = None) -> bool:
@@ -387,3 +408,15 @@ def terminal_records(directory: str) -> Dict[int, dict]:
     flattened the real exit codes."""
     return {rank: rec for rank, rec in read_heartbeats(directory).items()
             if rec.get("phase") in TERMINAL_PHASES}
+
+
+def flagged_ranks(directory: str,
+                  flag: Optional[str] = None) -> Dict[int, dict]:
+    """Ranks whose latest record carries integrity flags — the FLAGS
+    column of ``dstpu health`` (any flag), and with ``flag=`` the
+    blacklist feed: supervisors/agent filter to ``SDC``, the only mark
+    that names a HOST, so the generic ``INTEGRITY`` abort mark (stamped
+    by every rank of a diverged run) never strikes the innocent world."""
+    return {rank: rec for rank, rec in read_heartbeats(directory).items()
+            if rec.get("flags") and
+            (flag is None or flag in rec["flags"])}

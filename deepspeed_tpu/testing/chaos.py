@@ -32,6 +32,7 @@ Spec syntax (env var or ``arm()``)::
     DSTPU_CHAOS="ckpt.write:sleep:ms=300"     # delay, then continue
     DSTPU_CHAOS="run.preempt:sigterm"         # SIGTERM self (preemption)
     DSTPU_CHAOS="host.blackhole:raise:match=w2"  # keyed: only host w2
+    DSTPU_CHAOS="sentinel.spike:flag:factor=1000"  # query-style injection
 
 Run-supervision modes (round-4): ``hang`` blocks the calling thread
 forever — the userspace approximation of a wedged collective, what the
@@ -41,6 +42,12 @@ need an IO operation to still be in flight when something else happens.
 ``sigterm`` sends SIGTERM to the calling process (the installed
 preemption handler fires, exactly like a real TPU preemption notice).
 ``kill`` takes ``code=N`` to emulate any exit-code contract.
+
+Query mode (round-7, the training-integrity sentinel): ``flag`` never
+raises or kills — production code ASKS :func:`flag` whether the site is
+armed and fired, and perturbs its own data when it is (a grad spike
+scales the batch, an SDC fault flips a bit in one replica's weights).
+The ``factor=N`` option carries the perturbation magnitude.
 
 reference counterpart: DeepSpeed's tests monkeypatch torch.save /
 simulate SIGTERM by hand per test; a named-failpoint registry is the
@@ -77,16 +84,16 @@ class ChaosError(IOError):
         self.failpoint = name
 
 
-_MODES = ("raise", "kill", "hang", "sleep", "sigterm")
+_MODES = ("raise", "kill", "hang", "sleep", "sigterm", "flag")
 
 
 class _FailPoint:
     __slots__ = ("name", "mode", "skip", "times", "hits", "fired", "code",
-                 "ms", "match")
+                 "ms", "match", "factor")
 
     def __init__(self, name: str, mode: str, skip: int = 0, times: int = 1,
                  code: Optional[int] = None, ms: int = 0,
-                 match: Optional[str] = None):
+                 match: Optional[str] = None, factor: int = 1):
         if mode not in _MODES:
             raise ValueError(f"chaos mode must be one of {_MODES}, "
                              f"got {mode!r}")
@@ -97,6 +104,7 @@ class _FailPoint:
         self.code = KILL_EXIT_CODE if code is None else code
         self.ms = ms        # sleep mode: delay in milliseconds
         self.match = match  # keyed failpoints: fire only when key == match
+        self.factor = factor  # flag mode: perturbation magnitude
         self.hits = 0       # total traversals of this failpoint
         self.fired = 0      # times it actually failed
 
@@ -119,7 +127,7 @@ def parse_spec(spec: str) -> Dict[str, _FailPoint]:
             if k == "match":            # keyed failpoints take a STRING
                 kwargs[k] = v           # (e.g. match=worker-2 on
                 continue                # host.blackhole)
-            if k not in ("skip", "times", "code", "ms"):
+            if k not in ("skip", "times", "code", "ms", "factor"):
                 raise ValueError(f"bad chaos spec option {f!r} in {part!r}")
             kwargs[k] = int(v)
         out[name] = _FailPoint(name, mode, **kwargs)
@@ -139,13 +147,14 @@ def _load_env_once() -> None:
 
 def arm(name: str, mode: str = "raise", skip: int = 0, times: int = 1,
         code: Optional[int] = None, ms: int = 0,
-        match: Optional[str] = None) -> None:
+        match: Optional[str] = None, factor: int = 1) -> None:
     """Programmatically arm a failpoint (in-process tests). ``match``
     restricts a KEYED failpoint to one key — e.g. ``host.blackhole``
     with ``match="worker-2"`` only fires for that host's dispatch."""
     with _lock:
         _armed[name] = _FailPoint(name, mode, skip=skip, times=times,
-                                  code=code, ms=ms, match=match)
+                                  code=code, ms=ms, match=match,
+                                  factor=factor)
 
 
 def disarm(name: Optional[str] = None) -> None:
@@ -226,4 +235,33 @@ def failpoint(name: str, key: Optional[str] = None) -> None:
     if mode == "sigterm":
         os.kill(os.getpid(), signal.SIGTERM)
         return
+    if mode == "flag":
+        return          # query sites use flag(); traversal alone is inert
     raise ChaosError(name)
+
+
+def flag(name: str, key: Optional[str] = None) -> Optional[int]:
+    """Query-style failpoint: the injection magnitude (``factor``) when an
+    armed ``flag``-mode spec fires at this traversal, else ``None``.
+
+    Unlike :func:`failpoint`, the site itself performs the perturbation —
+    this only answers "should I, and how hard?". Hit/skip/times/match
+    accounting is identical, so a spec like
+    ``sentinel.spike:flag:skip=10:times=3:factor=1000`` scales exactly
+    steps 11-13 and nothing else."""
+    if not _env_loaded:
+        _load_env_once()
+    if not _armed:
+        return None
+    with _lock:
+        fp = _armed.get(name)
+        if fp is None or fp.mode != "flag":
+            return None
+        if fp.match is not None and key != fp.match:
+            return None
+        fp.hits += 1
+        if fp.hits <= fp.skip or fp.fired >= fp.times:
+            return None
+        fp.fired += 1
+        _history.append(name)
+        return fp.factor

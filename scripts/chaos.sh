@@ -7,6 +7,11 @@
 # backend kill path), blackholed-host blacklisting with degraded-world
 # elastic resume, connect retries, rc-114 end-to-end through dstpu
 # --elastic, and the per-rank failpoint in the REAL 2-process sharded save.
+# Round 7 adds the training-integrity matrices: chaos grad spike -> in-jit
+# skip with loss parity, spike storm -> verified rollback + data
+# fast-forward, post-rollback reproduction -> rc-118 abort, and the
+# cross-replica SDC bit-flip -> detection + host attribution (single-proc
+# 8-device vote and the REAL 2-process world).
 # Includes the `slow`-marked engine-in-child tests tier-1 skips.
 # See docs/RESILIENCE.md for the failpoint catalog and exit-code contract.
 #
@@ -21,9 +26,11 @@ unset DSTPU_CHAOS
 
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py \
+    tests/test_sentinel.py \
     tests/test_supervisor.py \
     tests/test_heartbeat.py \
     tests/test_multinode_runner.py \
     tests/test_launcher_elastic.py \
     "tests/test_multiprocess.py::test_two_process_sharded_save_with_per_rank_failpoint" \
+    "tests/test_multiprocess.py::test_two_process_sdc_bitflip_detected_and_attributed" \
     -q -p no:cacheprovider "$@"

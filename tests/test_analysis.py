@@ -1217,6 +1217,74 @@ def test_fix_respects_inline_suppression(tmp_path):
     assert f.read_text() == src                  # suppressed: untouched
 
 
+def test_fix_tpu009_casts_carry_back_preserving_f32_island(tmp_path):
+    """Round-7 satellite: the TPU009 autofix appends ``.astype(<init
+    dtype>)`` to the widened carry expression — the f32 math INSIDE stays
+    (accumulate in an f32 island), the carry dtype goes back to the
+    init's own 16-bit token."""
+    f = tmp_path / "scan9.py"
+    f.write_text(textwrap.dedent("""\
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+
+        def run(xs):
+            def body(c, x):
+                c = (c + x).astype(jnp.float32)
+                return c, x
+            init = jnp.zeros((8,), jnp.bfloat16)
+            return lax.scan(body, init, xs)
+    """))
+    proc = _run_cli([str(f), "--no-baseline", "--fix"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fixed = f.read_text()
+    assert "(c + x).astype(jnp.float32).astype(jnp.bfloat16)" in fixed
+    findings = lint_paths([str(f)], root=str(tmp_path))
+    assert not [x for x in findings if x.gating]
+    # idempotent: a second pass edits nothing
+    assert _run_cli([str(f), "--no-baseline", "--fix"]).returncode == 0
+    assert f.read_text() == fixed
+
+
+def test_fix_tpu009_inline_init_and_fp16_token(tmp_path):
+    """The cast-back uses the init's OWN dtype token (fp16 init -> fp16
+    cast), including when the init is inline in the scan call."""
+    f = tmp_path / "scan9b.py"
+    f.write_text(textwrap.dedent("""\
+        import jax.numpy as jnp
+        from jax import lax
+
+
+        def run(xs):
+            def inner(c, x):
+                return jnp.float32(c + x), x
+            return lax.scan(inner, jnp.zeros((8,), jnp.float16), xs)
+    """))
+    assert _run_cli([str(f), "--no-baseline", "--fix"]).returncode == 0
+    assert "jnp.float32(c + x).astype(jnp.float16)" in f.read_text()
+    findings = lint_paths([str(f)], root=str(tmp_path))
+    assert not [x for x in findings if x.gating]
+
+
+def test_fix_tpu009_respects_inline_suppression(tmp_path):
+    f = tmp_path / "keep9.py"
+    src = textwrap.dedent("""\
+        import jax.numpy as jnp
+        from jax import lax
+
+
+        def run(xs):
+            def body(c, x):
+                # graftlint: disable=TPU009 (intentional f32 upgrade)
+                return jnp.float32(c + x), x
+            return lax.scan(body, jnp.zeros((8,), jnp.bfloat16), xs)
+    """)
+    f.write_text(src)
+    assert _run_cli([str(f), "--no-baseline", "--fix"]).returncode == 0
+    assert f.read_text() == src                  # suppressed: untouched
+
+
 # ------------------------------------------------------------------- SARIF
 
 def test_sarif_format_and_file_output(tmp_path):

@@ -1,0 +1,91 @@
+"""Round-7 satellite: the 8-chip dryrun wall clock is a tracked metric.
+
+``__graft_entry__._dryrun_multichip_impl`` stamps a ``wall=N.Ns`` suffix
+on every leg's ok: line plus one machine-readable summary line; the
+driver's MULTICHIP report captures the tail, and the next round's run
+compares per-leg timings against the newest usable report — so an
+r04-style timeout shows up as a named per-leg regression instead of a
+mystery. These tests pin the parse/compare/baseline-discovery halves
+(plain python, no jax)."""
+
+import json
+
+import __graft_entry__ as entry
+
+
+def test_parse_leg_timings_prefers_summary_line():
+    text = (
+        "dryrun_multichip ok: n=8 mesh(dp=2,sp=2,tp=2) loss=9.1 wall=41.3s\n"
+        "dryrun_multichip moe ok: mesh(ep=2,dp=4) loss=8.8 wall=95.0s\n"
+        'dryrun_multichip timings: {"spmd": 41.3, "moe": 96.2}\n')
+    got = entry.parse_leg_timings(text)
+    assert got["spmd"] == 41.3
+    assert got["moe"] == 96.2            # summary wins over the suffix
+
+
+def test_parse_leg_timings_per_leg_fallback_on_truncated_run():
+    # the r04 shape: the outer timeout fired BEFORE the summary line —
+    # exactly the run where per-leg timing matters most
+    text = (
+        "dryrun_multichip ok: n=8 mesh(dp=2,sp=2,tp=2) loss=9.1 wall=40.0s\n"
+        "dryrun_multichip pipeline ok: mesh(pp=2,dp=2,tp=2) loss=9.0 "
+        "wall=120.5s\n")
+    got = entry.parse_leg_timings(text)
+    assert got == {"spmd": 40.0, "pipeline": 120.5}
+    assert entry.parse_leg_timings("no timings here") == {}
+
+
+def test_check_timing_regression_flags_slow_and_missing_legs():
+    baseline = {"spmd": 40.0, "pipeline": 100.0, "moe": 60.0}
+    current = {"spmd": 41.0, "pipeline": 250.0}
+    problems = entry.check_timing_regression(current, baseline, factor=2.0)
+    text = "\n".join(problems)
+    assert "pipeline" in text and "2.5x" in text
+    assert "moe" in text and "missing" in text
+    assert "spmd" not in text            # within budget
+    assert entry.check_timing_regression(baseline, baseline) == []
+
+
+def test_check_timing_regression_tolerates_host_speed_noise():
+    # CI hosts vary ~30% run to run: 1.9x is inside the 2x default budget
+    baseline = {"spmd": 40.0}
+    assert entry.check_timing_regression({"spmd": 76.0}, baseline) == []
+    assert entry.check_timing_regression({"spmd": 81.0}, baseline)
+
+
+def test_timings_carry_device_count_and_baseline_filters_on_it(tmp_path):
+    # an n=8 round's baseline must not judge an n=1 run: odd n skips most
+    # legs legitimately, and cross-n wall clocks aren't comparable
+    (tmp_path / "MULTICHIP_r06.json").write_text(json.dumps(
+        {"ok": True, "tail": 'dryrun_multichip timings: '
+                             '{"spmd": 40.0, "moe": 90.0, "n": 8}\n'}))
+    got = entry.parse_leg_timings(
+        'dryrun_multichip timings: {"spmd": 40.0, "n": 8}\n')
+    assert got == {"spmd": 40.0, "n": 8.0}
+    name, t = entry.latest_multichip_timings(str(tmp_path), n_devices=8)
+    assert name == "MULTICHIP_r06.json" and t == {"spmd": 40.0, "moe": 90.0}
+    assert entry.latest_multichip_timings(str(tmp_path), n_devices=1) == \
+        (None, {})
+
+
+def test_parse_leg_timings_ignores_unknown_legs():
+    # DRYRUN_LEGS is the key universe: stray wall= noise in a captured
+    # tail can never invent a leg for the regression check to miss later
+    text = ('dryrun_multichip bogus ok: loss=1 wall=5.0s\n'
+            'dryrun_multichip moe ok: loss=1 wall=60.0s\n')
+    assert entry.parse_leg_timings(text) == {"moe": 60.0}
+
+
+def test_latest_multichip_timings_skips_failed_and_untimed(tmp_path):
+    (tmp_path / "MULTICHIP_r02.json").write_text(json.dumps(
+        {"ok": True, "tail": "dryrun_multichip ok: loss=1 wall=33.0s\n"}))
+    (tmp_path / "MULTICHIP_r03.json").write_text(json.dumps(
+        {"ok": True, "tail": "no timing suffixes in this round"}))
+    (tmp_path / "MULTICHIP_r04.json").write_text(json.dumps(
+        {"ok": False, "tail": "dryrun_multichip ok: loss=1 wall=99.0s\n"}))
+    (tmp_path / "MULTICHIP_r05.json").write_text("{ torn json")
+    name, timings = entry.latest_multichip_timings(str(tmp_path))
+    assert name == "MULTICHIP_r02.json"  # newest USABLE report
+    assert timings == {"spmd": 33.0}
+    assert entry.latest_multichip_timings(str(tmp_path / "none")) == (None,
+                                                                      {})

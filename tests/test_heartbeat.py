@@ -199,6 +199,51 @@ def test_clear_channel_scopes_dir_to_one_run(tmp_path):
     hb.clear_channel(str(tmp_path / "missing"))  # no raise
 
 
+def test_add_flag_is_sticky_and_immediately_durable(tmp_path):
+    """An integrity flag (round 7: the SDC audit's blacklist evidence)
+    publishes immediately — the abort follows right behind the stamp —
+    and rides EVERY later record, so a consumer reading the newest record
+    at any time sees it."""
+    t = [1000.0]
+    w = _writer(tmp_path, rank=2, host="w2", min_interval=30.0,
+                clock=lambda: t[0])
+    w.write(hb.PHASE_STEP, 40, force=True)
+    assert w.add_flag("SDC", step=40)              # forced past the throttle
+    rec = hb.read_heartbeats(str(tmp_path))[2]
+    assert rec["flags"] == ["SDC"] and rec["step"] == 40
+    w.add_flag("SDC")                              # idempotent: no dup
+    w.write(hb.PHASE_STEP, 41, force=True)
+    rec = hb.read_heartbeats(str(tmp_path))[2]
+    assert rec["flags"] == ["SDC"] and rec["step"] == 41
+
+
+def test_flagged_ranks_reads_only_marked_records(tmp_path):
+    w0 = _writer(tmp_path, rank=0, host="w0")
+    w0.write(hb.PHASE_STEP, 10, force=True)
+    w1 = _writer(tmp_path, rank=1, host="w1")
+    w1.write(hb.PHASE_STEP, 10, force=True)
+    w1.add_flag("SDC")
+    flagged = hb.flagged_ranks(str(tmp_path))
+    assert list(flagged) == [1]
+    assert flagged[1]["host"] == "w1" and "SDC" in flagged[1]["flags"]
+    assert hb.flagged_ranks(str(tmp_path / "missing")) == {}
+
+
+def test_flagged_ranks_flag_filter_separates_sdc_from_integrity(tmp_path):
+    """Blacklist consumers filter to SDC — the generic INTEGRITY mark
+    (every rank of an rc-118 abort carries it, for dstpu health) must
+    never become host evidence."""
+    w0 = _writer(tmp_path, rank=0, host="w0")
+    w0.write(hb.PHASE_STEP, 10, force=True)
+    w0.add_flag("INTEGRITY")
+    w1 = _writer(tmp_path, rank=1, host="w1")
+    w1.write(hb.PHASE_STEP, 10, force=True)
+    w1.add_flag("SDC")
+    w1.add_flag("INTEGRITY")
+    assert sorted(hb.flagged_ranks(str(tmp_path))) == [0, 1]
+    assert list(hb.flagged_ranks(str(tmp_path), flag="SDC")) == [1]
+
+
 def test_writer_host_prefers_hostfile_vocabulary_env(tmp_path,
                                                      monkeypatch):
     """launch.py exports the operator's hostfile name for this rank;

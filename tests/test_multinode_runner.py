@@ -215,6 +215,23 @@ def test_backend_supervisor_stalled_evidence_beats_scheduler_rc(tmp_path):
     assert sup.failed_hosts() == ["w1"]
 
 
+@pytest.mark.slow
+def test_backend_supervisor_sdc_flag_names_host_scheduler_rc_cannot(
+        tmp_path):
+    """The scheduler flattens every rank's rc 118 into one step rc; the
+    flagged heartbeat record is the only per-host SDC attribution."""
+    hb_dir = tmp_path / "hb"
+    sup = BackendSupervisor(
+        [PY, "-c", "import time; time.sleep(0.8); raise SystemExit(118)"],
+        heartbeat_dir=str(hb_dir), heartbeat_poll=0.05,
+        stream=io.StringIO()).start()
+    w = hb.HeartbeatWriter(str(hb_dir), 1, host="w2", refresh_interval=0)
+    w.write(hb.PHASE_STEP, 50, force=True)
+    w.add_flag("SDC")
+    assert sup.wait(timeout=60) == 118
+    assert sup.failed_hosts() == ["w2"]
+
+
 def test_backend_supervisor_clean_exit_wins_over_old_noise(tmp_path):
     """The channel is run-scoped: a reused dir holding a PREVIOUS run's
     STALLED verdict and a stale mid-step record must not reconstruct a
@@ -307,3 +324,33 @@ def test_dstpu_health_subcommand(tmp_path, capsys):
     assert "w1" in out and "STALLED" in out and "wedged" in out
     # empty channel: nothing provably alive
     assert health_main([str(tmp_path / "empty")]) == 1
+
+
+def test_dstpu_health_flags_column_and_rc(tmp_path, capsys):
+    """Round-7 satellite: integrity flags (SDC from the cross-replica
+    audit) surface in a FLAGS column and flip the exit code — a host
+    whose numbers can't be trusted is operator news even while its
+    process is alive and stepping."""
+    from deepspeed_tpu.launcher.runner import health_main
+    w0 = hb.HeartbeatWriter(str(tmp_path), 0, host="w0", refresh_interval=0)
+    w0.write(hb.PHASE_STEP, 200, force=True)
+    w1 = hb.HeartbeatWriter(str(tmp_path), 1, host="w1", refresh_interval=0)
+    w1.write(hb.PHASE_STEP, 200, force=True)
+    w1.add_flag("SDC")
+    w1.stamp_terminal(hb.PHASE_EXIT)
+    rc = health_main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "FLAGS" in out
+    lines = {ln.split()[0]: ln for ln in out.splitlines() if ln.strip()}
+    assert "SDC" in lines["1"] and "rc 118" in lines["1"]
+    # a flagged EXIT is a concluded integrity abort, never a "clean exit"
+    assert "clean exit" not in lines["1"]
+    assert "SDC" not in lines["0"]
+    w0.write(hb.PHASE_STEP, 201, force=True)      # unflagged world: rc 0
+    import shutil
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    shutil.copy(w0.path, clean / "rank0.hb")
+    assert health_main([str(clean)]) == 0
+    capsys.readouterr()

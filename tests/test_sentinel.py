@@ -1,0 +1,521 @@
+"""Training-integrity sentinel (round 7): rolling robust detector,
+remediation ladder (in-jit skip -> verified rollback + data fast-forward
+-> rc-118 abort), folded non-finite guard, cross-replica SDC audit, and
+the audited-clean resume marker.
+
+The plain-python halves (RollingRobust, observe() ladder, checksum vote,
+markers, config shim, dataloader fast-forward) are tier-1 sub-second.
+The engine-in-anger chaos matrices (spike->skip parity, spike-storm->
+rollback, post-rollback abort, SDC bit-flip) build real engines and are
+``slow``-marked — ``scripts/chaos.sh`` runs them; the compile-count and
+single-device-get gates stay tier-1 because they pin the acceptance
+criterion that the sentinel adds ZERO extra device syncs.
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config import DeepSpeedConfig
+from deepspeed_tpu.config.config import IntegrityConfig
+from deepspeed_tpu.runtime import sentinel as sl
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              RepeatingLoader)
+from deepspeed_tpu.runtime.sentinel import (NonFiniteError, RollingRobust,
+                                            TrainingIntegrityError,
+                                            TrainingSentinel,
+                                            compare_replica_checksums)
+from deepspeed_tpu.testing import chaos
+from tests.util import SimpleModel, batch_stream, random_batch
+
+
+# ------------------------------------------------------------ RollingRobust
+
+def test_rolling_robust_median_mad():
+    r = RollingRobust(window=8)
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0):
+        r.push(v)
+    med, sigma = r.stats()
+    assert med == 3.0
+    assert sigma == pytest.approx(1.4826, rel=1e-6)    # MAD = 1.0
+
+
+def test_rolling_robust_needs_four_samples():
+    r = RollingRobust(window=8)
+    for v in (1.0, 2.0, 3.0):
+        r.push(v)
+    assert r.stats() is None and r.zscore(10.0) is None \
+        and r.threshold(3.0) is None
+
+
+def test_rolling_robust_outlier_cannot_drag_baseline():
+    # the median/MAD baseline must survive the very anomaly it detects —
+    # a mean/std would be dragged by the 1e6 sample, a median is not
+    r = RollingRobust(window=16)
+    for v in (1.0, 1.1, 0.9, 1.0, 1.05, 0.95):
+        r.push(v)
+    z_before = r.zscore(1e6)
+    r.push(1e6)
+    med, _ = r.stats()
+    assert med < 1.2
+    assert r.zscore(1e6) > 0.5 * z_before
+
+
+def test_rolling_robust_flat_warmup_sigma_floor():
+    # a perfectly flat window (MAD 0) must not turn the first jitter into
+    # an anomaly: sigma is floored at 1e-3 x max(|median|, 1)
+    r = RollingRobust(window=8)
+    for _ in range(6):
+        r.push(10.0)
+    med, sigma = r.stats()
+    assert med == 10.0 and sigma == pytest.approx(0.01)
+    assert r.zscore(10.001) < 1.0
+
+
+def test_rolling_robust_window_bound():
+    r = RollingRobust(window=4)
+    for v in range(100):
+        r.push(float(v))
+    assert len(r) == 4
+    assert r.stats()[0] == pytest.approx(97.5)
+
+
+# ------------------------------------------------------- observe() ladder
+
+def _sentinel(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("metrics", ["loss", "grad_norm"])
+    kw.setdefault("window", 16)
+    kw.setdefault("zmax", 5.0)
+    kw.setdefault("warmup_steps", 4)
+    kw.setdefault("cooldown_steps", 0)
+    kw.setdefault("rollback_after", 2)
+    kw.setdefault("strike_window", 10)
+    kw.setdefault("abort_after_rollbacks", 1)
+    return TrainingSentinel(IntegrityConfig(**kw))
+
+
+def _feed_clean(s, n, start=0):
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        v = 1.0 + 0.01 * float(rng.standard_normal())
+        assert s.observe(start + i, {"loss": v, "grad_norm": v}) == sl.OK
+    return start + n
+
+
+def test_observe_warmup_no_verdict():
+    s = _sentinel(warmup_steps=10)
+    # wild values during warmup: samples accumulate, nothing strikes
+    for i, v in enumerate((1.0, 50.0, 2.0, 80.0, 1.5)):
+        assert s.observe(i, {"loss": v, "grad_norm": v}) == sl.OK
+    assert not s.strikes
+
+
+def test_observe_spike_strikes_then_rolls_back_then_aborts():
+    s = _sentinel()
+    step = _feed_clean(s, 6)
+    spike = {"loss": 100.0, "grad_norm": 100.0}
+    assert s.observe(step, spike) == sl.STRIKE
+    assert s.observe(step + 1, spike) == sl.ROLLBACK       # rollback_after=2
+    s.note_rollback(restored_step=step - 2)
+    assert s.rollbacks_done == 1
+    # the anomaly reproduces post-rollback: ladder rung 3
+    t = step + 2
+    assert s.observe(t, spike) == sl.STRIKE
+    with pytest.raises(TrainingIntegrityError) as ei:
+        s.observe(t + 1, spike)
+    assert ei.value.exit_code == sl.INTEGRITY_EXIT_CODE == 118
+
+
+def test_observe_cooldown_counts_one_event_once():
+    s = _sentinel(cooldown_steps=5, rollback_after=3)
+    step = _feed_clean(s, 6)
+    spike = {"loss": 100.0, "grad_norm": 100.0}
+    assert s.observe(step, spike) == sl.STRIKE
+    assert s.observe(step + 1, spike) == sl.COOLDOWN       # same event
+    assert s.observe(step + 2, spike) == sl.COOLDOWN
+    assert len(s.strikes) == 1
+
+
+def test_observe_strikes_age_out_of_window():
+    s = _sentinel(rollback_after=2, strike_window=5)
+    step = _feed_clean(s, 6)
+    spike = {"loss": 100.0, "grad_norm": 100.0}
+    assert s.observe(step, spike) == sl.STRIKE
+    step = _feed_clean(s, 8, start=step + 1)               # > strike_window
+    assert s.observe(step, spike) == sl.STRIKE             # not ROLLBACK
+
+
+def test_observe_clean_stretch_retires_rollback_arm():
+    s = _sentinel(strike_window=5)
+    step = _feed_clean(s, 6)
+    s.note_rollback(restored_step=step)
+    assert s.rollbacks_done == 1
+    _feed_clean(s, 8, start=step + 1)                      # > strike_window
+    assert s.rollbacks_done == 0                           # rollback worked
+
+
+def test_observe_anomalous_sample_never_pollutes_baseline():
+    s = _sentinel(cooldown_steps=0, rollback_after=99)
+    step = _feed_clean(s, 8)
+    med_before = s.stats["loss"].stats()[0]
+    for i in range(4):
+        assert s.observe(step + i,
+                         {"loss": 100.0, "grad_norm": 100.0}) == sl.STRIKE
+    assert s.stats["loss"].stats()[0] == pytest.approx(med_before)
+
+
+def test_observe_in_jit_skip_strikes_without_baseline_damage():
+    s = _sentinel(rollback_after=99)
+    step = _feed_clean(s, 6)
+    accepted = s.accepted
+    v = s.observe(step, {"loss": 1.0, "grad_norm": 50.0, "anomaly_skip": 1})
+    assert v == sl.STRIKE
+    assert "batch skipped" in s.last_anomaly
+    assert s.accepted == accepted                  # skipped step: no sample
+
+
+def test_nonfinite_fold_raises_even_with_detector_off():
+    # the PR-3 nonfinite_guard semantics live in the SAME observe() path
+    s = TrainingSentinel(IntegrityConfig(enabled=False,
+                                         nonfinite_abort_after=3))
+    assert s.observe(5, {"nonfinite_streak": 2}) == sl.OK
+    with pytest.raises(NonFiniteError) as ei:
+        s.observe(6, {"nonfinite_streak": 3})
+    assert isinstance(ei.value, TrainingIntegrityError)
+    assert ei.value.exit_code == 118
+
+
+def test_disabled_sentinel_is_inert():
+    s = TrainingSentinel(IntegrityConfig(enabled=False))
+    assert not s.wants_every_step
+    assert s.spike_limit() is None
+    assert s.observe(1, {"loss": float("inf")}) == sl.OK
+
+
+def test_spike_limit_inf_during_warmup_then_finite():
+    s = _sentinel(warmup_steps=4, zmax=5.0)
+    assert s.spike_limit() == math.inf             # arg shape never changes
+    _feed_clean(s, 6)
+    thr = s.spike_limit()
+    assert math.isfinite(thr) and thr > 1.0
+    s2 = _sentinel(skip=False)
+    assert s2.spike_limit() is None                # rung 1 off: no jit arm
+
+
+def test_spike_limit_arms_even_without_grad_norm_in_metrics():
+    # dropping grad_norm from cfg.metrics must not silently kill the skip
+    # rung: its stats are tracked whenever skip is on
+    s = _sentinel(metrics=["loss"])
+    _feed_clean(s, 6)
+    assert math.isfinite(s.spike_limit())
+
+
+# --------------------------------------------------------- checksum vote
+
+def test_checksum_vote_unanimous_and_minority():
+    assert compare_replica_checksums([("a", 1), ("b", 1), ("c", 1)]) == []
+    assert compare_replica_checksums(
+        [("a", 1), ("b", 1), ("c", 2)]) == ["c"]
+    assert compare_replica_checksums(
+        [("a", 7), ("b", 3), ("c", 7), ("d", 7)]) == ["b"]
+
+
+def test_checksum_vote_tie_implicates_everyone():
+    # 1-vs-1: the mismatch is certain, the culprit is not
+    assert set(compare_replica_checksums([("a", 1), ("b", 2)])) == {"a", "b"}
+    assert set(compare_replica_checksums(
+        [("a", 1), ("b", 1), ("c", 2), ("d", 2)])) == {"a", "b", "c", "d"}
+
+
+def test_checksum_vote_degenerate_inputs():
+    assert compare_replica_checksums([]) == []
+    assert compare_replica_checksums([("a", 1)]) == []
+
+
+def test_audited_clean_marker_roundtrip(tmp_path):
+    assert sl.read_last_audited_clean(str(tmp_path)) is None
+    sl.write_last_audited_clean(str(tmp_path), "global_step40")
+    assert sl.read_last_audited_clean(str(tmp_path)) == "global_step40"
+    sl.write_last_audited_clean(str(tmp_path), "global_step50")
+    assert sl.read_last_audited_clean(str(tmp_path)) == "global_step50"
+    assert os.listdir(str(tmp_path)) == [sl.LAST_AUDITED_CLEAN_FILE]
+    # failures are swallowed: the marker is an optimization, never a gate
+    sl.write_last_audited_clean(str(tmp_path / "no" / "such"), "t")
+
+
+# ----------------------------------------------------------- config shim
+
+def test_nonfinite_guard_alias_folds_into_integrity():
+    cfg = DeepSpeedConfig(nonfinite_guard={"abort_after": 7})
+    assert cfg.integrity.nonfinite_abort_after == 7
+
+
+def test_explicit_integrity_wins_over_alias():
+    cfg = DeepSpeedConfig(nonfinite_guard={"abort_after": 7},
+                          integrity={"nonfinite_abort_after": 3})
+    assert cfg.integrity.nonfinite_abort_after == 3
+
+
+# ------------------------------------------------- dataloader fast-forward
+
+def _loader(n=64, batch=8, **kw):
+    data = [np.asarray([i], np.float32) for i in range(n)]
+    return DeepSpeedDataLoader(data, batch_size=batch, **kw)
+
+
+def test_dataloader_fast_forward_matches_uninterrupted_stream():
+    a, b = _loader(), _loader()                    # 8 batches/epoch
+    stream = RepeatingLoader(a)
+    for _ in range(11):                            # 1 epoch + 3 batches
+        next(stream)
+    b.fast_forward(11)
+    np.testing.assert_array_equal(next(iter(b)), next(stream))
+    assert b.epoch == a.epoch
+
+
+def test_dataloader_fast_forward_one_partial_epoch_then_full():
+    dl = _loader(n=32, batch=8)                    # 4 batches/epoch
+    dl.fast_forward(6)
+    assert dl.epoch == 1
+    first_epoch = list(dl)
+    assert len(first_epoch) == 2                   # resumes mid-epoch
+    assert len(list(dl)) == 4                      # then full epochs again
+
+
+def test_dataloader_forwards_epoch_to_sampler():
+    # the torch set_epoch idiom: an epoch-aware sampler re-derives its
+    # order per epoch, which keeps fast_forward honest for it too
+    class Sampler:
+        def __init__(self):
+            self.epochs = []
+
+        def set_epoch(self, e):
+            self.epochs.append(e)
+
+        def __iter__(self):
+            return iter(range(16))
+
+    smp = Sampler()
+    dl = DeepSpeedDataLoader([np.asarray([i]) for i in range(16)],
+                             batch_size=4, data_sampler=smp)
+    dl.fast_forward(6)                             # epoch 1, batch 2
+    assert len(list(dl)) == 2
+    assert smp.epochs == [1]
+
+
+def test_repeating_loader_fast_forward_delegates_and_drains():
+    inner = _loader(n=16, batch=4)
+    rep = RepeatingLoader(inner)
+    rep.fast_forward(5)                            # delegates O(1)
+    assert inner.epoch == 1
+    ref = RepeatingLoader(_loader(n=16, batch=4))
+    for _ in range(5):
+        next(ref)
+    np.testing.assert_array_equal(next(rep), next(ref))
+    # a bare iterable has no fast_forward: RepeatingLoader drains
+    rep2 = RepeatingLoader([np.asarray([i]) for i in range(6)])
+    rep2.fast_forward(2)
+    np.testing.assert_array_equal(next(rep2), np.asarray([2]))
+
+
+# ------------------------------------------------------ engine integration
+
+def _engine(extra_integrity=None, stage=1, **cfg_extra):
+    integ = {"enabled": True, "warmup_steps": 6, "window": 16,
+             "zmax": 6.0, "cooldown_steps": 0}
+    integ.update(extra_integrity or {})
+    cfg = {
+        "train_batch_size": 32,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+        "gradient_clipping": 1.0,
+        "bf16": {"enabled": True},
+        "steps_per_print": 1000,
+        "integrity": integ,
+    }
+    cfg.update(cfg_extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(), config=cfg, example_batch=random_batch(4))
+    return engine
+
+
+def test_sentinel_stats_add_zero_extra_device_syncs(monkeypatch):
+    """Acceptance gate: with the detector ON (every-step host feed),
+    _after_step still performs exactly ONE batched device_get per step and
+    the train step still compiles once. The sentinel's statistics ride the
+    existing pull; the spike-limit feed is a device scalar argument."""
+    import jax
+    engine = _engine()
+    cache_size = getattr(engine._train_step, "_cache_size", None)
+    stream = batch_stream(engine.config.train_batch_size)
+    engine.train_batch(next(stream))               # compile outside count
+    real = jax.device_get
+    calls = []
+    monkeypatch.setattr(jax, "device_get",
+                        lambda x: calls.append(1) or real(x))
+    for _ in range(3):
+        engine.train_batch(next(stream))
+    assert len(calls) == 3, (
+        f"{len(calls)} device_get calls across 3 steps — the sentinel "
+        "must ride the ONE batched _after_step pull")
+    if cache_size is not None:
+        assert cache_size() == 1, (
+            f"train step traced {cache_size()}x with integrity enabled")
+
+
+@pytest.mark.slow
+def test_chaos_spike_skipped_in_jit_reaches_loss_parity():
+    """Ladder rung 1 end-to-end: a chaos-poisoned batch (x1e4 features)
+    is skipped IN-JIT by the sentinel's grad-norm ceiling — state
+    untouched, streak counted — and the run trains through to loss parity
+    with an uninjected twin."""
+    import jax
+    clean = _engine()
+    stream = batch_stream(clean.config.train_batch_size)
+    clean_losses = [float(jax.device_get(
+        clean.train_batch(next(stream))["loss"])) for _ in range(30)]
+
+    chaos.arm("sentinel.spike", "flag", skip=14, times=1, factor=10000)
+    eng = _engine()
+    stream = batch_stream(eng.config.train_batch_size)
+    skipped_at = []
+    losses = []
+    for i in range(30):
+        m = eng.train_batch(next(stream))
+        losses.append(float(jax.device_get(m["loss"])))
+        if "anomaly_skip" in m and bool(np.asarray(
+                jax.device_get(m["anomaly_skip"]))):
+            skipped_at.append(i + 1)
+    assert skipped_at == [15], skipped_at
+    assert int(jax.device_get(eng.state.skipped_steps)) == 1
+    assert eng.sentinel.rollbacks_done == 0        # rung 1 was enough
+    # loss parity with the uninjected twin: the poisoned batch cost one
+    # skipped update and zero state damage
+    assert losses[-1] < losses[0] * 0.8
+    assert losses[-1] == pytest.approx(clean_losses[-1], rel=0.25)
+
+
+@pytest.mark.slow
+def test_chaos_spike_storm_rolls_back_and_fast_forwards(tmp_path):
+    """Ladder rung 2 end-to-end: with the skip rung off, a 3-batch spike
+    storm damages state, strikes out the window, and the engine restores
+    the last intact tag via the verified loader — while data_position is
+    NOT rewound, so the poisoned span is never replayed."""
+    import jax
+    eng = _engine({"skip": False, "rollback_after": 3, "strike_window": 20,
+                   "abort_after_rollbacks": 1})
+    stream = batch_stream(eng.config.train_batch_size)
+    for _ in range(10):
+        eng.train_batch(next(stream))
+    eng.save_checkpoint(str(tmp_path), tag="clean10")
+    chaos.arm("sentinel.spike", "flag", skip=0, times=3, factor=10000)
+    for _ in range(3):
+        eng.train_batch(next(stream))
+    assert eng.sentinel.rollbacks_done == 1
+    assert eng.global_steps == 10                  # restored tag
+    assert eng.data_position == 13                 # pipeline NOT rewound
+    # clean data resumes training from the restored state
+    losses = [float(jax.device_get(eng.train_batch(next(stream))["loss"]))
+              for _ in range(10)]
+    assert np.isfinite(losses).all()
+    assert eng.sentinel.last_verdict in (sl.OK, sl.STRIKE)
+
+    # the restored engine can reposition a fresh loader past the span
+    dl = _loader(n=1024, batch=32)
+    n = eng.fast_forward_dataloader(dl)
+    assert n == eng.data_position
+    assert dl._start_batch == eng.data_position % len(dl)
+
+
+@pytest.mark.slow
+def test_chaos_spike_reproduced_post_rollback_aborts_rc118(tmp_path):
+    """Ladder rung 3 end-to-end: a spike that reproduces after a rollback
+    is not the data — abort with the rc-118 integrity contract."""
+    eng = _engine({"skip": False, "rollback_after": 2, "strike_window": 20,
+                   "abort_after_rollbacks": 1})
+    stream = batch_stream(eng.config.train_batch_size)
+    for _ in range(10):
+        eng.train_batch(next(stream))
+    eng.save_checkpoint(str(tmp_path), tag="clean10")
+    chaos.arm("sentinel.spike", "flag", skip=0, times=8, factor=10000)
+    with pytest.raises(TrainingIntegrityError) as ei:
+        for _ in range(10):
+            eng.train_batch(next(stream))
+    assert ei.value.exit_code == 118
+    assert eng.sentinel.rollbacks_done == 1
+
+
+@pytest.mark.slow
+def test_rollback_without_checkpoint_aborts_loudly():
+    eng = _engine({"skip": False, "rollback_after": 2, "strike_window": 20})
+    stream = batch_stream(eng.config.train_batch_size)
+    for _ in range(8):
+        eng.train_batch(next(stream))
+    chaos.arm("sentinel.spike", "flag", skip=0, times=4, factor=10000)
+    with pytest.raises(TrainingIntegrityError, match="no checkpoint"):
+        for _ in range(4):
+            eng.train_batch(next(stream))
+
+
+@pytest.mark.slow
+def test_chaos_sdc_bitflip_detected_flagged_and_aborted(tmp_path,
+                                                        monkeypatch):
+    """Cross-replica SDC audit end-to-end (single process, 8 devices): a
+    chaos bit-flip on ONE device's replicated params loses the checksum
+    majority vote within audit_interval steps; the rank stamps an SDC
+    heartbeat flag (blacklist evidence) and aborts rc 118."""
+    import jax
+    from deepspeed_tpu.runtime import heartbeat as hb
+    hbdir = tmp_path / "hb"
+    monkeypatch.setenv(hb.HEARTBEAT_DIR_ENV, str(hbdir))
+    eng = _engine({"enabled": False, "audit_interval": 5})
+    stream = batch_stream(eng.config.train_batch_size)
+    for _ in range(4):
+        eng.train_batch(next(stream))
+    eng.save_checkpoint(str(tmp_path / "ck"), tag="t4")
+    eng.train_batch(next(stream))                  # step 5: clean audit
+    assert sl.read_last_audited_clean(str(tmp_path / "ck")) == "t4"
+
+    chaos.arm("sentinel.sdc", "flag", match="0")   # this process's key
+    with pytest.raises(TrainingIntegrityError, match="SDC") as ei:
+        for _ in range(5):
+            eng.train_batch(next(stream))          # step 10: dirty audit
+    assert ei.value.exit_code == 118
+    flags = hb.flagged_ranks(str(hbdir))
+    assert 0 in flags and sl.SDC_FLAG in flags[0]["flags"]
+
+    # a fresh engine's tag=None resume prefers the audited-clean tag even
+    # though later tags exist (they may carry the corruption)
+    eng2 = _engine({"enabled": False, "audit_interval": 5})
+    eng2.save_checkpoint(str(tmp_path / "ck"), tag="t9-post-audit")
+    eng2.load_checkpoint(str(tmp_path / "ck"))
+    assert eng2.global_steps == 4                  # t4, not t9-post-audit
+
+
+@pytest.mark.slow
+def test_audit_explicit_tag_not_overridden(tmp_path):
+    eng = _engine({"enabled": False, "audit_interval": 5})
+    stream = batch_stream(eng.config.train_batch_size)
+    for _ in range(2):
+        eng.train_batch(next(stream))
+    eng.save_checkpoint(str(tmp_path), tag="t2")
+    sl.write_last_audited_clean(str(tmp_path), "t-other")
+    eng.load_checkpoint(str(tmp_path), tag="t2")   # user intent wins
+    assert eng.global_steps == 2
+
+
+@pytest.mark.slow
+def test_data_position_checkpointed_and_restored(tmp_path):
+    eng = _engine()
+    stream = batch_stream(eng.config.train_batch_size)
+    for _ in range(7):
+        eng.train_batch(next(stream))
+    assert eng.data_position == 7
+    eng.save_checkpoint(str(tmp_path))
+    eng2 = _engine()
+    eng2.load_checkpoint(str(tmp_path))
+    assert eng2.data_position == 7

@@ -308,6 +308,29 @@ def test_blackholed_host_fails_dispatch_and_is_attributed(tmp_path):
     assert sup.failed_hosts() == ["h1"]
 
 
+@pytest.mark.slow
+def test_sdc_flag_attributes_host_while_rc118_strikes_nobody(tmp_path):
+    """Round 7: an integrity abort exits EVERY rank rc 118 (the audit is
+    collective), so the rc must strike no host — only the SDC-flagged
+    rank's record carries the attribution."""
+    from deepspeed_tpu.runtime import heartbeat as hb
+    hb_dir = str(tmp_path / "hb")
+    sup = RunSupervisor([
+        _spec("import time; time.sleep(0.8); raise SystemExit(118)", "h0"),
+        _spec("import time; time.sleep(0.8); raise SystemExit(118)", "h1"),
+    ], grace_secs=0.5, heartbeat_dir=hb_dir, stream=io.StringIO()).start()
+    w0 = hb.HeartbeatWriter(hb_dir, 0, host="h0", refresh_interval=0)
+    w0.write(hb.PHASE_STEP, 10, force=True)
+    w0.add_flag("INTEGRITY")             # every aborting rank carries this
+    w1 = hb.HeartbeatWriter(hb_dir, 1, host="h1", refresh_interval=0)
+    w1.write(hb.PHASE_STEP, 10, force=True)
+    w1.add_flag("SDC")
+    w1.add_flag("INTEGRITY")
+    rc = sup.wait(timeout=60)
+    assert rc == 118                     # counted failure for the agent
+    assert sup.failed_hosts() == ["h1"]  # ...but only the SDC-flagged host
+
+
 # --------------------------------------------------- Popen facade + the agent
 
 def test_popen_facade_poll_wait_terminate():
