@@ -64,6 +64,42 @@ _RANK_NAME = re.compile(
     r"^(?:global_|local_|node_)?rank$|^process_index$|^process_id$")
 
 
+def _locally_bound(module, name_node: ast.Name) -> bool:
+    """True when ``name_node``'s identifier is bound by any enclosing
+    function — a parameter, assignment/annotated-assignment target,
+    aug-assignment, for-loop target, with-item alias, or walrus. Such a
+    use reads the LOCAL binding, never the module-level constant."""
+    ident = name_node.id
+    fn = module.enclosing_function(name_node)
+    while fn is not None:
+        args = getattr(fn, "args", None)
+        if args is not None:
+            for a in (list(args.args) + list(args.posonlyargs)
+                      + list(args.kwonlyargs)
+                      + [x for x in (args.vararg, args.kwarg) if x]):
+                if a.arg == ident:
+                    return True
+        for node in module.nodes_by_fn.get(fn, ()):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                                   ast.NamedExpr)):
+                targets = [node.target]
+            elif isinstance(node, ast.For):
+                targets = [node.target]
+            elif isinstance(node, ast.comprehension):
+                targets = [node.target]
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                targets = [node.optional_vars]
+            for t in targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name) and leaf.id == ident:
+                        return True
+        fn = module.enclosing_function(fn)
+    return False
+
+
 def module_dotted_name(rel_path: str) -> str:
     """'deepspeed_tpu/comm/comm.py' -> 'deepspeed_tpu.comm.comm';
     '__init__.py' collapses onto its package."""
@@ -110,11 +146,17 @@ class ProjectIndex:
         self._callers: Dict[ast.AST, List[ast.AST]] = {}
         self._direct_ctx: Dict[ast.AST, List[C.AxisContext]] = {}
         self.axis_universe: Set[str] = set()
+        #: dotted constant name -> axis-name set it denotes (module-level
+        #: ``NAME = "model"`` / ``AXES = ("data", "model")`` assignments);
+        #: None marks a name assigned CONFLICTING literals (never guess)
+        self.axis_constants: Dict[str, Optional[FrozenSet[str]]] = {}
         self._rank_locals: Dict[ast.AST, Set[str]] = {}
         for m in self.modules:
             self._register_module(m)
         for m in self.modules:
             self._collect_imports(m)
+        for m in self.modules:
+            self._collect_axis_constants(m)
         for m in self.modules:
             self._collect_contexts_and_axes(m)
         for m in self.modules:
@@ -388,6 +430,82 @@ class ProjectIndex:
 
     # ------------------------------------------------------- axis contexts
 
+    def _collect_axis_constants(self, module) -> None:
+        """Module-level string/tuple-of-string constants, by dotted name.
+
+        ``MODEL_AXIS = "model"`` makes ``lax.psum(x, MODEL_AXIS)`` — in
+        THIS module or any module importing the name — as checkable as
+        the literal. A name assigned conflicting literal values is
+        poisoned (None): TPU012 stays silent rather than guess which
+        assignment is live."""
+        dotted = self.mod_dotted[id(module)]
+        for node in module.nodes_by_fn.get(None, ()):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                target, value = node.target.id, node.value
+            else:
+                continue
+            names = C.literal_axes(value)
+            key = f"{dotted}.{target}"
+            if names is None:
+                # a non-literal reassignment of a known constant poisons it
+                if key in self.axis_constants:
+                    self.axis_constants[key] = None
+                continue
+            prev = self.axis_constants.get(key, names)
+            self.axis_constants[key] = names if prev == names else None
+
+    def resolve_axes(self, module, node: Optional[ast.AST]
+                     ) -> Optional[FrozenSet[str]]:
+        """:func:`collectives.literal_axes` extended through module-level
+        constants: a Name/Attribute (bare local, imported, or re-exported)
+        that denotes a collected string/tuple constant resolves to its
+        axis set; tuples may MIX literals and constant names. None = not
+        statically resolvable (the existing stay-silent contract)."""
+        if node is None:
+            return None
+        names = C.literal_axes(node)
+        if names is not None:
+            return names
+
+        def one(n: ast.AST) -> Optional[FrozenSet[str]]:
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                return frozenset({n.value})
+            if not isinstance(n, (ast.Name, ast.Attribute)):
+                return None
+            if isinstance(n, ast.Name) and _locally_bound(module, n):
+                # a function-local binding (param, assignment, loop
+                # target) SHADOWS both module constants AND imported
+                # names at this use site: the value is the caller's
+                # contract, not the constant's — stay silent
+                return None
+            q = self.qualify(module, n)
+            if q is None:
+                return None
+            if isinstance(n, ast.Name) and q == n.id:
+                # bare, un-imported name: a constant of THIS module
+                q = f"{self.mod_dotted[id(module)]}.{n.id}"
+            seen: Set[str] = set()
+            while q not in self.axis_constants and q in self._reexports \
+                    and q not in seen:
+                seen.add(q)
+                q = self._reexports[q]
+            return self.axis_constants.get(q)
+
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out: FrozenSet[str] = frozenset()
+            for e in node.elts:
+                r = one(e)
+                if r is None:
+                    return None
+                out |= r
+            return out
+        return one(node)
+
     def _collect_contexts_and_axes(self, module) -> None:
         """Direct shard_map/pmap wraps + the project axis universe."""
         for call in module.all_calls:
@@ -396,18 +514,18 @@ class ProjectIndex:
             if q in C.SHARD_WRAPPERS:
                 ax = next((kw.value for kw in call.keywords
                            if kw.arg == "axis_names"), None)
-                names = C.literal_axes(ax)
+                names = self.resolve_axes(module, ax)
                 ctx = names if names is not None else C.UNKNOWN
             elif q in C.PMAP_WRAPPERS:
                 ax = next((kw.value for kw in call.keywords
                            if kw.arg == "axis_name"), None)
-                names = C.literal_axes(ax)
+                names = self.resolve_axes(module, ax)
                 ctx = names if names is not None else C.UNKNOWN
             elif q in C.MESH_CTORS:
                 ax = (call.args[1] if len(call.args) > 1 else
                       next((kw.value for kw in call.keywords
                             if kw.arg in ("axis_names", "axis_name")), None))
-                names = C.literal_axes(ax)
+                names = self.resolve_axes(module, ax)
                 if names:
                     self.axis_universe |= names
                 continue

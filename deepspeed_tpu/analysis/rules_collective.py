@@ -149,10 +149,17 @@ class MeshAxisValidityRule(Rule):
     enclosing ``shard_map``/``pmap``. Resolution is interprocedural: a
     helper that does ``lax.psum(x, "model")`` is checked against the axis
     sets of every shard_map context that reaches it through the call
-    graph (or lexically). When no context is statically known, the name
-    is checked against the PROJECT axis universe (every axis declared in
-    any shard_map/pmap/Mesh/``*_AXES`` constant) — which catches the typo
-    class outright. Contexts whose axes aren't statically visible
+    graph (or lexically). Axis arguments that are MODULE-LEVEL CONSTANTS
+    (round-8 depth) resolve like literals — ``lax.psum(x, MODEL_AXIS)``
+    with ``MODEL_AXIS = "model"`` in this or an imported module (the
+    parallel/mesh.py idiom), including tuples mixing constants and
+    literals; the same resolution feeds shard_map/pmap ``axis_names=``
+    declarations, so constant-declared contexts check constant-passed
+    axes. A constant assigned conflicting values is never guessed at.
+    When no context is statically known, the name is checked against the
+    PROJECT axis universe (every axis declared in any shard_map/pmap/
+    Mesh/``*_AXES`` constant) — which catches the typo class outright.
+    Contexts whose axes aren't statically visible
     (``axis_names={self.axis}``, mesh-derived axes) disable the check
     rather than guess, and the universe fallback is skipped entirely
     when the run declares NO axes (a subset lint of helper files has no
@@ -173,7 +180,10 @@ class MeshAxisValidityRule(Rule):
             q = index.qualify(module, call.func)
             if not (C.collective_kind(q) or q in C.LAX_AXIS_USERS):
                 continue
-            names = C.literal_axes(C.axis_arg(call, q))
+            # literals, plus Name/Attribute axis args resolving through
+            # module-level constants (``lax.psum(x, MODEL_AXIS)`` with
+            # ``MODEL_AXIS = "model"`` in this or an imported module)
+            names = index.resolve_axes(module, C.axis_arg(call, q))
             if not names:
                 continue
             fn = module.enclosing_function(call)

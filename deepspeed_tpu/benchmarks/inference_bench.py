@@ -1,18 +1,28 @@
-"""Inference latency benchmarks — prefill/forward + generation sweeps.
+"""Inference latency benchmarks — prefill/forward + generation sweeps,
+plus a Poisson-arrival serving-load leg.
 
 Capability parity with the reference's ``benchmarks/inference`` (bert/gpt
 latency scripts): measures forward latency over batch/seq and per-token
 decode latency with the KV-cache generate loop, on the current backend.
+``--poisson`` drives the round-8 continuous-batching serving loop
+(deepspeed_tpu/serving/) with open-loop Poisson arrivals at fixed request
+rates, reporting tokens/s/chip and p50/p99 request latency — the
+serving-SLO counterpart of the closed-loop sweeps above, with a
+machine-readable ``inference_bench poisson: {json}`` line in the PR-7
+dryrun-timings style.
 
     python -m deepspeed_tpu.benchmarks.inference_bench \
         [--preset gpt2-125m] [--batches 1,8] [--seqs 128,1024] [--new 64]
+    python -m deepspeed_tpu.benchmarks.inference_bench --poisson \
+        [--rates 2,8] [--requests 64] [--prompt 128] [--new 64]
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import time
-from typing import List
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -104,6 +114,103 @@ def run_ragged(preset: str, batch: int, max_seq: int, new_tokens: int):
     return row
 
 
+def run_poisson(preset: str, rate: float, num_requests: int,
+                prompt_len: int, new_tokens: int,
+                serving: Optional[dict] = None, seed: int = 0,
+                model_kwargs: Optional[dict] = None) -> dict:
+    """Open-loop Poisson load against the continuous-batching serving loop.
+
+    Requests arrive at exponential inter-arrival times (rate = requests/s)
+    regardless of server progress — the open-loop regime where queueing
+    delay shows up honestly (a closed loop would self-throttle). Reports
+    per-request latency (arrival -> completion, so queue wait counts)
+    p50/p99 and steady-state tokens/s/chip, plus the machine-readable
+    line the regression tooling greps::
+
+        inference_bench poisson: {"rate": 8.0, "p50_s": ..., ...}
+    """
+    from ..models import build_model
+    from ..serving.engine import ServingEngine
+    model, cfg = build_model(preset, max_seq_len=prompt_len + new_tokens,
+                             **(model_kwargs or {}))
+    rng = np.random.default_rng(seed)
+    ids0 = rng.integers(0, cfg.vocab_size, (1, prompt_len))
+    # one-shot bench setup: init compiles once before the timed region
+    # graftlint: disable=TPU002
+    params = jax.jit(lambda r: model.init(r, {"input_ids": ids0})
+                     ["params"])(jax.random.PRNGKey(0))
+    eng = ServingEngine(cfg, params, serving=serving)
+
+    # a shared "system prompt" prefix (2 blocks) exercises the prefix
+    # cache the way production traffic does; suffixes vary per request
+    shared = 2 * eng.block_size
+    sys_prompt = rng.integers(1, cfg.vocab_size, size=min(shared,
+                                                          prompt_len // 2))
+    prompts = []
+    for _ in range(num_requests):
+        suffix_len = max(1, prompt_len - len(sys_prompt))
+        prompts.append(list(sys_prompt)
+                       + list(rng.integers(1, cfg.vocab_size,
+                                           size=suffix_len)))
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=num_requests))
+
+    # warm the compile caches outside the timed window (serving latency,
+    # not XLA latency, is measured): warm A compiles the FULL-prompt
+    # prefill bucket and seeds the prefix cache; warm B, sharing the
+    # system prompt, takes the prefix hit and compiles the SUFFIX bucket
+    # every timed request will actually use — plus the one decode step
+    def _mk_prompt():
+        suffix_len = max(1, prompt_len - len(sys_prompt))
+        return (list(sys_prompt)
+                + list(rng.integers(1, cfg.vocab_size, size=suffix_len)))
+    for _ in range(2):
+        warm = eng.submit(_mk_prompt(), 2)
+        eng.run_until_idle()
+        assert warm.done
+
+    reqs = []
+    lat: List[float] = []
+    t0 = time.perf_counter()
+    next_i = 0
+    while len(lat) < num_requests:
+        now = time.perf_counter() - t0
+        while next_i < num_requests and arrivals[next_i] <= now:
+            i = next_i
+            reqs.append((eng.submit(prompts[i], new_tokens), arrivals[i]))
+            next_i += 1
+        if eng.idle:
+            if next_i < num_requests:
+                time.sleep(max(arrivals[next_i] - (time.perf_counter() - t0),
+                               0.0))
+            continue
+        eng.step()
+        done_now = time.perf_counter() - t0
+        still = []
+        for req, arr in reqs:
+            if req.done:
+                lat.append(done_now - arr)
+            else:
+                still.append((req, arr))
+        reqs = still
+    wall = time.perf_counter() - t0
+    n_chips = jax.device_count()
+    gen_tokens = num_requests * new_tokens
+    row = {
+        "preset": preset, "rate": float(rate), "requests": num_requests,
+        "prompt": prompt_len, "new_tokens": new_tokens,
+        "wall_s": round(wall, 3),
+        "p50_s": round(float(np.percentile(lat, 50)), 4),
+        "p99_s": round(float(np.percentile(lat, 99)), 4),
+        "mean_s": round(float(np.mean(lat)), 4),
+        "tokens_per_s": round(gen_tokens / wall, 1),
+        "tokens_per_s_per_chip": round(gen_tokens / wall / n_chips, 1),
+        "prefix_hit_tokens": eng.stats["prefix_hit_tokens"],
+        "n_chips": n_chips,
+    }
+    print("inference_bench poisson: " + json.dumps(row))
+    return row
+
+
 def run_spatial(size: int, batch: int, channels: int = 64,
                 context_len: int = 77):
     """Conditional-UNet forward latency (the diffusion serving hot loop —
@@ -144,12 +251,23 @@ def main(argv=None):
     p.add_argument("--spatial", action="store_true",
                    help="conditional-UNet forward latency")
     p.add_argument("--latent", type=int, default=64)
+    p.add_argument("--poisson", action="store_true",
+                   help="Poisson-arrival load vs the serving loop")
+    p.add_argument("--rates", default="2,8",
+                   help="request rates (req/s), comma-separated")
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--prompt", type=int, default=128)
     args = p.parse_args(argv)
     if args.spatial:
         run_spatial(args.latent, int(args.batches.split(",")[0]))
         return
     if args.ragged:
         run_ragged(args.preset, args.ragged_batch, args.ragged_seq, args.new)
+        return
+    if args.poisson:
+        for rate in (float(x) for x in args.rates.split(",")):
+            run_poisson(args.preset, rate, args.requests, args.prompt,
+                        args.new)
         return
     run(args.preset, [int(x) for x in args.batches.split(",")],
         [int(x) for x in args.seqs.split(",")], args.new)

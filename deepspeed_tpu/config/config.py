@@ -389,6 +389,31 @@ class WatchdogConfig(DeepSpeedConfigModel):
     compile_timeout: float = 0.0  # COMPILE: first entry -> first step; 0 = off
     restore_timeout: float = 0.0  # RESTORE: load_checkpoint bound; 0 = off
     save_timeout: float = 0.0     # SAVE: save bound; 0 = unbounded (suspend)
+    serve_timeout: float = 0.0    # SERVE: serving-loop iteration gap; 0 = off
+
+
+class ServingConfig(DeepSpeedConfigModel):
+    """TPU-native (round 8): the continuous-batching serving loop
+    (deepspeed_tpu/serving/, docs/SERVING.md). The KV cache is a paged
+    POOL of ``pool_blocks`` blocks x ``block_size`` tokens shared by
+    every in-flight sequence (block 0 reserved as the null block);
+    requests are admitted FIFO when their lifetime block budget fits,
+    prefilled into their blocks (reusing prefix-cached blocks for shared
+    system prompts when ``prefix_cache``), and decoded by ONE fixed-shape
+    jitted step over ``max_batch`` lanes. Pool HBM ≈ 2 (k+v) x layers x
+    heads x head_dim x pool_blocks x block_size x dtype_bytes; size
+    ``pool_blocks`` to the HBM left after weights. ``block_size`` trades
+    fragmentation (last-block waste per sequence) against table length
+    and prefix-cache granularity — shared prefixes are reused at
+    full-block granularity only."""
+    block_size: int = 32               # tokens per KV block
+    pool_blocks: int = 256             # pool capacity incl. the null block
+    max_batch: int = 8                 # decode lanes (fixed compiled shape)
+    max_blocks_per_seq: int = 64       # table width; caps prompt+generation
+    prefix_cache: bool = True          # reuse shared full-block prefixes
+    max_queue: int = 4096              # admission queue bound (backpressure)
+    kv_cache_dtype: Optional[str] = None   # None = model dtype
+    seed: int = 0                      # sampling PRNG seed
 
 
 class ProgressiveLayerDropConfig(DeepSpeedConfigModel):
@@ -517,6 +542,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     dataloader_drop_last: bool = False
     nebula: NebulaConfig = Field(default_factory=NebulaConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
+    serving: ServingConfig = Field(default_factory=ServingConfig)
     tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)
     sequence_parallel: SequenceParallelConfig = Field(default_factory=SequenceParallelConfig)
     moe: MoEConfig = Field(default_factory=MoEConfig)

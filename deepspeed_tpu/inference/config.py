@@ -7,7 +7,8 @@ from typing import Any, Dict, Optional
 
 from pydantic import Field
 
-from ..config.config import DeepSpeedConfigModel
+from ..config.config import DeepSpeedConfigModel, ServingConfig, \
+    WatchdogConfig
 
 
 class InferenceTPConfig(DeepSpeedConfigModel):
@@ -40,6 +41,11 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     checkpoint: Optional[str] = None
     enable_cuda_graph: bool = False   # accepted for parity; XLA always "graph-captures"
     replace_method: str = "auto"
+    # round 8: continuous-batching serving loop (engine.serve(); shares the
+    # section schema with the training config) + the PR-6 watchdog knobs
+    # that bound it (serve_timeout)
+    serving: ServingConfig = Field(default_factory=ServingConfig)
+    watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
 
 
 def load_inference_config(config) -> DeepSpeedInferenceConfig:

@@ -243,3 +243,28 @@ class InferenceEngine:
         raise NotImplementedError(
             "generate() requires a deepspeed_tpu.models.Transformer or a "
             "model exposing its own generate method")
+
+    def serve(self, serving=None, heartbeat=None, interpret=False):
+        """Continuous-batching serving loop over THIS engine's weights
+        (round 8): a ``serving.ServingEngine`` with a paged KV block
+        pool, FIFO admission control, prefix-cache reuse, and one
+        fixed-shape compiled decode step — see docs/SERVING.md.
+
+        ``serving`` overrides the config's ``serving`` section (dict or
+        ServingConfig). When the config arms ``watchdog.serve_timeout``,
+        the loop is supervised by the PR-6 stall watchdog (rc 117 on a
+        wedged iteration). int8 weight-only engines serve unchanged (the
+        dequant rides the paged forward's matmuls)."""
+        from ..models.transformer import Transformer
+        if not isinstance(self.module, Transformer):
+            raise NotImplementedError(
+                "serve() requires a deepspeed_tpu.models.Transformer "
+                "(the paged runner mirrors its decode layer math)")
+        from ..serving.engine import ServingEngine
+        eng = ServingEngine(self.module.cfg, self.params,
+                            serving=serving if serving is not None
+                            else self.config.serving,
+                            heartbeat=heartbeat, interpret=interpret)
+        if self.config.watchdog.serve_timeout > 0:
+            eng.arm_watchdog(self.config.watchdog.serve_timeout)
+        return eng

@@ -251,16 +251,16 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
     # B=1 / short caches, where per-layer kernel dispatch dominates.
     # ``prefer_kernel`` (generate passes it from the static prompt/gen
     # plan) overrides the local B/max_len heuristic. "flash" forces the
-    # kernel; alibi needs a bias the kernel doesn't carry -> jnp path;
-    # ragged (left-padded) batches need per-sample masks -> jnp path; the
-    # int8 cache needs the dequant read -> jnp path.
+    # kernel. ALiBi slopes and the Gemma-2 softcap run IN-KERNEL (round-8
+    # parity with the flash prefill kernel); ragged (left-padded) batches
+    # need per-sample masks -> jnp path; the int8 cache needs the dequant
+    # read -> jnp path.
     if prefer_kernel is None:
         prefer_kernel = B >= 2 and max_len >= 4 * 512
     use_kernel = ((cfg.attention_impl == "flash"
                    or (cfg.attention_impl == "auto" and prefer_kernel))
-                  and jax.default_backend() == "tpu" and ali is None
-                  and pad is None and not quant_kv
-                  and not cfg.attn_softcap)   # decode kernel has no softcap
+                  and jax.default_backend() == "tpu"
+                  and pad is None and not quant_kv)
 
     # prefill on the flash kernel (empty cache — caller's contract): alibi,
     # softcap and a UNIFORM static window all run in-kernel; mixed per-layer
@@ -340,10 +340,13 @@ def forward_with_cache(cfg: TransformerConfig, params: PyTree,
             from ..ops.pallas.decode_attention import decode_attention
             try:
                 # stacked form: the kernel indexes layer li out of the
-                # carried [L, ...] cache itself — no materialized slice
+                # carried [L, ...] cache itself — no materialized slice;
+                # alibi slopes / softcap ride in-kernel
                 o = decode_attention(q, k_all, v_all, pos + T_new,
                                      window=window, sm_scale=sm_scale,
-                                     layer_idx=li)
+                                     layer_idx=li,
+                                     alibi_slopes=prefill_slopes,
+                                     softcap=cfg.attn_softcap)
             except ValueError:
                 o = None                       # shapes don't tile
         if o is None:

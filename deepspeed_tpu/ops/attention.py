@@ -129,6 +129,44 @@ def sliding_window_attention(q, k, v, window: int, *,
         window=window, layout_exact=False, interpret=interpret)
 
 
+def paged_attention(q, k_pool, v_pool, block_tables, context_lens, *,
+                    sm_scale: Optional[float] = None,
+                    alibi_slopes=None,
+                    softcap: float = 0.0,
+                    window=None,
+                    layer_idx=None,
+                    q_start=None,
+                    impl: str = "auto",
+                    interpret: bool = False) -> jnp.ndarray:
+    """Dispatching paged-attention entry point (serving decode path).
+
+    q [B, nh, T, hd] against a block-pool K/V ([L?, nh, num_blocks,
+    block_size, hd]) through per-sequence ``block_tables`` [B, max_blocks]
+    and ``context_lens`` [B]. The Pallas kernel
+    (ops/pallas/paged_attention.py) serves the decode regime (T == 1, TPU
+    or interpret) with ALiBi/softcap/window in-kernel; every other regime
+    — prefill (T > 1, possibly with PADDED trailing queries positioned by
+    ``q_start``), CPU, untileable shapes — runs the exact jnp gather
+    reference. ``impl="reference"`` forces the oracle.
+    """
+    kw = dict(sm_scale=sm_scale, alibi_slopes=alibi_slopes, softcap=softcap,
+              window=window, layer_idx=layer_idx)
+    on_tpu = jax.default_backend() == "tpu"
+    if impl in ("auto", "flash") and (on_tpu or interpret) \
+            and q.shape[2] == 1:
+        # T == 1: the query position is ctx - 1 by the decode contract, so
+        # q_start (== ctx - 1 when given) carries no extra information
+        from .pallas.paged_attention import paged_attention as _kernel
+        try:
+            return _kernel(q, k_pool, v_pool, block_tables, context_lens,
+                           interpret=interpret, **kw)
+        except ValueError:
+            pass                    # shapes don't tile — gather reference
+    from .pallas.paged_attention import paged_attention_reference
+    return paged_attention_reference(q, k_pool, v_pool, block_tables,
+                                     context_lens, q_start=q_start, **kw)
+
+
 def attention(q: jnp.ndarray,
               k: jnp.ndarray,
               v: jnp.ndarray,

@@ -19,6 +19,9 @@ Mechanics (same machinery as block_sparse_attention's block-skip):
   * causal + current-length + optional sliding-window masking is exact
     per-token, all driven by scalars so one compiled kernel serves the whole
     generation loop (no recompile as the sequence grows).
+  * ALiBi (per-head slopes, bias rebuilt from indices) and the Gemma-2 tanh
+    softcap run in-kernel — BLOOM/MPT and Gemma-2-class models decode on the
+    kernel instead of silently falling back to the jnp path.
 """
 
 from __future__ import annotations
@@ -37,8 +40,9 @@ from .flash_attention import NEG_INF
 __all__ = ["decode_attention"]
 
 
-def _kernel(scal_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
-            *, hg, Tp, block_k, nk, sm_scale, stacked):
+def _kernel(scal_ref, q_ref, k_ref, v_ref, slopes_ref, o_ref, acc, m_scr,
+            l_scr, *, hg, Tp, block_k, nk, sm_scale, softcap, has_alibi,
+            stacked):
     j = pl.program_id(1)
     cnt, qstart, window = scal_ref[0], scal_ref[1], scal_ref[2]
 
@@ -55,10 +59,18 @@ def _kernel(scal_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
         v = v_ref[0, 0] if stacked else v_ref[0]
         s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
                                 preferred_element_type=jnp.float32) * sm_scale
+        if softcap:
+            # Gemma-2 logit cap, BEFORE bias/masks (the decode-path order)
+            s = jnp.tanh(s / softcap) * softcap
         # rows t of the (padded) q block are absolute position qstart + t;
         # cols are cache positions j*block_k + c
         q_abs = qstart + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        if has_alibi:
+            # per-head slope * (k - q) distance, built from indices — the
+            # same in-kernel term as the flash kernel's prefill bias
+            slope = slopes_ref[0][:, :1][:, None, :]        # [hg, 1, 1]
+            s = s + slope * (k_pos - q_abs).astype(jnp.float32)
         keep = k_pos <= q_abs                               # causal w/ cache
         keep &= (q_abs - k_pos < window) | (window <= 0)    # sliding window
         s = jnp.where(keep, s, NEG_INF)
@@ -98,6 +110,8 @@ def decode_attention(q: jnp.ndarray,
                      sm_scale: Optional[float] = None,
                      block_k: int = 512,
                      layer_idx=None,
+                     alibi_slopes=None,
+                     softcap: float = 0.0,
                      interpret: bool = False) -> jnp.ndarray:
     """Attention of T new tokens against a preallocated KV cache.
 
@@ -109,7 +123,10 @@ def decode_attention(q: jnp.ndarray,
        cache, so a scan-carried cache needs NO materialized per-layer slice.
     cur_len: i32 scalar (traced ok), total valid length INCLUDING the T new
        tokens.  window: python int or traced i32 scalar; <= 0 means global.
-    Returns [B, nh, T, hd].
+    alibi_slopes: [nh] per-head slopes — the bias slope * (k_pos - q_pos)
+       is built from indices in-kernel (BLOOM/MPT decode stays on the
+       kernel). softcap: Gemma-2 tanh logit cap, STATIC float (it changes
+       the compiled math). Returns [B, nh, T, hd].
 
     Raises ValueError when shapes can't tile (tiny head_dim / max_len) —
     callers fall back to the jnp path.
@@ -146,6 +163,16 @@ def decode_attention(q: jnp.ndarray,
     win = jnp.asarray(0 if window is None else window, jnp.int32)
     li = jnp.asarray(0 if layer_idx is None else layer_idx, jnp.int32)
     scal = jnp.stack([cnt, cur - T, win.reshape(()), li.reshape(())])
+    softcap = float(softcap) if softcap else 0.0
+    has_alibi = alibi_slopes is not None
+    if has_alibi:
+        # [B*ng, hg, 128]: program g reads its head group's slopes from its
+        # own tile (no dynamic VMEM scalar indexing)
+        sl = jnp.asarray(alibi_slopes, jnp.float32).reshape(ng, hg)
+        slopes = jnp.broadcast_to(sl[None, :, :, None],
+                                  (B, ng, hg, 128)).reshape(B * ng, hg, 128)
+    else:
+        slopes = jnp.zeros((1, 1, 128), jnp.float32)    # placeholder
 
     # dead grid steps clamp to the last active block: a repeated index means
     # the pipeline skips the K/V copy (the DMA half of the block skip)
@@ -162,6 +189,9 @@ def decode_attention(q: jnp.ndarray,
         kv_spec = pl.BlockSpec(
             (1, hg, block_k, hd),
             lambda g, j, s: (g, 0, jnp.minimum(j, s[0] - 1), 0))
+    slopes_spec = (pl.BlockSpec((1, hg, 128), lambda g, j, s: (g, 0, 0))
+                   if has_alibi else
+                   pl.BlockSpec((1, 1, 128), lambda g, j, s: (0, 0, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B * ng, nk),
@@ -169,6 +199,7 @@ def decode_attention(q: jnp.ndarray,
             pl.BlockSpec((1, hg, Tp, hd), lambda g, j, s: (g, 0, 0, 0)),
             kv_spec,
             kv_spec,
+            slopes_spec,
         ],
         out_specs=pl.BlockSpec((1, hg, Tp, hd), lambda g, j, s: (g, 0, 0, 0)),
         scratch_shapes=[
@@ -180,9 +211,10 @@ def decode_attention(q: jnp.ndarray,
     with jax.named_scope("decode_attention"):
         out = pl.pallas_call(
             partial(_kernel, hg=hg, Tp=Tp, block_k=block_k, nk=nk,
-                    sm_scale=scale, stacked=stacked),
+                    sm_scale=scale, softcap=softcap, has_alibi=has_alibi,
+                    stacked=stacked),
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((B * ng, hg, Tp, hd), q.dtype),
             interpret=interpret,
-        )(scal, qf, kf, vf)
+        )(scal, qf, kf, vf, slopes)
     return out.reshape(B, nh, Tp, hd)[:, :, :T]

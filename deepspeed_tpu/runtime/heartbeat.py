@@ -62,12 +62,18 @@ PHASE_RESTORE = "RESTORE"
 PHASE_COMPILE = "COMPILE"
 PHASE_STEP = "STEP"
 PHASE_SAVE = "SAVE"
+#: the serving loop's steady state (round 8): one record per loop
+#: iteration cadence — the serving analog of STEP, so the watchdog /
+#: health stack supervises a long-lived server the way it supervises
+#: training (serving/engine.py stamps it; watchdog.serve_timeout bounds it)
+PHASE_SERVE = "SERVE"
 #: terminal phases — the final record of a rank that died supervised
 PHASE_STALLED = "STALLED"
 PHASE_PREEMPTED = "PREEMPTED"
 PHASE_EXIT = "EXIT"
 
-PHASES = (PHASE_INIT, PHASE_RESTORE, PHASE_COMPILE, PHASE_STEP, PHASE_SAVE)
+PHASES = (PHASE_INIT, PHASE_RESTORE, PHASE_COMPILE, PHASE_STEP, PHASE_SAVE,
+          PHASE_SERVE)
 TERMINAL_PHASES = (PHASE_STALLED, PHASE_PREEMPTED, PHASE_EXIT)
 
 #: env var carrying the shared heartbeat directory to every worker

@@ -1,0 +1,21 @@
+"""Production serving: continuous batching over a paged KV cache.
+
+- :mod:`~deepspeed_tpu.serving.kv_cache` — block pool, refcounted
+  fork/free, prefix cache (vLLM-style paged layout);
+- :mod:`~deepspeed_tpu.serving.model_runner` — paged transformer
+  forward (generation-path numerics, block-table K/V);
+- :mod:`~deepspeed_tpu.serving.scheduler` — FIFO admission control under
+  the block budget;
+- :mod:`~deepspeed_tpu.serving.engine` — the fixed-shape serving loop
+  (one decode-step compile, SERVE heartbeat phase).
+
+Entry points: ``ServingEngine(cfg, params, serving_config)`` directly, or
+``deepspeed_tpu.init_inference(...).serve()``.
+"""
+
+from .engine import ServingEngine
+from .kv_cache import BlockPool, BlockPoolExhausted, PrefixCache, init_pool
+from .scheduler import Request, Scheduler
+
+__all__ = ["ServingEngine", "BlockPool", "BlockPoolExhausted", "PrefixCache",
+           "init_pool", "Request", "Scheduler"]

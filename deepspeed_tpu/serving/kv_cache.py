@@ -1,0 +1,251 @@
+"""Paged KV cache: a fixed pool of blocks + per-sequence block tables.
+
+The dense serving layout (``models/generation.init_cache``) preallocates
+``[L, B, nh, max_len, hd]`` per batch — every sequence pays for its WORST
+CASE length, and a finished sequence's slack is unreclaimable until the
+whole batch drains. For a long-lived serving loop that is the capacity
+bottleneck, not FLOPs. This module replaces it with the vLLM-style paged
+layout:
+
+* one preallocated device pool ``[L, nh, num_blocks * block_size, hd]``
+  (per k and v) shared by every in-flight sequence;
+* a host-side :class:`BlockPool` allocator handing out fixed-size blocks
+  with REFERENCE COUNTS — ``fork`` shares blocks between sequences
+  (prefix-cache reuse for common system prompts) and a block returns to
+  the free list when its last holder releases it;
+* a :class:`PrefixCache` mapping token-prefix hashes to full-block runs
+  of previously prefilled prompts, so a new request sharing a prompt
+  prefix skips recomputing (and re-storing) those blocks entirely.
+
+Copy-on-write discipline: blocks are shared at FULL-BLOCK granularity
+only (a forked prefix always ends on a block boundary), and a sequence
+only ever writes K/V at logical positions >= its fork point — which land
+in its own private blocks. Shared blocks are therefore read-only by
+construction; no device-side copy is ever needed, and the refcount is
+the entire consistency protocol.
+
+Physical block 0 is the NULL block: never allocated, the write target of
+padded/inactive lanes in the fixed-shape decode step, and never read
+(every read is masked by the per-sequence context length).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..testing import chaos
+
+#: physical block 0 — the write sink for padded lanes, never allocated
+NULL_BLOCK = 0
+
+
+class BlockPoolExhausted(RuntimeError):
+    """Allocation would exceed the pool — the scheduler's signal to keep
+    the request QUEUED (admission control), never a crash."""
+
+
+def init_pool(cfg, num_blocks: int, block_size: int,
+              dtype=None) -> Dict[str, jnp.ndarray]:
+    """Device-side paged pool: k/v ``[L, nh, num_blocks*block_size, hd]``.
+
+    Flat slot layout (slot = block * block_size + offset) so the decode
+    step's K/V write is ONE scatter over the slot axis; the paged-attention
+    kernel views the same buffer as ``[L, nh, num_blocks, block_size, hd]``
+    (a free reshape) to DMA whole blocks through the block table."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, cfg.num_heads, num_blocks * block_size,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+class BlockPool:
+    """Host-side block allocator with refcounts (see module docstring).
+
+    ``num_blocks`` COUNTS the reserved null block: a pool of N blocks has
+    N - 1 allocatable."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("pool needs >= 2 blocks (block 0 is the null "
+                             "block)")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free: List[int] = list(range(num_blocks - 1, NULL_BLOCK, -1))
+        self._refs: Dict[int, int] = {}
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        return -(-max(int(n_tokens), 0) // self.block_size)
+
+    def alloc(self, n: int) -> List[int]:
+        """``n`` fresh private blocks (refcount 1). Raises
+        :class:`BlockPoolExhausted` when the pool can't cover them — and
+        the ``serve.oom`` failpoint can force that path (chaos tests pin
+        queued-not-crashed)."""
+        chaos.failpoint("serve.oom")
+        if n > len(self._free):
+            raise BlockPoolExhausted(
+                f"need {n} blocks, {len(self._free)} free "
+                f"(pool {self.num_blocks - 1} x {self.block_size} tokens)")
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._refs[b] = 1
+        return out
+
+    def fork(self, blocks: Sequence[int]) -> List[int]:
+        """Share ``blocks`` with another holder: +1 refcount each. The
+        caller must treat them as READ-ONLY (full-block prefix sharing
+        guarantees it never writes below its fork point)."""
+        for b in blocks:
+            if b == NULL_BLOCK or b not in self._refs:
+                raise ValueError(f"fork of unallocated block {b}")
+            self._refs[b] += 1
+        return list(blocks)
+
+    def release(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per block; a block returns to the free list
+        when its last holder releases it."""
+        for b in blocks:
+            refs = self._refs.get(b)
+            if refs is None:
+                raise ValueError(f"release of unallocated block {b}")
+            if refs > 1:
+                self._refs[b] = refs - 1
+            else:
+                del self._refs[b]
+                self._free.append(b)
+
+    def refcount(self, block: int) -> int:
+        return self._refs.get(block, 0)
+
+
+def _chain_keys(tokens: Sequence[int], block_size: int,
+                max_blocks: int) -> List[str]:
+    """Per-block-boundary prefix digests, computed INCREMENTALLY: key k
+    hashes tokens[:k*block_size] by extending one running sha1, so the
+    whole ladder costs O(len(tokens)) — not O(len^2 / block_size) as
+    hashing each prefix from scratch would (admission is a hot path and
+    prompts reach tens of thousands of tokens)."""
+    keys: List[str] = []
+    h = hashlib.sha1()
+    for k in range(max_blocks):
+        for t in tokens[k * block_size:(k + 1) * block_size]:
+            h.update(int(t).to_bytes(4, "little", signed=True))
+        keys.append(h.hexdigest())
+    return keys
+
+
+class PrefixCache:
+    """Token-prefix hash -> full-block run of an already-prefilled prompt.
+
+    Entries hold their own refcount on the blocks (via ``pool.fork``), so
+    a cached prefix survives the request that created it; eviction (LRU,
+    on allocation pressure) releases those references. Hash collisions
+    are guarded by comparing the stored token prefix on match; entries of
+    one insert share a single tokens tuple (no per-entry prefix copies)."""
+
+    def __init__(self, pool: BlockPool):
+        self.pool = pool
+        # key -> (tokens ref, n_blocks, blocks, last_used)
+        self._entries: Dict[str, Tuple[Tuple[int, ...], int, List[int],
+                                       int]] = {}
+        self._clock = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def _lookup(self, tokens: Sequence[int]
+                ) -> Tuple[int, Optional[str], List[int]]:
+        """(n_cached_tokens, entry key, blocks) of the longest cached
+        full-block prefix — NO fork, no LRU touch."""
+        bs = self.pool.block_size
+        max_blocks = (len(tokens) - 1) // bs
+        if max_blocks <= 0 or not self._entries:
+            return 0, None, []
+        keys = _chain_keys(tokens, bs, max_blocks)
+        for k in range(max_blocks, 0, -1):
+            ent = self._entries.get(keys[k - 1])
+            if ent is None:
+                continue
+            etoks, ek, blocks, _ = ent
+            if ek != k or tuple(etoks[:k * bs]) != \
+                    tuple(int(t) for t in tokens[:k * bs]):
+                continue                       # hash collision — skip
+            return k * bs, keys[k - 1], blocks
+        return 0, None, []
+
+    def peek(self, tokens: Sequence[int]) -> Tuple[int, Optional[str]]:
+        """Admission-budget probe: (n_cached_tokens, entry key) WITHOUT
+        taking a reference — the scheduler uses it to net the hit out of
+        the block budget and to protect the entry from its own
+        make-room eviction."""
+        n, key, _ = self._lookup(tokens)
+        return n, key
+
+    def match(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
+        """Longest cached FULL-BLOCK prefix of ``tokens``, capped at
+        ``len(tokens) - 1`` so a fully-cached prompt still leaves >= 1
+        token to prefill (the last prompt token's logits seed sampling).
+        Returns ``(n_cached_tokens, forked_blocks)`` — the blocks already
+        carry the caller's refcount."""
+        n, key, blocks = self._lookup(tokens)
+        if key is None:
+            return 0, []
+        self._clock += 1
+        ent = self._entries[key]
+        self._entries[key] = (ent[0], ent[1], ent[2], self._clock)
+        return n, self.pool.fork(blocks)
+
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> None:
+        """Register every full-block prefix of a prefilled prompt. The
+        cache forks (refcounts) the blocks it retains; duplicate keys are
+        refreshed, not re-forked."""
+        bs = self.pool.block_size
+        nfull = len(tokens) // bs
+        if nfull <= 0:
+            return
+        shared = tuple(int(t) for t in tokens[:nfull * bs])
+        keys = _chain_keys(shared, bs, nfull)
+        for k in range(1, nfull + 1):
+            key = keys[k - 1]
+            self._clock += 1
+            ent = self._entries.get(key)
+            if ent is not None and ent[1] == k \
+                    and ent[0][:k * bs] == shared[:k * bs]:
+                self._entries[key] = (ent[0], ent[1], ent[2], self._clock)
+                continue
+            held = self.pool.fork(list(blocks[:k]))
+            self._entries[key] = (shared, k, held, self._clock)
+
+    def evict(self, need_blocks: int,
+              protect: Optional[str] = None) -> int:
+        """Release least-recently-used entries until ``need_blocks`` are
+        free in the pool (or nothing evictable remains). Returns entries
+        evicted. ``protect`` exempts one entry key — the prefix the
+        admission candidate itself is about to reuse must not be the
+        victim of its own make-room pass. Releasing an entry only frees
+        blocks no live request still holds — refcounts make eviction
+        safe mid-flight."""
+        evicted = 0
+        while self.pool.free_count < need_blocks:
+            victims = [k for k in self._entries if k != protect]
+            if not victims:
+                break
+            key = min(victims, key=lambda k: self._entries[k][3])
+            _, _, blocks, _ = self._entries.pop(key)
+            self.pool.release(blocks)
+            evicted += 1
+        return evicted
+
+    def clear(self) -> None:
+        self.evict(self.pool.num_blocks)

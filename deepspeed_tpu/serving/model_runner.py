@@ -1,0 +1,207 @@
+"""Paged transformer forward: generation-path numerics over a block pool.
+
+One pure function, :func:`paged_forward`, serves BOTH serving regimes:
+
+* **prefill** — B=1, T = padded prompt(-suffix) length: writes the
+  prompt's K/V into the sequence's pool blocks and returns logits for
+  every query position (the host samples at the last REAL position);
+* **decode** — B = max_batch (the padded active set), T=1: one fresh
+  token per lane, fixed shapes across admissions/evictions so the jit
+  NEVER re-specializes (the serving loop compiles exactly one decode
+  step — CUDA-graph discipline, enforced by tests).
+
+It mirrors ``models/generation.forward_with_cache`` numerically (same
+layer math, same f32 score path, same -1e30 masking), so a paged serve
+is token-exact with sequential ``generate()`` calls under greedy
+sampling. The differences are mechanical: K/V land in pool slots via one
+scatter per layer instead of a dynamic-update-slice into a dense cache,
+and attention reads ride ``ops.attention.paged_attention`` — the Pallas
+block-table kernel on TPU decode, the exact jnp gather reference
+elsewhere.
+
+Inactive / padded lanes are harmless by construction: their block tables
+are all-NULL, their writes land in the null block, and their outputs are
+discarded by the host. No per-sample left-pad machinery is needed —
+paged sequences are always exact-length.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generation import _dense, _layer_norm, _moe_mlp
+from ..models.transformer import TransformerConfig
+from ..ops.attention import paged_attention
+
+PyTree = Any
+
+
+def paged_forward(cfg: TransformerConfig,
+                  params: PyTree,
+                  input_ids: jnp.ndarray,
+                  pools: Dict[str, jnp.ndarray],
+                  block_tables: jnp.ndarray,
+                  q_start: jnp.ndarray,
+                  context_lens: jnp.ndarray,
+                  block_size: int,
+                  *,
+                  interpret: bool = False
+                  ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Run T tokens per lane at logical positions [q_start, q_start + T)
+    against the paged pool. Returns (logits [B, T, V] f32, updated pools).
+
+    input_ids: [B, T]. pools: {"k","v"} [L, nh, num_slots, hd]
+    (``serving.kv_cache.init_pool`` layout; ``num_slots`` = pool blocks x
+    ``block_size``). block_tables: [B, max_blocks_per_seq] i32 — logical
+    block j of lane b is physical pool block ``block_tables[b, j]``.
+    q_start: [B] i32 — first query's logical position (tokens already in
+    the cache below it are attended: a prefix-cache hit prefills only the
+    suffix). context_lens: [B] i32 — total valid tokens INCLUDING the
+    real queries of this call; query positions >= context_lens are
+    PADDING (their K/V writes route to the null block, their logits are
+    garbage the host never reads). ``block_size`` is static — it shapes
+    the compiled scatter/gather.
+
+    Params must be the scan-layers layout (``ensure_scan_layout``).
+    post-LN encoders don't decode; int8 weight-only params work unchanged
+    (the dequant rides ``_kernel_of``); int8 KV pools are not supported.
+    """
+    if cfg.post_ln:
+        raise NotImplementedError("post-LN encoders (BERT) do not serve")
+    if "blocks" not in params:
+        raise ValueError("paged_forward needs scan-layers params "
+                         "(models.generation.ensure_scan_layout)")
+    B, T = input_ids.shape
+    nbk = block_tables.shape[1]
+    bs = int(block_size)
+    k_pool, v_pool = pools["k"], pools["v"]
+    num_slots = k_pool.shape[2]
+    if num_slots % bs:
+        raise ValueError(f"pool slots {num_slots} not divisible by "
+                         f"block_size {bs}")
+    nb_pool = num_slots // bs
+    L = cfg.num_layers
+    nh, hd = cfg.num_heads, cfg.head_dim
+    kvh = cfg.kv_heads
+    rms = cfg.norm == "rmsnorm"
+    from ..models.transformer import _ACTIVATIONS, alibi_slopes, apply_rotary
+    act = _ACTIVATIONS[cfg.activation]
+    sm_scale = (cfg.attn_scale if cfg.attn_scale is not None
+                else 1.0 / np.sqrt(hd))
+
+    bt = jnp.asarray(block_tables, jnp.int32)
+    q_start = jnp.asarray(q_start, jnp.int32).reshape(B)
+    ctx = jnp.asarray(context_lens, jnp.int32).reshape(B)
+
+    wte = params["wte"]["embedding"]
+    x = wte.astype(cfg.dtype)[input_ids]
+    if cfg.embed_scale is not None:
+        x = x * jnp.asarray(cfg.embed_scale, x.dtype)
+
+    pos = q_start[:, None] + jnp.arange(T)[None, :]        # [B, T] logical
+    if cfg.pos_embed == "learned":
+        wpe = params["wpe"]["embedding"].astype(cfg.dtype)
+        x = x + wpe[jnp.minimum(pos, wpe.shape[0] - 1)]
+    if cfg.embed_ln:
+        x = _layer_norm(x, params["ln_emb"], cfg.layer_norm_eps, rms)
+
+    slopes = (jnp.asarray(alibi_slopes(nh), jnp.float32)
+              if cfg.pos_embed == "alibi" else None)
+    windows = (jnp.asarray(cfg.layer_windows, jnp.int32)
+               if cfg.layer_windows is not None
+               else jnp.zeros((cfg.num_layers,), jnp.int32))
+
+    # write slots: logical position p of lane b lives in pool slot
+    # bt[b, p // bs] * bs + p % bs; PADDED positions (>= ctx) route to the
+    # null block so the fixed-shape step can't corrupt live state
+    blk = jnp.clip(pos // bs, 0, nbk - 1)                  # [B, T]
+    off = pos % bs
+    phys = jnp.take_along_axis(bt, blk, axis=1)            # [B, T]
+    valid = pos < ctx[:, None]
+    slots = jnp.where(valid, phys * bs + off, off)         # null block else
+    flat_slots = slots.reshape(B * T)
+
+    def layer(carry, xs):
+        x, k_pool, v_pool = carry
+        p, window, li = xs
+        h = _layer_norm(x, p["ln1"], cfg.layer_norm_eps, rms)
+        qkv = _dense(h, p["attn_qkv"])
+        q, k, v = jnp.split(qkv, [nh * hd, (nh + kvh) * hd], axis=-1)
+        to_heads = lambda t, n: t.reshape(B, T, n, hd).transpose(0, 2, 1, 3)
+        q, k, v = to_heads(q, nh), to_heads(k, kvh), to_heads(v, kvh)
+        if cfg.qk_norm:
+            q = _layer_norm(q, p["q_norm"], cfg.layer_norm_eps, rms=True)
+            k = _layer_norm(k, p["k_norm"], cfg.layer_norm_eps, rms=True)
+        if cfg.pos_embed == "rotary":
+            # table covers the pool's per-sequence maximum (nbk * bs) —
+            # plain-theta tables are length-independent, so this matches
+            # generate()'s cache-capacity table exactly
+            inv_freq = cfg.rope_inv_freq(nbk * bs)
+            q = apply_rotary(q, pos, cfg.rotary_dim, cfg.rotary_interleaved,
+                             cfg.rope_theta, inv_freq=inv_freq)
+            k = apply_rotary(k, pos, cfg.rotary_dim, cfg.rotary_interleaved,
+                             cfg.rope_theta, inv_freq=inv_freq)
+        if kvh != nh:
+            # GQA: repeat kv to full heads before the pool write (the pool
+            # stays [*, nh, ...] so the paged kernel applies unchanged)
+            k = jnp.repeat(k, nh // kvh, axis=1)
+            v = jnp.repeat(v, nh // kvh, axis=1)
+        # ONE scatter per layer: [B, nh, T, hd] -> [B*T, nh, hd] rows into
+        # flat slots (padded lanes hit the null block)
+        k_rows = k.transpose(0, 2, 1, 3).reshape(B * T, nh, hd)
+        v_rows = v.transpose(0, 2, 1, 3).reshape(B * T, nh, hd)
+        k_pool = k_pool.at[li, :, flat_slots].set(
+            k_rows.astype(k_pool.dtype))
+        v_pool = v_pool.at[li, :, flat_slots].set(
+            v_rows.astype(v_pool.dtype))
+        # attention through the block table (kernel on TPU decode,
+        # exact jnp gather elsewhere)
+        kp5 = k_pool.reshape(L, nh, nb_pool, bs, hd)
+        vp5 = v_pool.reshape(L, nh, nb_pool, bs, hd)
+        o = paged_attention(q, kp5, vp5, bt, ctx, sm_scale=sm_scale,
+                            alibi_slopes=slopes, softcap=cfg.attn_softcap,
+                            window=window, layer_idx=li, q_start=q_start,
+                            interpret=interpret)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, nh * hd)
+        attn_out = _dense(o, p["attn_proj"])
+        if cfg.post_block_norms:
+            attn_out = _layer_norm(attn_out, p["post_attn_norm"],
+                                   cfg.layer_norm_eps, rms)
+
+        def mlp(hin):
+            if cfg.moe_experts > 0:
+                return _moe_mlp(cfg, p["moe"], hin)
+            if cfg.gated_mlp:
+                g = act(_dense(hin, p["mlp_gate"]))
+                return _dense(g * _dense(hin, p["mlp_fc"]), p["mlp_proj"])
+            return _dense(act(_dense(hin, p["mlp_fc"])), p["mlp_proj"])
+
+        if cfg.parallel_residual:
+            m_in = (_layer_norm(x, p["ln2"], cfg.layer_norm_eps, rms)
+                    if cfg.parallel_residual_dual_ln else h)
+            x_out = x + attn_out + mlp(m_in)
+        else:
+            x_mid = x + attn_out
+            h2 = _layer_norm(x_mid, p["ln2"], cfg.layer_norm_eps, rms)
+            m = mlp(h2)
+            if cfg.post_block_norms:
+                m = _layer_norm(m, p["post_mlp_norm"],
+                                cfg.layer_norm_eps, rms)
+            x_out = x_mid + m
+        return (x_out, k_pool, v_pool), None
+
+    xs = (params["blocks"], windows, jnp.arange(cfg.num_layers))
+    (x, k_new, v_new), _ = jax.lax.scan(layer, (x, k_pool, v_pool), xs)
+    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps, rms)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bth,vh->btv", x, wte.astype(x.dtype))
+    else:
+        logits = _dense(x, params["lm_head"])
+    if cfg.final_logit_softcap:
+        from ..ops.attention import apply_softcap
+        logits = apply_softcap(logits, cfg.final_logit_softcap)
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}

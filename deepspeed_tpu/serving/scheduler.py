@@ -1,0 +1,180 @@
+"""Continuous-batching request scheduler: admission control + FIFO queue.
+
+The serving loop (serving/engine.py) is a fixed-shape decode step over
+``max_batch`` lanes; this module decides WHICH requests occupy those
+lanes. Design contract:
+
+* **Admission control by block budget.** A request is admitted only when
+  the pool can cover its whole lifetime — ``ceil((prompt + max_new - 1)
+  / block_size)`` blocks, minus whatever a prefix-cache hit contributes.
+  Admitting on the full lifetime (not just the prompt) means an admitted
+  sequence can NEVER hit the pool mid-decode: exhaustion is a
+  queue-time, not a crash-time, condition.
+* **Strict FIFO.** If the head of the queue does not fit, nothing behind
+  it is admitted either — a stream of small requests cannot starve a big
+  one (fairness under a full pool is a pinned test).
+* **In-flight batching.** ``next_admission`` is consulted every loop
+  iteration, so new prefills enter as soon as finishing sequences return
+  their blocks — no batch drain barrier.
+
+Failpoints (testing/chaos.py): ``serve.enqueue`` fires in :meth:`submit`
+(a rejected/exploding enqueue must surface to the caller, not wedge the
+loop); ``serve.oom`` fires inside ``BlockPool.alloc`` (the engine treats
+it exactly like a genuinely full pool: the request stays queued).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from ..testing import chaos
+from ..utils.logging import logger
+from .kv_cache import BlockPool, PrefixCache
+
+#: request lifecycle states
+QUEUED, PREFILL, RUNNING, FINISHED, FAILED = (
+    "QUEUED", "PREFILL", "RUNNING", "FINISHED", "FAILED")
+
+_rid = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request riding the serving loop."""
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token_id: Optional[int] = None
+    on_finish: Optional[Callable[["Request"], None]] = None
+    rid: int = field(default_factory=lambda: next(_rid))
+    # -- filled by the engine -------------------------------------------------
+    state: str = QUEUED
+    output_tokens: List[int] = field(default_factory=list)
+    prefix_hit_tokens: int = 0
+    arrival_ts: float = field(default_factory=time.monotonic)
+    first_token_ts: Optional[float] = None
+    finish_ts: Optional[float] = None
+    error: Optional[str] = None
+
+    @property
+    def tokens(self) -> List[int]:
+        return list(self.prompt) + list(self.output_tokens)
+
+    @property
+    def done(self) -> bool:
+        return self.state in (FINISHED, FAILED)
+
+    def _finish(self, state: str = FINISHED,
+                error: Optional[str] = None) -> None:
+        self.state = state
+        self.error = error
+        self.finish_ts = time.monotonic()
+        if self.on_finish is not None:
+            try:
+                self.on_finish(self)
+            except Exception:           # callbacks must not kill the loop
+                logger.exception("serving: on_finish callback for request "
+                                 "%d raised", self.rid)
+
+
+class Scheduler:
+    """FIFO queue + block-budget admission over a shared :class:`BlockPool`.
+
+    Thread-safe on the queue: ``submit`` may be called from any thread
+    (the Poisson load generator, an RPC handler); admission and
+    completion run on the serving loop's thread.
+    """
+
+    def __init__(self, pool: BlockPool, max_queue: int = 4096,
+                 max_model_len: Optional[int] = None,
+                 prefix_cache: Optional[PrefixCache] = None):
+        self.pool = pool
+        self.prefix_cache = prefix_cache
+        self.max_queue = int(max_queue)
+        self.max_model_len = max_model_len
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ queue side
+
+    def submit(self, req: Request) -> Request:
+        """Enqueue; raises on a full queue or an over-long request (the
+        caller must know synchronously — a silently dropped request is a
+        hung client)."""
+        chaos.failpoint("serve.enqueue")
+        total = len(req.prompt) + req.max_new_tokens
+        if not req.prompt:
+            raise ValueError("empty prompt")
+        if self.max_model_len is not None and total > self.max_model_len:
+            raise ValueError(
+                f"request {req.rid}: prompt + max_new_tokens = {total} "
+                f"exceeds max_model_len {self.max_model_len}")
+        # a lifetime budget beyond the WHOLE pool could never be admitted:
+        # under strict FIFO it would wedge the queue forever (and no
+        # watchdog would fire — the loop keeps iterating). Reject now.
+        allocatable = self.pool.num_blocks - 1
+        if self.blocks_needed(req) > allocatable:
+            raise ValueError(
+                f"request {req.rid}: needs {self.blocks_needed(req)} KV "
+                f"blocks, pool has {allocatable} total — raise "
+                "serving.pool_blocks or shrink the request")
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                raise RuntimeError(
+                    f"serving queue full ({self.max_queue}); apply "
+                    "backpressure upstream")
+            self._queue.append(req)
+        return req
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def pending(self) -> int:
+        return len(self)
+
+    # -------------------------------------------------------- admission side
+
+    def blocks_needed(self, req: Request, prefix_tokens: int = 0) -> int:
+        """Lifetime block budget: the cache holds prompt + max_new - 1
+        tokens (the final sampled token is never written back), minus the
+        full blocks a prefix hit already provides."""
+        life = len(req.prompt) + max(req.max_new_tokens - 1, 0)
+        return self.pool.blocks_for_tokens(life - prefix_tokens)
+
+    def next_admission(self) -> Optional[Request]:
+        """Pop the head iff its block budget fits (strict FIFO: a head
+        that does not fit blocks everything behind it). Tries prefix-cache
+        eviction before giving up — cached-but-unused blocks must never
+        starve admissions."""
+        with self._lock:
+            if not self._queue:
+                return None
+            head = self._queue[0]
+            hit_tokens, hit_key = ((0, None) if self.prefix_cache is None
+                                   else self.prefix_cache.peek(head.prompt))
+            # budget NET of the prefix hit, and the make-room eviction
+            # protects the hit's entry — the head's own reusable prefix
+            # must never be the victim of admitting the head
+            need = self.blocks_needed(head, prefix_tokens=hit_tokens)
+            if need > self.pool.free_count and self.prefix_cache is not None:
+                self.prefix_cache.evict(need, protect=hit_key)
+            if need > self.pool.free_count:
+                return None
+            self._queue.popleft()
+            return head
+
+    def requeue_front(self, req: Request) -> None:
+        """Put an admission back at the HEAD (transient allocation failure
+        — chaos 'serve.oom' or a racing allocation): FIFO order is
+        preserved and the request is retried next iteration."""
+        with self._lock:
+            self._queue.appendleft(req)

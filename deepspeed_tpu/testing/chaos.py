@@ -43,6 +43,13 @@ need an IO operation to still be in flight when something else happens.
 preemption handler fires, exactly like a real TPU preemption notice).
 ``kill`` takes ``code=N`` to emulate any exit-code contract.
 
+Serving failpoints (round-8, the continuous-batching loop): on the
+serving hot path production code declares ``serve.enqueue``
+(Scheduler.submit — an exploding enqueue must surface to the submitting
+caller, never wedge the loop) and ``serve.oom`` (BlockPool.alloc — an
+injected allocation failure must leave the request QUEUED and the loop
+serving, indistinguishable from a genuinely full pool).
+
 Query mode (round-7, the training-integrity sentinel): ``flag`` never
 raises or kills — production code ASKS :func:`flag` whether the site is
 armed and fired, and perturbs its own data when it is (a grad spike

@@ -5,12 +5,13 @@
 #   - multi-second subprocess matrices (engine-in-child chaos/supervision
 #     tests) — also run by scripts/chaos.sh;
 #   - heavy model-integration legs (multi-step training parity, 2-proc
-#     gloo TP+PP, HF parity, remat/fused-loss agreement) that were moved
-#     out of tier-1 to keep its wall clock inside the 870s budget on
-#     2-core CI hosts. Each has a cheaper cousin still gating tier-1.
+#     gloo TP+PP, HF parity, remat/fused-loss agreement, the round-8
+#     serving architecture matrix) that were moved out of tier-1 to keep
+#     its wall clock inside the 870s budget on 2-core CI hosts. Each has
+#     a cheaper cousin still gating tier-1.
 #
-# Run this after any change to runtime/, models/, or inference/ that
-# tier-1 alone can't be trusted to cover.
+# Run this after any change to runtime/, models/, inference/, or
+# serving/ that tier-1 alone can't be trusted to cover.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

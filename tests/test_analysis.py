@@ -968,6 +968,137 @@ def test_tpu012_negative_pmap_axis(tmp_path):
     assert "TPU012" not in codes(findings, gating_only=False)
 
 
+def test_tpu012_positive_module_constant_axis(tmp_path):
+    """Round-8 depth: an axis passed AS a module-level constant resolves
+    like the literal — a constant naming an undeclared axis is flagged."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax import lax
+
+        WRONG_AXIS = "modle"
+
+        def run(xs, mesh):
+            def inner(x):
+                return lax.psum(x, WRONG_AXIS)
+            return jax.shard_map(inner, mesh=mesh, in_specs=None,
+                                 out_specs=None, axis_names=("model",))(xs)
+    """)
+    (f,) = [f for f in findings if f.rule == "TPU012"]
+    assert "modle" in f.message and "'model'" in f.message
+
+
+def test_tpu012_negative_module_constant_axis_and_context(tmp_path):
+    """Constants on BOTH sides: axis_names declared from a constant tuple
+    and the collective passing a member constant — no finding; a tuple
+    mixing constants and literals resolves too."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax import lax
+
+        DATA_AXIS = "data"
+        MODEL_AXIS = "model"
+        MESH_AXES = (DATA_AXIS, MODEL_AXIS)
+
+        def run(xs, mesh):
+            def inner(x):
+                y = lax.psum(x, MODEL_AXIS)
+                return lax.pmean(y, (DATA_AXIS, "model"))
+            return jax.shard_map(inner, mesh=mesh, in_specs=None,
+                                 out_specs=None, axis_names=MESH_AXES)(xs)
+    """)
+    assert "TPU012" not in codes(findings, gating_only=False)
+
+
+def test_tpu012_constant_axis_cross_module(tmp_path):
+    """The constant lives in ANOTHER module of the lint run (the
+    parallel/mesh.py idiom): resolution follows the import map. The typo'd
+    import is flagged against the project universe; the valid one is not."""
+    from deepspeed_tpu.analysis import lint_paths
+    import textwrap
+    (tmp_path / "meshdef.py").write_text(textwrap.dedent("""
+        MODEL_AXIS = "model"
+        BAD_AXIS = "modle"
+        MESH_AXES = ("data", "model")
+    """))
+    (tmp_path / "user.py").write_text(textwrap.dedent("""
+        from jax import lax
+        from meshdef import BAD_AXIS, MODEL_AXIS
+
+        def good(x):
+            return lax.psum(x, MODEL_AXIS)
+
+        def bad(x):
+            return lax.psum(x, BAD_AXIS)
+    """))
+    findings = lint_paths([str(tmp_path / "meshdef.py"),
+                           str(tmp_path / "user.py")], root=str(tmp_path))
+    tpu12 = [f for f in findings if f.rule == "TPU012"]
+    assert len(tpu12) == 1 and tpu12[0].symbol == "bad"
+
+
+def test_tpu012_negative_locally_shadowed_constant(tmp_path):
+    """A function-local binding (param or assignment) shadowing a
+    module-level constant reads the LOCAL value — a variable axis, the
+    caller's contract; the module constant must not be resolved."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax import lax
+
+        AXIS = "not_an_axis"
+
+        def facade(x, AXIS):
+            return lax.psum(x, AXIS)
+
+        def run(xs, mesh):
+            def inner(x):
+                AXIS = pick_axis()
+                return lax.pmean(x, AXIS)
+            return jax.shard_map(inner, mesh=mesh, in_specs=None,
+                                 out_specs=None, axis_names=("model",))(xs)
+    """)
+    assert "TPU012" not in codes(findings, gating_only=False)
+
+
+def test_tpu012_negative_locally_shadowed_imported_constant(tmp_path):
+    """Shadowing must also beat the IMPORT MAP: a parameter named like an
+    imported constant is a variable axis, not the other module's value."""
+    from deepspeed_tpu.analysis import lint_paths
+    import textwrap
+    (tmp_path / "meshdef2.py").write_text(textwrap.dedent("""
+        MODEL_AXIS = "not_declared_anywhere"
+        MESH_AXES = ("data", "model")
+    """))
+    (tmp_path / "user2.py").write_text(textwrap.dedent("""
+        from jax import lax
+        from meshdef2 import MODEL_AXIS
+
+        def facade(x, MODEL_AXIS):
+            return lax.psum(x, MODEL_AXIS)
+    """))
+    findings = lint_paths([str(tmp_path / "meshdef2.py"),
+                           str(tmp_path / "user2.py")], root=str(tmp_path))
+    assert "TPU012" not in codes(findings, gating_only=False)
+
+
+def test_tpu012_negative_conflicting_constant(tmp_path):
+    """A name assigned two different literals is poisoned — never guess
+    which assignment is live at the call site."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax import lax
+
+        AXIS = "modle"
+        AXIS = "other_modle"
+
+        def run(xs, mesh):
+            def inner(x):
+                return lax.psum(x, AXIS)
+            return jax.shard_map(inner, mesh=mesh, in_specs=None,
+                                 out_specs=None, axis_names=("model",))(xs)
+    """)
+    assert "TPU012" not in codes(findings, gating_only=False)
+
+
 # --------------------------------------- TPU013 (collective-order divergence)
 
 def test_tpu013_positive_raise_between_collectives(tmp_path):

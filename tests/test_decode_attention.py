@@ -13,7 +13,7 @@ import pytest
 from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
 
 
-def _oracle(q, kc, vc, cur, window=0):
+def _oracle(q, kc, vc, cur, window=0, slopes=None, softcap=0.0):
     B, nh, T, hd = q.shape
     max_len = kc.shape[2]
     q_abs = np.arange(cur - T, cur)
@@ -23,6 +23,11 @@ def _oracle(q, kc, vc, cur, window=0):
         mask = mask & (q_abs[:, None] - k_pos[None, :] < window)
     s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q, np.float32),
                   np.asarray(kc, np.float32)) / np.sqrt(hd)
+    if softcap:
+        s = np.tanh(s / softcap) * softcap
+    if slopes is not None:
+        dist = (k_pos[None, :] - q_abs[:, None]).astype(np.float32)
+        s = s + slopes[None, :, None, None] * dist[None, None]
     s = np.where(mask[None, None], s, -1e30)
     p = np.asarray(jax.nn.softmax(jnp.asarray(s), axis=-1))
     return np.einsum("bhqk,bhkd->bhqd", p, np.asarray(vc, np.float32))
@@ -74,6 +79,46 @@ def test_decode_stacked_layer_cache():
         np.testing.assert_allclose(
             np.asarray(out), _oracle(q, kcl[li], vcl[li], cur),
             rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("T,cur", [(1, 200), (4, 300)])
+def test_decode_alibi(T, cur):
+    """BLOOM/MPT regime: per-head ALiBi slopes applied in-kernel."""
+    q, kc, vc = _data(T=T, cur=cur)
+    from deepspeed_tpu.models.transformer import alibi_slopes
+    sl = np.asarray(alibi_slopes(4), np.float32)
+    out = decode_attention(jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+                           jnp.asarray(cur, jnp.int32),
+                           alibi_slopes=jnp.asarray(sl), interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               _oracle(q, kc, vc, cur, slopes=sl),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_softcap():
+    """Gemma-2 regime: tanh logit softcap in-kernel, pre-mask."""
+    q, kc, vc = _data(cur=300)
+    out = decode_attention(jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+                           jnp.asarray(300, jnp.int32), softcap=20.0,
+                           interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               _oracle(q, kc, vc, 300, softcap=20.0),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_alibi_softcap_window_compose():
+    q, kc, vc = _data(cur=400)
+    from deepspeed_tpu.models.transformer import alibi_slopes
+    sl = np.asarray(alibi_slopes(4), np.float32)
+    out = decode_attention(jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+                           jnp.asarray(400, jnp.int32),
+                           window=jnp.asarray(64, jnp.int32),
+                           alibi_slopes=jnp.asarray(sl), softcap=15.0,
+                           interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        _oracle(q, kc, vc, 400, window=64, slopes=sl, softcap=15.0),
+        rtol=2e-5, atol=2e-5)
 
 
 def test_decode_bf16():

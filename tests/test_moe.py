@@ -200,6 +200,10 @@ def test_moe_param_accounting():
         (n_tree, cfg.num_params())
 
 
+# tier-2 (round 8 budget): test_moe_transformer_trains (top-1, ungated)
+# keeps MoE training gating tier-1; SwiGLU-expert decode parity stays in
+# test_hf_policies.test_moe_decode_parity
+@pytest.mark.slow
 def test_gated_moe_transformer_trains():
     """SwiGLU experts (Mixtral family, round 5): gated_mlp + moe_experts
     trains under expert parallelism — round 4 refused the combination.

@@ -184,6 +184,9 @@ def _free_port():
     return port
 
 
+# tier-2 (round 8 budget): the 2-proc gloo category runs in tier2/chaos.sh;
+# in-process multi-device engine training keeps gating tier-1
+@pytest.mark.slow
 def test_two_process_train_and_checkpoint(tmp_path):
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER)

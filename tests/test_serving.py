@@ -1,0 +1,437 @@
+"""Continuous-batching serving loop: token-exactness, fixed-shape compile
+discipline, block-pool admission control, prefix-cache COW, FIFO fairness,
+chaos failpoints, SERVE heartbeat supervision.
+
+The oracle everywhere is sequential ``models.generation.generate()`` —
+greedy serving output must be TOKEN-EXACT with one-at-a-time generation
+(same layer math through serving/model_runner.py), across staggered
+arrivals, mixed lengths, admissions and evictions.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.models.generation import generate
+from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.kv_cache import (BlockPool, BlockPoolExhausted,
+                                            PrefixCache)
+from deepspeed_tpu.serving.scheduler import QUEUED
+from deepspeed_tpu.testing import chaos
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    # f32: the token-exactness contract compares greedy argmaxes between
+    # two mathematically-identical-but-differently-fused programs; bf16's
+    # 8-bit mantissa makes 1-ulp near-ties on a random tiny model likely
+    model, cfg = build_model(
+        "gpt2-tiny", hidden_size=32, num_layers=2, num_heads=2,
+        vocab_size=64, max_seq_len=256, attention_impl="reference",
+        dtype=jnp.float32)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    return cfg, params
+
+
+def _oracle_tokens(cfg, params, prompt, n):
+    out = generate(cfg, params, jnp.asarray([list(prompt)]), n)
+    return [int(x) for x in np.asarray(out)[0][len(prompt):]]
+
+
+SERVE_CFG = {"block_size": 16, "pool_blocks": 64, "max_batch": 4,
+             "max_blocks_per_seq": 8}
+
+
+# ---------------------------------------------------------------------------
+# the acceptance-criteria integration leg
+# ---------------------------------------------------------------------------
+
+def test_serving_integration_staggered_token_exact(tiny):
+    """>= 8 concurrent requests, staggered arrivals, mixed lengths, greedy:
+    token-exact vs sequential generate(), with EXACTLY ONE decode-step
+    compile across all admissions/evictions (fixed-shape discipline)."""
+    cfg, params = tiny
+    eng = ServingEngine(cfg, params, serving=SERVE_CFG)
+    rng = np.random.default_rng(7)
+    # 9 requests (> max_batch lanes), 4 distinct prompt lengths and 2
+    # distinct generation lengths: mixed-length coverage while the
+    # sequential-generate oracle compiles only 4 (T, max_new) programs
+    # (tier-1 budget — each distinct pair is one _generate trace)
+    lens = [5, 11, 17, 23, 5, 17, 11, 23, 11]
+    prompts = [list(rng.integers(1, 64, size=n)) for n in lens]
+    new = [6, 6, 8, 8, 6, 8, 6, 8, 6]     # per-length, so 4 oracle pairs
+    finished = []
+    # staggered: 3 up front, 3 after a couple of loop iterations, 3 after
+    # the first completions — admissions ride a live, partially-full loop
+    reqs = [eng.submit(prompts[i], new[i],
+                       on_finish=lambda r: finished.append(r.rid))
+            for i in range(3)]
+    eng.step(); eng.step()
+    reqs += [eng.submit(prompts[i], new[i]) for i in range(3, 6)]
+    while eng.stats["completed"] == 0:
+        eng.step()
+    reqs += [eng.submit(prompts[i], new[i]) for i in range(6, 9)]
+    eng.run_until_idle()
+
+    assert eng.stats["completed"] == 9
+    for p, n, r in zip(prompts, new, reqs):
+        assert r.output_tokens == _oracle_tokens(cfg, params, p, n), \
+            f"request {r.rid} diverged from sequential generate()"
+    # the fixed-shape decode step compiled exactly once
+    cache_size = getattr(eng._decode_fn, "_cache_size", None)
+    if cache_size is None:
+        pytest.skip("jax build has no PjitFunction._cache_size")
+    assert cache_size() == 1
+    assert finished                      # completion callbacks fired
+
+
+def test_serving_pool_released_after_drain(tiny):
+    cfg, params = tiny
+    eng = ServingEngine(cfg, params,
+                        serving=dict(SERVE_CFG, prefix_cache=False))
+    rng = np.random.default_rng(3)
+    eng.generate_batch([list(rng.integers(1, 64, size=12))] * 3,
+                       max_new_tokens=5)
+    assert eng.pool.used_count == 0      # every block returned
+
+
+# ---------------------------------------------------------------------------
+# block pool + prefix cache units
+# ---------------------------------------------------------------------------
+
+def test_block_pool_alloc_release_refcounts():
+    pool = BlockPool(num_blocks=8, block_size=16)
+    assert pool.free_count == 7          # block 0 reserved
+    a = pool.alloc(3)
+    assert 0 not in a and pool.free_count == 4
+    shared = pool.fork(a[:2])
+    assert pool.refcount(a[0]) == 2
+    pool.release(a)                      # first holder gone
+    assert pool.free_count == 5          # a[2] back; a[0], a[1] still held
+    assert pool.refcount(a[0]) == 1
+    pool.release(shared)
+    assert pool.free_count == 7
+    with pytest.raises(BlockPoolExhausted):
+        pool.alloc(8)
+    with pytest.raises(ValueError):
+        pool.fork([0])                   # null block is never shareable
+
+
+def test_prefix_cache_match_insert_evict():
+    pool = BlockPool(num_blocks=16, block_size=4)
+    cache = PrefixCache(pool)
+    toks = list(range(10))               # 2 full blocks + 2 tokens
+    blocks = pool.alloc(3)
+    cache.insert(toks, blocks)
+    assert len(cache) == 2               # k=1 and k=2 prefixes
+    n, forked = cache.match(toks)
+    assert n == 8 and forked == blocks[:2]
+    # owner + one ref per covering cache entry (k=1, k=2) + the fork:
+    # per-entry refs keep partial eviction safe (dropping the k=2 entry
+    # must not free the block the k=1 entry still serves)
+    assert pool.refcount(blocks[0]) == 4
+    pool.release(forked)
+    # an 8-token prompt (exactly 2 blocks) must leave >= 1 token to
+    # prefill: only the 1-block prefix may be reused
+    n8, forked8 = cache.match(toks[:8])
+    assert n8 == 4
+    pool.release(forked8)
+    # eviction under pressure releases LRU entries (owner refs remain)
+    pool.release(blocks)
+    cache.evict(pool.num_blocks)
+    assert pool.used_count == 0
+
+
+def test_prefix_cache_hash_collision_guard():
+    pool = BlockPool(num_blocks=8, block_size=4)
+    cache = PrefixCache(pool)
+    blocks = pool.alloc(1)
+    cache.insert([1, 2, 3, 4], blocks)
+    n, forked = cache.match([9, 9, 9, 9, 5])
+    assert n == 0 and forked == []
+
+
+def test_serving_prefix_cow_blocks_are_shared_readonly(tiny):
+    """Forked prefix blocks are refcounted and READ-ONLY: the consumer
+    writes only above its fork point, the donor's block contents are
+    bit-identical after the consumer runs, and freeing the donor does not
+    corrupt the consumer (token-exactness holds throughout)."""
+    cfg, params = tiny
+    eng = ServingEngine(cfg, params, serving=SERVE_CFG)
+    rng = np.random.default_rng(11)
+    sys_prompt = list(rng.integers(1, 64, size=32))      # 2 full blocks
+    p1 = sys_prompt + list(rng.integers(1, 64, size=5))
+    p2 = sys_prompt + list(rng.integers(1, 64, size=9))
+
+    r1 = eng.submit(p1, 4)
+    eng.run_until_idle()
+    assert r1.output_tokens == _oracle_tokens(cfg, params, p1, 4)
+    # the shared blocks live on in the prefix cache after r1 drained
+    n, forked = eng.prefix_cache.match(p2)
+    assert n == 32
+    shared = list(forked)
+    eng.pool.release(forked)             # undo the probe's fork
+    snapshot = np.asarray(
+        eng.pools["k"][:, :, shared[0] * 16:(shared[0] + 1) * 16])
+
+    r2 = eng.submit(p2, 4)
+    eng.run_until_idle()
+    assert r2.prefix_hit_tokens == 32    # reused, not recomputed
+    assert r2.output_tokens == _oracle_tokens(cfg, params, p2, 4)
+    after = np.asarray(
+        eng.pools["k"][:, :, shared[0] * 16:(shared[0] + 1) * 16])
+    np.testing.assert_array_equal(snapshot, after)   # copy-on-write honored
+
+
+# ---------------------------------------------------------------------------
+# admission control / FIFO / chaos
+# ---------------------------------------------------------------------------
+
+def test_pool_exhaustion_queues_not_crashes(tiny):
+    """More lifetime blocks than the pool holds: the overflow requests
+    WAIT (admission control) and complete as earlier ones free blocks."""
+    cfg, params = tiny
+    eng = ServingEngine(cfg, params,
+                        serving={"block_size": 16, "pool_blocks": 5,
+                                 "max_batch": 4, "max_blocks_per_seq": 4,
+                                 "prefix_cache": False})
+    rng = np.random.default_rng(5)
+    prompts = [list(rng.integers(1, 64, size=20)) for _ in range(4)]
+    reqs = [eng.submit(p, 6) for p in prompts]          # 2 blocks each, 4 free
+    eng.step()
+    assert eng.active == 2 and eng.scheduler.pending == 2   # budget-limited
+    eng.run_until_idle()
+    assert eng.stats["completed"] == 4
+    for p, r in zip(prompts, reqs):
+        assert r.output_tokens == _oracle_tokens(cfg, params, p, 6)
+
+
+def test_fifo_fairness_under_full_pool(tiny):
+    """Strict FIFO: a big head request that does not fit blocks the small
+    ones behind it — small traffic cannot starve a large request."""
+    cfg, params = tiny
+    eng = ServingEngine(cfg, params,
+                        serving={"block_size": 16, "pool_blocks": 7,
+                                 "max_batch": 4, "max_blocks_per_seq": 6,
+                                 "prefix_cache": False})
+    rng = np.random.default_rng(6)
+    running = eng.submit(list(rng.integers(1, 64, size=40)), 6)   # 3 blocks
+    eng.step()
+    assert eng.active == 1
+    big = eng.submit(list(rng.integers(1, 64, size=60)), 6)       # 4 blocks
+    small = eng.submit(list(rng.integers(1, 64, size=8)), 4)      # 1 block
+    eng.step()
+    # 3 free blocks: big does not fit; small WOULD fit but must wait
+    assert big.state == QUEUED and small.state == QUEUED
+    eng.run_until_idle()
+    assert running.done and big.done and small.done
+    assert big.first_token_ts <= small.first_token_ts    # FIFO admission
+
+
+def test_prefill_failure_marks_failed_and_releases_blocks(tiny):
+    """A deterministic forward failure mid-prefill must not leak blocks:
+    the request is FAILED (callback fires, stats count it), the pool is
+    whole, and the loop keeps serving."""
+    cfg, params = tiny
+    eng = ServingEngine(cfg, params,
+                        serving=dict(SERVE_CFG, prefix_cache=False))
+    boom = eng._prefill_fn
+    eng._prefill_fn = lambda *a, **kw: (_ for _ in ()).throw(
+        RuntimeError("injected prefill failure"))
+    seen = []
+    req = eng.submit([1, 2, 3, 4], 4, on_finish=lambda r: seen.append(r))
+    with pytest.raises(RuntimeError, match="injected"):
+        eng.step()
+    assert req.state == "FAILED" and "injected" in req.error
+    assert seen and eng.stats["failed"] == 1
+    assert eng.pool.used_count == 0          # nothing leaked
+    eng._prefill_fn = boom
+    ok = eng.submit([1, 2, 3, 4], 3)
+    eng.run_until_idle()
+    assert ok.done and ok.state == "FINISHED"
+
+
+def test_admission_eviction_protects_heads_own_prefix(tiny):
+    """Make-room eviction nets the head's prefix hit out of the budget and
+    never evicts the entry the head is about to reuse."""
+    cfg, params = tiny
+    eng = ServingEngine(cfg, params,
+                        serving={"block_size": 16, "pool_blocks": 6,
+                                 "max_batch": 2, "max_blocks_per_seq": 5})
+    rng = np.random.default_rng(17)
+    shared = list(rng.integers(1, 64, size=32))          # 2 full blocks
+    r1 = eng.submit(shared + [5, 6], 2)                  # 3 blocks lifetime
+    eng.run_until_idle()
+    # cache holds the 2 shared blocks; 3 blocks free. The follower needs
+    # 3 total, nets to 1 with the hit — admissible WITHOUT eviction even
+    # though the gross budget (3) equals free (3): the hit survives
+    r2 = eng.submit(shared + [7, 8, 9], 2)
+    eng.run_until_idle()
+    assert r1.done and r2.done
+    assert r2.prefix_hit_tokens == 32        # the entry was not evicted
+
+
+def test_chaos_serve_oom_keeps_request_queued(tiny):
+    cfg, params = tiny
+    eng = ServingEngine(cfg, params, serving=SERVE_CFG)
+    rng = np.random.default_rng(8)
+    prompt = list(rng.integers(1, 64, size=10))
+    chaos.arm("serve.oom", "raise", times=2)
+    req = eng.submit(prompt, 4)
+    eng.step()
+    assert req.state == QUEUED and not req.done     # deferred, not failed
+    assert chaos.fired("serve.oom")
+    eng.step(); eng.step()                          # failpoint exhausted
+    eng.run_until_idle()
+    assert req.done and req.output_tokens == \
+        _oracle_tokens(cfg, params, prompt, 4)
+
+
+def test_chaos_serve_enqueue_surfaces_to_caller(tiny):
+    cfg, params = tiny
+    eng = ServingEngine(cfg, params, serving=SERVE_CFG)
+    chaos.arm("serve.enqueue", "raise")
+    with pytest.raises(chaos.ChaosError):
+        eng.submit([1, 2, 3], 4)
+    # the loop itself is unharmed
+    eng.submit([1, 2, 3], 2)
+    eng.run_until_idle()
+    assert eng.stats["completed"] == 1
+
+
+def test_scheduler_rejects_overlong_and_full_queue(tiny):
+    cfg, params = tiny
+    eng = ServingEngine(cfg, params,
+                        serving=dict(SERVE_CFG, max_queue=1))
+    with pytest.raises(ValueError, match="max_model_len"):
+        eng.submit(list(range(1, 60)) * 3, 128)     # 177 + 128 > 128
+    eng.submit([1, 2, 3], 2)
+    with pytest.raises(RuntimeError, match="queue full"):
+        eng.submit([4, 5, 6], 2)
+
+
+def test_submit_rejects_request_bigger_than_whole_pool(tiny):
+    """A lifetime budget beyond the pool could NEVER be admitted — under
+    strict FIFO it would wedge the queue forever while the loop keeps
+    heartbeating. submit() must reject it synchronously."""
+    cfg, params = tiny
+    eng = ServingEngine(cfg, params,
+                        serving={"block_size": 16, "pool_blocks": 3,
+                                 "max_batch": 2, "max_blocks_per_seq": 8,
+                                 "prefix_cache": False})
+    with pytest.raises(ValueError, match="pool has 2"):
+        eng.submit(list(range(1, 40)), 16)          # needs 4 > 2 blocks
+    # a fitting request still serves
+    r = eng.submit([1, 2, 3], 2)
+    eng.run_until_idle()
+    assert r.done
+
+
+# ---------------------------------------------------------------------------
+# supervision + sampling + entry points
+# ---------------------------------------------------------------------------
+
+def test_serving_stamps_serve_heartbeat(tmp_path, tiny):
+    import json
+    from deepspeed_tpu.runtime.heartbeat import (PHASE_EXIT, PHASE_SERVE,
+                                                 HeartbeatWriter,
+                                                 heartbeat_path,
+                                                 read_heartbeats)
+    cfg, params = tiny
+    hb = HeartbeatWriter(str(tmp_path), rank=0, min_interval=0.0,
+                         refresh_interval=0.0)
+    eng = ServingEngine(cfg, params, serving=SERVE_CFG, heartbeat=hb)
+    eng.submit([1, 2, 3, 4], 3)
+    eng.run_until_idle()
+    eng.close()
+    with open(heartbeat_path(str(tmp_path), 0), encoding="utf-8") as f:
+        phases = [json.loads(ln)["phase"] for ln in f if ln.strip()]
+    assert PHASE_SERVE in phases         # the loop was supervised
+    assert read_heartbeats(str(tmp_path))[0]["phase"] == PHASE_EXIT
+
+
+def test_serving_eos_and_temperature_lanes(tiny):
+    cfg, params = tiny
+    eng = ServingEngine(cfg, params, serving=SERVE_CFG)
+    greedy = _oracle_tokens(cfg, params, [5, 6, 7, 8], 6)
+    # eos cut: force eos at the first greedy token -> finishes after 1
+    r_eos = eng.submit([5, 6, 7, 8], 6, eos_token_id=greedy[0])
+    # a temperature lane rides the same compiled step
+    r_temp = eng.submit([9, 10, 11], 6, temperature=0.8)
+    eng.run_until_idle()
+    assert r_eos.output_tokens == [greedy[0]]
+    assert len(r_temp.output_tokens) == 6
+    with pytest.raises(NotImplementedError):
+        eng.submit([1, 2], 4, top_k=5)
+
+
+def test_init_inference_serve_entry(tiny):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import Transformer
+    cfg, params = tiny
+    module = Transformer(cfg)
+    eng = deepspeed_tpu.init_inference(
+        module, {"dtype": "float32",
+                 "serving": {"block_size": 16, "pool_blocks": 32,
+                             "max_batch": 2, "max_blocks_per_seq": 8}},
+        model_parameters=params)
+    srv = eng.serve()
+    out = srv.generate_batch([[3, 1, 4, 1, 5]], max_new_tokens=4)
+    assert out[0] == _oracle_tokens(cfg, params, [3, 1, 4, 1, 5], 4)
+
+
+def test_inference_bench_poisson_line(capsys):
+    """The Poisson load leg drives the serving loop and prints the
+    machine-readable p50/p99 line (acceptance criterion)."""
+    import json
+    from deepspeed_tpu.benchmarks.inference_bench import run_poisson
+    row = run_poisson(
+        "gpt2-tiny", rate=200.0, num_requests=5, prompt_len=24,
+        new_tokens=4,
+        serving={"block_size": 16, "pool_blocks": 32, "max_batch": 4,
+                 "max_blocks_per_seq": 8},
+        model_kwargs=dict(hidden_size=32, num_layers=2, num_heads=2,
+                          vocab_size=64, attention_impl="reference"))
+    line = [ln for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("inference_bench poisson: ")]
+    assert line, "machine-readable poisson line missing"
+    parsed = json.loads(line[0].split("inference_bench poisson: ", 1)[1])
+    for key in ("p50_s", "p99_s", "tokens_per_s_per_chip", "rate"):
+        assert key in parsed and parsed[key] == row[key]
+    assert 0 < row["p50_s"] <= row["p99_s"]
+
+
+@pytest.mark.slow
+def test_serving_arch_matrix_token_exact():
+    """Heavier matrix: ALiBi+softcap (Gemma/BLOOM-class), sliding window,
+    GQA+rotary+RMSNorm — each serves token-exact vs sequential
+    generate()."""
+    archs = [
+        dict(pos_embed="alibi", attn_softcap=20.0, final_logit_softcap=15.0,
+             norm="layernorm"),
+        dict(layer_windows=(32, 32), pos_embed="rotary"),
+        dict(pos_embed="rotary", norm="rmsnorm", gated_mlp=True,
+             activation="silu", num_kv_heads=2, tie_embeddings=False),
+    ]
+    rng = np.random.default_rng(13)
+    for kw in archs:
+        model, cfg = build_model("gpt2-tiny", hidden_size=32, num_layers=2,
+                                 num_heads=4, vocab_size=64, max_seq_len=128,
+                                 attention_impl="reference",
+                                 dtype=jnp.float32, **kw)
+        ids = np.zeros((1, 8), np.int32)
+        params = model.init(jax.random.PRNGKey(1),
+                            {"input_ids": ids})["params"]
+        eng = ServingEngine(cfg, params,
+                            serving={"block_size": 16, "pool_blocks": 32,
+                                     "max_batch": 3, "max_blocks_per_seq": 8})
+        prompts = [list(rng.integers(1, 64, size=n)) for n in (6, 13, 21)]
+        reqs = [eng.submit(p, 5) for p in prompts]
+        eng.run_until_idle()
+        for p, r in zip(prompts, reqs):
+            assert r.output_tokens == _oracle_tokens(cfg, params, p, 5), \
+                f"arch {kw} diverged"
