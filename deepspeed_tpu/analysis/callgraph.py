@@ -150,6 +150,12 @@ class ProjectIndex:
         #: ``NAME = "model"`` / ``AXES = ("data", "model")`` assignments);
         #: None marks a name assigned CONFLICTING literals (never guess)
         self.axis_constants: Dict[str, Optional[FrozenSet[str]]] = {}
+        #: dotted constant name -> (module, P(...) call) for module-level
+        #: ``SPEC = PartitionSpec(...)`` assignments; None marks a name
+        #: reassigned or non-literal (poisoned — never guess which
+        #: assignment is live). Feeds TPU008's constant resolution the
+        #: way axis_constants feeds TPU012's.
+        self.spec_constants: Dict[str, Optional[Tuple]] = {}
         self._rank_locals: Dict[ast.AST, Set[str]] = {}
         for m in self.modules:
             self._register_module(m)
@@ -157,6 +163,8 @@ class ProjectIndex:
             self._collect_imports(m)
         for m in self.modules:
             self._collect_axis_constants(m)
+        for m in self.modules:
+            self._collect_spec_constants(m)
         for m in self.modules:
             self._collect_contexts_and_axes(m)
         for m in self.modules:
@@ -458,6 +466,64 @@ class ProjectIndex:
                 continue
             prev = self.axis_constants.get(key, names)
             self.axis_constants[key] = names if prev == names else None
+
+    #: canonical dotted names that construct a PartitionSpec (kept in
+    #: sync with rules.ShardingSpecDriftRule._SPECS)
+    SPEC_CTORS = frozenset({"jax.sharding.PartitionSpec",
+                            "jax.interpreters.pxla.PartitionSpec"})
+
+    def _collect_spec_constants(self, module) -> None:
+        """Module-level ``SPEC = P(...)`` assignments, by dotted name.
+
+        ``QUEUE_SPEC = P("expert", ("data", "seq"))`` makes
+        ``with_sharding_constraint(x, QUEUE_SPEC)`` — in this module or
+        any importer — as checkable by TPU008 as the inline literal. A
+        name reassigned (or assigned a non-PartitionSpec value) is
+        poisoned rather than guessed at."""
+        dotted = self.mod_dotted[id(module)]
+        for node in module.nodes_by_fn.get(None, ()):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                target, value = node.target.id, node.value
+            else:
+                continue
+            key = f"{dotted}.{target}"
+            is_spec = (isinstance(value, ast.Call) and
+                       self.qualify(module, value.func) in self.SPEC_CTORS)
+            if not is_spec:
+                if key in self.spec_constants:
+                    self.spec_constants[key] = None
+                continue
+            if key in self.spec_constants:     # reassigned: poisoned
+                self.spec_constants[key] = None
+            else:
+                self.spec_constants[key] = (module, value)
+
+    def resolve_spec_constant(self, module, node: ast.AST
+                              ) -> Optional[Tuple]:
+        """(defining module, P(...) call) for a Name/Attribute that
+        denotes a collected module-level PartitionSpec constant; None
+        when the name is locally bound (the value is the caller's
+        contract), unresolvable, or poisoned."""
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            return None
+        if isinstance(node, ast.Name) and _locally_bound(module, node):
+            return None
+        q = self.qualify(module, node)
+        if q is None:
+            return None
+        if isinstance(node, ast.Name) and q == node.id:
+            q = f"{self.mod_dotted[id(module)]}.{node.id}"
+        seen: Set[str] = set()
+        while q not in self.spec_constants and q in self._reexports \
+                and q not in seen:
+            seen.add(q)
+            q = self._reexports[q]
+        return self.spec_constants.get(q)
 
     def resolve_axes(self, module, node: Optional[ast.AST]
                      ) -> Optional[FrozenSet[str]]:

@@ -240,6 +240,11 @@ def compute_edits(module: ModuleInfo,
         if f.node is None:
             continue
         if f.rule == "TPU008":
+            # cross-module constant findings anchor on the USE name, not
+            # a P(...) call — only literal/same-module-constant findings
+            # (whose node IS the call) are mechanically fixable
+            if not isinstance(f.node, ast.Call):
+                continue
             e = _fix_spec(module, f.node, offs)
             if e:
                 edits.append(e)

@@ -601,23 +601,60 @@ class ShardingSpecDriftRule(Rule):
         return None
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        project = getattr(module, "project", None)
+        seen_consts = set()
         for node in module.all_calls:
             if _qual(module, node.func) not in self._SITES:
                 continue
             for sub in ast.walk(node):
-                if not isinstance(sub, ast.Call) or \
-                        _qual(module, sub.func) not in self._SPECS:
+                if isinstance(sub, ast.Call) and \
+                        _qual(module, sub.func) in self._SPECS:
+                    why = self._drift(module, sub)
+                    if why:
+                        yield self.finding(
+                            module, sub,
+                            f"non-canonical PartitionSpec at a constraint "
+                            f"site: {why}; the spec names the same sharding "
+                            "as its canonical form but is a different jit "
+                            "cache key — a spurious retrace. Canonicalize "
+                            "(drop trailing Nones / unwrap 1-tuples) or pass "
+                            "through canonicalize_spec")
                     continue
-                why = self._drift(module, sub)
-                if why:
+                # module-level constant depth: a Name/Attribute argument
+                # that resolves (through imports/re-exports, the TPU012
+                # constant machinery) to a module-level ``SPEC = P(...)``
+                # is checked against the SAME drift classes
+                if project is None or not isinstance(
+                        sub, (ast.Name, ast.Attribute)):
+                    continue
+                hit = project.resolve_spec_constant(module, sub)
+                if hit is None:
+                    continue
+                def_module, spec_call = hit
+                why = self._drift(def_module, spec_call)
+                if not why:
+                    continue
+                if def_module is module:
+                    # anchor at the definition: one finding per constant
+                    # (however many sites read it), and --fix rewrites
+                    # the P(...) literal once
+                    if id(spec_call) in seen_consts:
+                        continue
+                    seen_consts.add(id(spec_call))
+                    yield self.finding(
+                        def_module, spec_call,
+                        f"non-canonical PartitionSpec constant "
+                        f"'{ast.unparse(sub)}' used at a constraint "
+                        f"site: {why}; canonicalize the definition")
+                else:
+                    # cross-module: anchor at the USE (suppressions and
+                    # subset lints stay per-file); not autofixable
                     yield self.finding(
                         module, sub,
-                        f"non-canonical PartitionSpec at a constraint "
-                        f"site: {why}; the spec names the same sharding "
-                        "as its canonical form but is a different jit "
-                        "cache key — a spurious retrace. Canonicalize "
-                        "(drop trailing Nones / unwrap 1-tuples) or pass "
-                        "through canonicalize_spec")
+                        f"constant '{ast.unparse(sub)}' "
+                        f"({def_module.rel_path}:{spec_call.lineno}) is a "
+                        f"non-canonical PartitionSpec: {why}; "
+                        "canonicalize the definition")
 
 
 @register

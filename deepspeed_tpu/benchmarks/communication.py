@@ -5,18 +5,49 @@ Capability parity with the reference's ``benchmarks/communication/*`` +
 with algbw/busbw accounting). TPU edition: collectives run inside shard_map
 over the full device mesh; busbw factors follow the standard ring-algorithm
 accounting the reference uses (all_reduce busbw = 2(n-1)/n * algbw, etc.).
+
+Round 10 additions (the comm-plan subsystem's measurement source):
+
+* ``--algos`` sweeps WIRE FORMATS per op — ``exact`` plus the quantized
+  implementations (``int8`` for all_reduce / reduce_scatter / all_to_all
+  via ``runtime/comm``, ``onebit`` for all_reduce) — so the selector has
+  real measurements to choose from;
+* every row is ALSO printed as a machine-readable ``comm_bench: {json}``
+  line (the format ``comm_plan.selector.parse_bench_lines`` ingests);
+* ``--record PATH`` writes the sweep as JSON, and each run compares its
+  rows against the newest recorded sweep next to it with the same >2x
+  loud-regression convention as the dryrun timing gate
+  (``DSTPU_COMM_BENCH_GATE=1`` makes a regression fatal).
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
+import json
+import os
 import time
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.jax_compat import shard_map
+
+#: wire formats each op can sweep (exact always; quantized where an
+#: implementation exists in runtime/comm)
+OP_ALGOS = {
+    "all_reduce": ("exact", "int8", "onebit"),
+    "all_gather": ("exact",),
+    "reduce_scatter": ("exact", "int8"),
+    "all_to_all": ("exact", "int8"),
+    "pt2pt": ("exact",),
+}
+
+#: a row slower than this factor vs the newest recorded sweep is loud
+SWEEP_REGRESSION_FACTOR = 2.0
 
 
 def _mesh_all():
@@ -39,29 +70,77 @@ def _collective_fn(op: str, mesh) -> Callable:
     n = mesh.devices.size
 
     if op == "all_reduce":
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             lambda x: jax.lax.psum(x, "all"),
             mesh=mesh, in_specs=P("all"), out_specs=P("all"), check_vma=False))
     if op == "all_gather":
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             lambda x: jax.lax.all_gather(x, "all", tiled=True),
             mesh=mesh, in_specs=P("all"), out_specs=P(), check_vma=False))
     if op == "reduce_scatter":
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             lambda x: jax.lax.psum_scatter(x, "all", tiled=True),
             mesh=mesh, in_specs=P(), out_specs=P("all"), check_vma=False))
     if op == "all_to_all":
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             lambda x: jax.lax.all_to_all(
                 x.reshape(n, -1), "all", split_axis=0, concat_axis=0,
                 tiled=True).reshape(-1),
             mesh=mesh, in_specs=P("all"), out_specs=P("all"), check_vma=False))
     if op == "pt2pt":
         perm = [(i, (i + 1) % n) for i in range(n)]
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             lambda x: jax.lax.ppermute(x, "all", perm),
             mesh=mesh, in_specs=P("all"), out_specs=P("all"), check_vma=False))
     raise ValueError(f"unknown op {op}")
+
+
+def _quantized_setup(op: str, algo: str, mesh, numel: int, dtype
+                     ) -> Tuple[Callable, jnp.ndarray]:
+    """(fn, input) for a quantized wire format. ``numel`` is the same
+    total element count the exact cell ran; each op maps it onto the
+    stacked per-rank layout its runtime/comm collective consumes so the
+    PER-RANK payload matches the exact variant's (allreduce family:
+    per-rank value numel/n like the exact shard; reduce_scatter: each
+    rank contributes a FULL numel buffer like the exact replicated
+    input; all_to_all: numel/n sent per rank like the exact local
+    (n, numel/n^2) chunking) — latency rows stay apples-to-apples."""
+    from ..runtime.comm.compressed import (chunk_elems, compressed_allreduce,
+                                           quantized_allreduce)
+    from ..runtime.comm.quantized import (quantized_all_to_all,
+                                          quantized_reduce_scatter)
+    n = mesh.devices.size
+    sh = NamedSharding(mesh, P("all"))
+    per_rank = numel // n
+    # one OUTER jit per cell so the timing loop hits the compile cache
+    # (the runtime/comm collectives build their shard_map per trace —
+    # correct under a caller's jit, a retrace per call when timed bare)
+    if op == "all_reduce" and algo == "int8":
+        x = jax.device_put(jnp.ones((n, per_rank), dtype), sh)
+        err = jax.device_put(jnp.zeros((n, per_rank), jnp.float32), sh)
+        return (jax.jit(lambda v: quantized_allreduce(  # graftlint: disable=TPU002 (one jit per sweep cell, reused across timed iters)
+            v, err, mesh=mesh, axis="all")[0]), x)
+    if op == "all_reduce" and algo == "onebit":
+        x = jax.device_put(jnp.ones((n, per_rank), dtype), sh)
+        werr = jax.device_put(jnp.zeros((n, per_rank), jnp.float32), sh)
+        serr = jax.device_put(
+            jnp.zeros((n, chunk_elems(per_rank, n)), jnp.float32), sh)
+        return (jax.jit(lambda v: compressed_allreduce(  # graftlint: disable=TPU002 (one jit per sweep cell, reused across timed iters)
+            v, werr, serr, mesh=mesh, axis="all")[0]), x)
+    if op == "reduce_scatter" and algo == "int8":
+        # each rank contributes a FULL buffer, like the exact replicated input
+        x = jax.device_put(jnp.ones((n, numel), dtype), sh)
+        return (jax.jit(lambda v: quantized_reduce_scatter(  # graftlint: disable=TPU002 (one jit per sweep cell, reused across timed iters)
+            v, mesh=mesh, axis="all")), x)
+    if op == "all_to_all" and algo == "int8":
+        rows = n * n
+        # logical [n*n, numel/n^2]: numel/n sent per rank, matching the
+        # exact cell's local (n, numel/n^2) chunking
+        x = jax.device_put(jnp.ones((rows, max(numel // rows, 1)), dtype),
+                           sh)
+        return (jax.jit(lambda v: quantized_all_to_all(  # graftlint: disable=TPU002 (one jit per sweep cell, reused across timed iters)
+            v, mesh=mesh, axis="all")), x)
+    raise ValueError(f"no {algo!r} implementation for op {op!r}")
 
 
 def busbw_factor(op: str, n: int) -> float:
@@ -78,27 +157,38 @@ def busbw_factor(op: str, n: int) -> float:
 
 
 def run_op_sweep(op: str, sizes_mb: List[float], dtype=jnp.bfloat16,
-                 iters: int = 10) -> List[Dict]:
+                 iters: int = 10, algo: str = "exact",
+                 emit: bool = False) -> List[Dict]:
     mesh = _mesh_all()
     n = mesh.devices.size
-    fn = _collective_fn(op, mesh)
     itemsize = jnp.dtype(dtype).itemsize
     rows = []
     # reduce_scatter consumes a per-rank FULL buffer (in_specs=P()), so place
     # the input replicated; sharding it P('all') would fold an implicit
     # all-gather into the timed region and corrupt the measurement
     in_spec = P() if op == "reduce_scatter" else P("all")
+    fn = _collective_fn(op, mesh) if algo == "exact" else None
     for mb in sizes_mb:
-        numel = max(int(mb * 2 ** 20 / itemsize) // n * n, n)
-        x = jax.device_put(jnp.ones((numel,), dtype),
-                           NamedSharding(mesh, in_spec))
-        dt = _timed(fn, x, iters)
+        base = max(int(mb * 2 ** 20 / itemsize) // n * n, n)
+        numel = -(-base // (n * n)) * n * n      # divisible for every layout
+        if algo == "exact":
+            x = jax.device_put(jnp.ones((numel,), dtype),
+                               NamedSharding(mesh, in_spec))
+            timed_fn = fn
+        else:
+            timed_fn, x = _quantized_setup(op, algo, mesh, numel, dtype)
+        dt = _timed(timed_fn, x, iters)
         size_bytes = numel * itemsize
+        row = {"op": op, "algo": algo, "axis": "all", "n": n,
+               "size_mb": round(size_bytes / 2 ** 20, 3),
+               "size_bytes": size_bytes,
+               "latency_us": round(dt * 1e6, 1)}
         algbw = size_bytes / dt / 1e9
-        rows.append({"op": op, "size_mb": round(size_bytes / 2 ** 20, 3),
-                     "latency_us": round(dt * 1e6, 1),
-                     "algbw_gbps": round(algbw, 3),
-                     "busbw_gbps": round(algbw * busbw_factor(op, n), 3)})
+        row["algbw_gbps"] = round(algbw, 3)
+        row["busbw_gbps"] = round(algbw * busbw_factor(op, n), 3)
+        rows.append(row)
+        if emit:
+            print("comm_bench: " + json.dumps(row))
     return rows
 
 
@@ -114,22 +204,120 @@ def print_table(rows: List[Dict]):
         print("  ".join(str(r[c]).ljust(w) for c, w in zip(cols, widths)))
 
 
+# ---------------------------------------------------------------------------
+# recorded sweeps + regression compare (dryrun timing-gate convention)
+# ---------------------------------------------------------------------------
+
+def record_sweep(rows: List[Dict], path: str) -> str:
+    doc = {"n": rows[0]["n"] if rows else len(jax.devices()), "rows": rows}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, path)
+    return path
+
+
+def latest_comm_sweep(baseline_dir: str, n_devices: Optional[int] = None
+                      ) -> Tuple[Optional[str], List[Dict]]:
+    """(name, rows) of the newest recorded sweep in ``baseline_dir``
+    (``COMMBENCH_r*.json`` reports or ``comm_sweep*.json`` recordings);
+    sweeps from a different device count are skipped — their latencies
+    aren't comparable."""
+    paths = sorted(glob.glob(os.path.join(baseline_dir,
+                                          "COMMBENCH_r*.json")) +
+                   glob.glob(os.path.join(baseline_dir,
+                                          "comm_sweep*.json")),
+                   key=os.path.getmtime, reverse=True)
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rows = doc.get("rows") if isinstance(doc, dict) else None
+        if not rows:
+            continue
+        if n_devices is not None and doc.get("n") is not None and \
+                int(doc["n"]) != int(n_devices):
+            continue
+        return os.path.basename(path), rows
+    return None, []
+
+
+def check_sweep_regression(current: List[Dict], baseline: List[Dict],
+                           factor: float = SWEEP_REGRESSION_FACTOR
+                           ) -> List[str]:
+    """Rows > ``factor`` x their recorded latency, keyed by
+    (op, algo, axis, size_mb). Missing rows are NOT flagged (a narrower
+    re-run is legitimate; the dryrun gate owns leg-coverage)."""
+    def key(r):
+        return (r.get("op"), r.get("algo", "exact"), r.get("axis", "all"),
+                r.get("size_mb"))
+
+    base = {key(r): float(r["latency_us"]) for r in baseline
+            if "latency_us" in r}
+    problems = []
+    for r in current:
+        b = base.get(key(r))
+        if b is None or b <= 0 or "latency_us" not in r:
+            continue
+        now = float(r["latency_us"])
+        if now > factor * b:
+            problems.append(
+                f"{r['op']}/{r.get('algo', 'exact')}@{r.get('size_mb')}MB: "
+                f"{now:.1f}us vs recorded {b:.1f}us "
+                f"({now / b:.1f}x > {factor:g}x budget)")
+    return problems
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ds_bench",
                                 description="collective benchmark sweeps")
     p.add_argument("--ops", default="all_reduce,all_gather,reduce_scatter,"
                                     "all_to_all,pt2pt")
+    p.add_argument("--algos", default="exact",
+                   help="comma list of wire formats per op "
+                        "(exact,int8,onebit); unsupported (op, algo) "
+                        "pairs are skipped")
     p.add_argument("--sizes-mb", default="1,16,64")
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--record", default="",
+                   help="write the sweep rows to this JSON path (the "
+                        "comm-plan selector's input)")
+    p.add_argument("--baseline-dir", default=".",
+                   help="directory searched for the newest recorded "
+                        "sweep to compare against (>2x = loud "
+                        "regression; DSTPU_COMM_BENCH_GATE=1 makes it "
+                        "fatal)")
     args = p.parse_args(argv)
     dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
              "float16": jnp.float16}[args.dtype]
     sizes = [float(s) for s in args.sizes_mb.split(",")]
+    algos = [a.strip() for a in args.algos.split(",") if a.strip()]
     all_rows = []
     for op in args.ops.split(","):
-        all_rows += run_op_sweep(op.strip(), sizes, dtype, args.iters)
+        op = op.strip()
+        for algo in algos:
+            if algo not in OP_ALGOS.get(op, ()):
+                continue
+            all_rows += run_op_sweep(op, sizes, dtype, args.iters,
+                                     algo=algo, emit=True)
     print_table(all_rows)
+    base_name, baseline = latest_comm_sweep(args.baseline_dir,
+                                            len(jax.devices()))
+    if baseline:
+        problems = check_sweep_regression(all_rows, baseline)
+        for prob in problems:
+            print(f"comm_bench REGRESSION vs {base_name}: {prob}")
+        if not problems:
+            print(f"comm_bench within {SWEEP_REGRESSION_FACTOR:g}x of "
+                  f"{base_name}")
+        elif os.environ.get("DSTPU_COMM_BENCH_GATE") == "1":
+            raise SystemExit("comm_bench regression:\n" +
+                             "\n".join(problems))
+    if args.record:
+        print(f"comm_bench recorded: {record_sweep(all_rows, args.record)}")
 
 
 if __name__ == "__main__":

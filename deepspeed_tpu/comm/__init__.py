@@ -22,3 +22,8 @@ from .comm import (
     configure,
 )
 from .logging import CommsLogger
+from .planned import (
+    moe_exchange_spec,
+    planned_grad_sync,
+    planned_queue_exchange,
+)

@@ -1,0 +1,55 @@
+"""Plan-routed collective entry points — the comm-facade face of
+``comm_plan``.
+
+Wiring sites call ONE function here instead of picking a wire format
+themselves: the engine's ZeRO-2 grad sync calls
+:func:`planned_grad_sync` with the algorithm its init-time resolution
+chose, and the MoE dispatch asks :func:`moe_exchange_spec` at trace time
+(reading the engine-installed plan context) whether — and how — the
+queue exchange should leave the implicit-SPMD path. Execution lives in
+``runtime/comm/quantized.py``; policy lives in ``comm_plan/``; this
+module is the seam between them, mirroring how ``comm.comm`` fronts the
+raw ``jax.lax`` collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..comm_plan.runtime import active_context, resolve_algo
+from ..runtime.comm.quantized import grad_sync, make_queue_exchange
+
+
+def planned_grad_sync(x, *, mesh, axis="data", algo: str = "int8",
+                      bits: int = 8, block: int = 256, mean: bool = True):
+    """The ZeRO-2 grad-sync entry point: stacked per-rank grads in,
+    reduced (replicated) grads out, wire format per ``algo``."""
+    return grad_sync(x, mesh=mesh, axis=axis, algo=algo, bits=bits,
+                     block=block, mean=mean)
+
+
+def moe_exchange_spec(mesh, nbytes: int
+                      ) -> Optional[Tuple[str, int, int]]:
+    """Consulted by ``moe.layer.MoE`` at trace time: returns
+    ``(algo, bits, block)`` when the active plan routes the expert
+    all-to-all through the EXPLICIT exchange, or None to stay on the
+    implicit constraint-driven path (no context installed, a
+    single-member expert axis, or an exact verdict)."""
+    ctx = active_context()
+    if ctx is None:
+        return None
+    ep = mesh.shape.get("expert", 1)
+    if ep <= 1:
+        return None
+    algo = resolve_algo(ctx, "moe_all_to_all", "expert", nbytes,
+                        axis_size=ep)
+    if algo == "exact":
+        return None
+    return algo, ctx.bits, ctx.block
+
+
+def planned_queue_exchange(mesh, *, algo: str, bits: int = 8,
+                           block: int = 256):
+    """(dispatch, combine) pair for the grouped MoE layout — see
+    ``runtime.comm.quantized.make_queue_exchange``."""
+    return make_queue_exchange(mesh, algo=algo, bits=bits, block=block)

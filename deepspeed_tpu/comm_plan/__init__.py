@@ -1,0 +1,35 @@
+"""Communication planning — per-collective algorithm selection.
+
+The subsystem that decides, per {collective kind, mesh axis, message-size
+bucket}, which wire format a collective runs with:
+
+* :mod:`plan` — :class:`CommPlan`, the JSON-serializable decision table
+  (and the substrate ROADMAP item 2's hand-overlapped schedules will
+  slot into);
+* :mod:`selector` — builds a plan from ``benchmarks/communication.py``
+  sweep records (argmin latency per cell, deterministic tie-break) with
+  safe size-threshold heuristics where no sweep exists;
+* :mod:`runtime` — the active-plan context the engine installs around
+  its traced programs plus the resolution ladder
+  (override > plan entry > heuristic) and the accuracy guard;
+* :mod:`cli` — ``dstpu comm-plan sweep|show``, recording sweeps through
+  the ``autotuning/`` experiment machinery.
+
+Execution lives next to the collectives it routes:
+``runtime/comm/quantized.py`` (the int8 reduce-scatter / all-to-all) and
+the ``comm.planned`` facade the engine and ``moe/`` dispatch call.
+See docs/COMM.md.
+"""
+
+from .plan import ALGOS, CommPlan, PlanEntry, SITE_ALGOS, bucket_of
+from .selector import heuristic_algo, parse_bench_lines, select_plan
+from .runtime import (
+    PlanContext,
+    active_context,
+    resolve_algo,
+    use_context,
+)
+
+__all__ = ["ALGOS", "CommPlan", "PlanEntry", "SITE_ALGOS", "bucket_of",
+           "heuristic_algo", "parse_bench_lines", "select_plan",
+           "PlanContext", "active_context", "resolve_algo", "use_context"]

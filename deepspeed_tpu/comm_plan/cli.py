@@ -1,0 +1,126 @@
+"""``dstpu comm-plan`` — record collective sweeps and select a plan.
+
+``sweep`` runs the (op x algo x size) grid through the ``autotuning/``
+experiment machinery — every cell is an :class:`autotuning.Experiment`
+whose runner times one collective via ``benchmarks/communication.py``,
+scored by throughput exactly like a batch-geometry trial — then feeds
+the measured rows to ``comm_plan.selector.select_plan`` and writes the
+plan JSON the engine's ``comm_plan.plan_path`` consumes. ``show``
+renders a recorded plan (and what the heuristic would do for a given
+query) without touching devices.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def _sweep_records(ops: List[str], algos: List[str], sizes_mb: List[float],
+                   dtype_name: str, iters: int) -> List[Dict]:
+    """The grid, executed as autotuning experiments (GridSearchTuner over
+    the op/algo/size space; failed cells are recorded with their error
+    and skipped by the selector, the autotuner's error-result
+    convention)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..autotuning.autotuner import Autotuner
+    from ..benchmarks.communication import OP_ALGOS, run_op_sweep
+
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+             "float16": jnp.float16}[dtype_name]
+    rows: List[Dict] = []
+
+    def runner(cfg: Dict) -> Dict[str, float]:
+        op, algo, mb = cfg["op"], cfg["algo"], float(cfg["size_mb"])
+        if algo not in OP_ALGOS.get(op, ()):
+            raise ValueError(f"no {algo} implementation for {op}")
+        row = run_op_sweep(op, [mb], dtype, iters, algo=algo,
+                           emit=True)[0]
+        rows.append(row)
+        return {"throughput": row["busbw_gbps"],
+                "latency_us": row["latency_us"]}
+
+    tuner = Autotuner(
+        base_config={},
+        runner=runner,
+        tuning_space={"op": ops, "algo": algos, "size_mb": sizes_mb},
+        tuner_type="gridsearch")
+    tuner.tune()
+    n_fail = sum(1 for e in tuner.experiments if e.error)
+    if n_fail:
+        print(f"comm-plan sweep: {n_fail} cells failed (recorded with "
+              "errors, excluded from selection)")
+    return rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dstpu comm-plan",
+        description="record collective sweeps / select + inspect comm "
+                    "plans (docs/COMM.md)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sw = sub.add_parser("sweep", help="run the op x algo x size grid on "
+                                      "this host's devices and write the "
+                                      "selected plan")
+    sw.add_argument("--ops", default="all_reduce,reduce_scatter,all_to_all")
+    sw.add_argument("--algos", default="exact,int8")
+    sw.add_argument("--sizes-mb", default="1,4,16,64")
+    sw.add_argument("--dtype", default="float32")
+    sw.add_argument("--iters", type=int, default=10)
+    sw.add_argument("--out", default="comm_plan.json",
+                    help="plan JSON path (engine: comm_plan.plan_path)")
+    sw.add_argument("--record", default="",
+                    help="also save the raw sweep rows (the regression "
+                         "baseline benchmarks/communication.py compares "
+                         "against)")
+
+    sh = sub.add_parser("show", help="render a recorded plan")
+    sh.add_argument("plan", help="plan JSON path")
+    sh.add_argument("--query", default="",
+                    help="kind:axis:bytes — print the algorithm this "
+                         "plan (entry or heuristic) resolves for one "
+                         "message, e.g. reduce_scatter:data:8388608")
+
+    args = p.parse_args(argv)
+    from .plan import CommPlan
+    if args.cmd == "show":
+        plan = CommPlan.load(args.plan)
+        print(plan.describe())
+        if args.query:
+            from .selector import heuristic_algo
+            kind, axis, nbytes = args.query.split(":")
+            chosen = plan.choose(kind, axis, int(nbytes))
+            if chosen is None:
+                chosen = heuristic_algo(kind, int(nbytes), axis_size=2)
+                print(f"{args.query} -> {chosen} (heuristic: no plan "
+                      "entry covers this bucket)")
+            else:
+                print(f"{args.query} -> {chosen} (plan entry)")
+        return 0
+
+    import jax
+    ops = [o.strip() for o in args.ops.split(",") if o.strip()]
+    algos = [a.strip() for a in args.algos.split(",") if a.strip()]
+    sizes = [float(s) for s in args.sizes_mb.split(",")]
+    rows = _sweep_records(ops, algos, sizes, args.dtype, args.iters)
+    if args.record:
+        from ..benchmarks.communication import record_sweep
+        print(f"comm-plan sweep recorded: "
+              f"{record_sweep(rows, args.record)}")
+    from .selector import select_plan
+    plan = select_plan(rows, meta={"n_devices": len(jax.devices()),
+                                   "dtype": args.dtype,
+                                   "source": "dstpu comm-plan sweep"})
+    path = plan.save(args.out)
+    print(plan.describe())
+    print(f"comm-plan written: {path} ({len(plan.entries)} entries)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,146 @@
+"""Active-plan context + the algorithm resolution ladder.
+
+The engine installs a :class:`PlanContext` around its traced programs
+(``use_context`` wraps ``apply_fn``), so model-internal seams — the MoE
+dispatch — read the plan at TRACE time without any config threading
+through module pytrees. The context is a thread-local stack: two engines
+in one process (the test suite's exact-vs-quantized twins) each see only
+their own plan, and an engine with comm_plan disabled sees none.
+
+Resolution ladder for a site query (:func:`resolve_algo`):
+
+1. explicit per-kind override from the ``comm_plan`` config section
+   (site alias first, then the wire kind) — unsupported algos RAISE, a
+   forced choice must not silently degrade;
+2. the loaded plan's (kind, axis, bucket) entry — entries naming an algo
+   the site cannot execute fall through (the plan also steers benchmark
+   kinds the engine has no seam for);
+3. the size-threshold heuristic.
+
+The :class:`AccuracyGuard` is the engine-side safety valve: when the
+observed global grad norm drops below ``guard_min_grad_norm``, the next
+steps run the EXACT program — near convergence (or during a warmup with
+tiny grads) the blockwise-int8 quantization error is no longer small
+relative to the signal. The guard only ever forces exact; it never
+promotes a collective to a quantized algorithm.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .plan import CommPlan, SITE_ALGOS, SITE_KIND
+from .selector import heuristic_algo
+
+_tls = threading.local()
+
+
+@dataclass
+class PlanContext:
+    """Everything a wiring site needs to pick an algorithm."""
+    plan: Optional[CommPlan] = None
+    overrides: dict = field(default_factory=dict)
+    bits: int = 8
+    block: int = 256
+    size_threshold: int = 4 * 2 ** 20
+    resolved: dict = field(default_factory=dict)   # site -> algo (audit)
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+@contextlib.contextmanager
+def use_context(ctx: Optional[PlanContext]):
+    """Make ``ctx`` the active plan context for the dynamic extent (used
+    at trace time; a None ctx is a no-op so wrappers stay unconditional)."""
+    if ctx is None:
+        yield
+        return
+    st = _stack()
+    st.append(ctx)
+    try:
+        yield
+    finally:
+        st.pop()
+
+
+def active_context() -> Optional[PlanContext]:
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+def resolve_algo(ctx: PlanContext, site: str, axis: str, nbytes: int,
+                 axis_size: int) -> str:
+    """The ladder; returns an algo the SITE can execute."""
+    if site not in SITE_ALGOS:
+        raise ValueError(f"unknown comm-plan site {site!r} "
+                         f"(known: {sorted(SITE_ALGOS)})")
+    kind = SITE_KIND[site]
+    supported = SITE_ALGOS[site]
+    for key in (site, kind):
+        forced = (ctx.overrides or {}).get(key)
+        if forced is not None:
+            if forced not in supported:
+                raise ValueError(
+                    f"comm_plan.overrides[{key!r}] = {forced!r} is not "
+                    f"executable at site {site!r} (supported: "
+                    f"{supported})")
+            ctx.resolved[site] = forced
+            return forced
+    if ctx.plan is not None:
+        chosen = ctx.plan.choose(kind, axis, nbytes)
+        if chosen is not None and chosen in supported:
+            ctx.resolved[site] = chosen
+            return chosen
+    algo = heuristic_algo(kind, nbytes, axis_size,
+                          size_threshold=ctx.size_threshold)
+    if algo not in supported:
+        algo = "exact"
+    ctx.resolved[site] = algo
+    return algo
+
+
+class AccuracyGuard:
+    """Host-side exact-mode latch on small grad norms (see module doc)."""
+
+    def __init__(self, min_grad_norm: float):
+        self.min_grad_norm = float(min_grad_norm)
+        self._last: Optional[float] = None
+
+    def observe(self, grad_norm: float) -> None:
+        if grad_norm == grad_norm:      # ignore NaN (overflow steps)
+            self._last = float(grad_norm)
+
+    @property
+    def use_exact(self) -> bool:
+        return self._last is not None and self._last < self.min_grad_norm
+
+
+# ---------------------------------------------------------------------------
+# local-region flag: inside the engine's stacked-grads shard_map the model
+# runs SHARD-LOCALLY — mesh sharding constraints don't apply there (and
+# naming a manual axis in one is an error on some jax versions)
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def local_region():
+    """Mark the dynamic extent as a shard-local model trace: `
+    ``models.transformer._spec_constraint`` (and everything routed
+    through it) becomes a no-op inside."""
+    prev = getattr(_tls, "local_region", 0)
+    _tls.local_region = prev + 1
+    try:
+        yield
+    finally:
+        _tls.local_region = prev
+
+
+def in_local_region() -> bool:
+    return bool(getattr(_tls, "local_region", 0))

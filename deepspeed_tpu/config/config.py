@@ -416,6 +416,33 @@ class ServingConfig(DeepSpeedConfigModel):
     seed: int = 0                      # sampling PRNG seed
 
 
+class CommPlanConfig(DeepSpeedConfigModel):
+    """TPU-native (round 10): the communication-planning subsystem
+    (``deepspeed_tpu/comm_plan/``, docs/COMM.md). With ``enabled`` the
+    engine resolves a wire format per collective site — the ZeRO-2
+    gradient reduce-scatter and the MoE expert all-to-all — through the
+    ladder override > recorded plan > size heuristic, and routes
+    non-exact verdicts through the explicit blockwise-int8 collectives
+    in ``runtime/comm/quantized.py``. ``plan_path`` points at a plan
+    recorded by ``dstpu comm-plan sweep``; ``overrides`` forces an
+    algorithm per site alias (``grad_reduce_scatter``,
+    ``moe_all_to_all``) or wire kind (``reduce_scatter`` ...), and an
+    unexecutable forced algorithm raises at initialize.
+    ``guard_min_grad_norm`` is the accuracy guard: once the observed
+    global grad norm drops below it, subsequent steps run the exact
+    program (quantization error is no longer small relative to the
+    signal); it costs the per-step metrics pull. ``quant_block`` is the
+    elements-per-scale granularity of the int8 wire format (error is
+    bounded by block absmax / 127 per element)."""
+    enabled: bool = False
+    plan_path: Optional[str] = None
+    overrides: Dict[str, str] = Field(default_factory=dict)
+    quant_bits: int = 8
+    quant_block: int = 256
+    size_threshold_mb: float = 4.0     # heuristic regime boundary
+    guard_min_grad_norm: float = 0.0   # 0 = guard off
+
+
 class ProgressiveLayerDropConfig(DeepSpeedConfigModel):
     enabled: bool = False
     theta: float = 0.5
@@ -543,6 +570,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     nebula: NebulaConfig = Field(default_factory=NebulaConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     serving: ServingConfig = Field(default_factory=ServingConfig)
+    comm_plan: CommPlanConfig = Field(default_factory=CommPlanConfig)
     tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)
     sequence_parallel: SequenceParallelConfig = Field(default_factory=SequenceParallelConfig)
     moe: MoEConfig = Field(default_factory=MoEConfig)

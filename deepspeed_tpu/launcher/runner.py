@@ -293,6 +293,11 @@ def main(argv=None):
         argv = sys.argv[1:]
     if argv and argv[0] == "health":
         sys.exit(health_main(argv[1:]))
+    if argv and argv[0] == "comm-plan":
+        # record collective sweeps / select + inspect comm plans
+        # (docs/COMM.md; consumed by the comm_plan config section)
+        from ..comm_plan.cli import main as comm_plan_main
+        sys.exit(comm_plan_main(argv[1:]))
     args = parse_args(argv)
     if args.autotuning:
         if not args.deepspeed_config:

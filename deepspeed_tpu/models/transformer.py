@@ -493,6 +493,14 @@ def _spec_constraint(x, spec: P):
     Manual-'pipe' context) a full-mesh NamedSharding is REJECTED — there the
     bare spec is exactly right: it resolves against the context mesh and
     ignores the manual axes (our specs never name 'pipe')."""
+    # the comm-plan stacked-grads step traces the model SHARD-LOCALLY
+    # (manual over the DP axes): every mesh constraint is meaningless
+    # there — and naming a manual axis in one is an error on jax lines
+    # without the abstract-mesh probe below — so the local-region flag
+    # turns them all off for that trace
+    from ..comm_plan.runtime import in_local_region
+    if in_local_region():
+        return x
     # jax-version compat: get_abstract_mesh moved under jax.sharding only in
     # newer releases; older trees keep it in jax._src.mesh (and lack
     # sharding-in-types entirely — see the typeof probe below)

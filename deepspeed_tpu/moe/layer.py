@@ -33,6 +33,15 @@ from jax.sharding import PartitionSpec as P
 
 from .sharded_moe import compute_capacity, top1_gating, top2_gating
 
+#: canonical intermediate PartitionSpecs of the dispatch pipeline —
+#: module-level constants so every reshape lands on the SAME spelling
+#: (graftlint TPU008 resolves P(...) literals through these names) and
+#: the grouped-layout transitions stay expressible as collectives
+#: instead of SPMD replicate-and-reshard fallbacks (ROADMAP item 2a)
+TOKEN_AXES = ("data", "expert", "seq")
+QUEUE_SPEC = P("expert", ("data", "seq"))
+GROUP_SPEC = P(TOKEN_AXES)
+
 
 def _constrain(x, *spec):
     """Sharding constraint that works under plain jax.jit (resolved against
@@ -198,10 +207,29 @@ class MoE(nn.Module):
         # the all-to-all of the reference's _AllToAll (sharded_moe.py:89)
         dispatched = jnp.einsum("gtec,gth->gech", dispatch.astype(self.dtype),
                                 tokens_g.astype(self.dtype))
-        dispatched = _constrain(dispatched, ("data", "expert", "seq"),
-                                None, None, None)
-        queues = dispatched.transpose(1, 0, 2, 3).reshape(E, G * Cg, H)
-        queues = _constrain(queues, "expert", ("data", "seq"), None)
+        from ..models.transformer import _spec_constraint
+        dispatched = _spec_constraint(dispatched, GROUP_SPEC)
+
+        # comm-plan seam: with an active plan routing the expert a2a to a
+        # quantized wire format, the exchange pair runs EXPLICITLY (int8
+        # payload + blockwise scales through comm.planned); otherwise the
+        # canonical constraints below let the SPMD partitioner emit the
+        # exact all-to-all from the sharding transition
+        xchg_pair = None
+        if mm is not None and G > 1 and G == g:
+            from ..comm.planned import (moe_exchange_spec,
+                                        planned_queue_exchange)
+            xchg = moe_exchange_spec(
+                mm, dispatched.size * dispatched.dtype.itemsize)
+            if xchg is not None:
+                algo, bits, blk = xchg
+                xchg_pair = planned_queue_exchange(
+                    mm.mesh, algo=algo, bits=bits, block=blk)
+        if xchg_pair is not None:
+            queues = xchg_pair[0](dispatched)            # [E, G*Cg, H]
+        else:
+            queues = dispatched.transpose(1, 0, 2, 3).reshape(E, G * Cg, H)
+            queues = _spec_constraint(queues, QUEUE_SPEC)
 
         expert_factory = self.expert or (lambda: ExpertMLP(
             self.hidden_size, self.hidden_size * self.mlp_ratio,
@@ -214,12 +242,16 @@ class MoE(nn.Module):
             metadata_params={nn.PARTITION_NAME: "expert"},
         )
         expert_out = vexpert(expert_factory(), queues)       # [E, G*Cg, H]
-        expert_out = _constrain(expert_out, "expert", ("data", "seq"), None)
+        expert_out = _spec_constraint(expert_out, QUEUE_SPEC)
 
-        # return exchange + per-group combine
-        out_g = _constrain(
-            expert_out.reshape(E, G, Cg, H).transpose(1, 0, 2, 3),
-            ("data", "expert", "seq"), None, None, None)
+        # return exchange + per-group combine: the explicit pair inverts
+        # the dispatch exchange exactly (row order is self-consistent)
+        if xchg_pair is not None:
+            out_g = xchg_pair[1](expert_out)             # [G, E, Cg, H]
+        else:
+            out_g = _spec_constraint(
+                expert_out.reshape(E, G, Cg, H).transpose(1, 0, 2, 3),
+                GROUP_SPEC)
         y = jnp.einsum("gtec,gech->gth", combine.astype(self.dtype),
                        out_g.astype(self.dtype))
         y = _constrain(y, ("data", "expert", "seq"), None, None)

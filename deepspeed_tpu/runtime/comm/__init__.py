@@ -1,5 +1,14 @@
-"""Specialized runtime communication: compressed (1-bit/int8) collectives."""
+"""Specialized runtime communication: compressed (1-bit/int8) collectives
+and the blockwise-int8 reduce-scatter / all-to-all family."""
 
 from .compressed import compressed_allreduce, quantized_allreduce
+from .quantized import (
+    grad_sync,
+    make_queue_exchange,
+    quantized_all_to_all,
+    quantized_reduce_scatter,
+)
 
-__all__ = ["compressed_allreduce", "quantized_allreduce"]
+__all__ = ["compressed_allreduce", "quantized_allreduce", "grad_sync",
+           "make_queue_exchange", "quantized_all_to_all",
+           "quantized_reduce_scatter"]

@@ -441,6 +441,35 @@ class DeepSpeedEngine:
                 loss_scaler=self.loss_scaler,
                 zero_stage=stage)
 
+        # comm-plan: per-collective algorithm selection (round 10;
+        # docs/COMM.md) ------------------------------------------------------
+        # Policy resolves HERE (programs are static); execution routes
+        # through comm.planned -> runtime/comm/quantized.py. The MoE
+        # dispatch reads the same context at trace time via the apply_fn
+        # wrap, so one plan steers both wire-hot seams.
+        self.comm_plan_ctx = None
+        self._cp_guard = None
+        self._train_step_q = None
+        cp = self.config.comm_plan
+        if cp.enabled:
+            from ..comm_plan import CommPlan
+            from ..comm_plan.runtime import AccuracyGuard, PlanContext
+            plan = CommPlan.load(cp.plan_path) if cp.plan_path else None
+            self.comm_plan_ctx = PlanContext(
+                plan=plan, overrides=dict(cp.overrides or {}),
+                bits=cp.quant_bits, block=cp.quant_block,
+                size_threshold=int(cp.size_threshold_mb * 2 ** 20))
+            self.apply_fn = self._wrap_apply_comm_plan(self.apply_fn)
+            self._resolve_grad_sync_algo(params_f32)
+            if cp.guard_min_grad_norm > 0:
+                self._cp_guard = AccuracyGuard(cp.guard_min_grad_norm)
+            log_dist(
+                "comm plan: "
+                f"plan={'recorded:' + cp.plan_path if cp.plan_path else 'heuristic'} "
+                f"grad_sync={self.comm_plan_ctx.resolved.get('grad_reduce_scatter')} "
+                f"overrides={dict(cp.overrides or {})} "
+                f"guard={cp.guard_min_grad_norm}", ranks=[0])
+
         # device placement of state -----------------------------------------
         # fp32 training: params ARE the master copy — TrainState.master is kept
         # empty so the same buffers aren't donated twice through the pytree.
@@ -901,6 +930,158 @@ class DeepSpeedEngine:
 
         return jax.jit(train_step, donate_argnums=(0,))
 
+    # ------------------------------------------------- comm-plan grad sync
+
+    def _wrap_apply_comm_plan(self, apply_fn):
+        """Install the engine's plan context around every model trace so
+        trace-time seams (the MoE dispatch) read THIS engine's plan —
+        thread-local and scoped, so a second engine in the same process
+        never inherits it."""
+        from ..comm_plan.runtime import use_context
+        ctx = self.comm_plan_ctx
+
+        def wrapped(params, batch, rng, train):
+            with use_context(ctx):
+                return apply_fn(params, batch, rng, train)
+
+        return wrapped
+
+    def _grad_sync_envelope(self) -> Tuple[bool, str]:
+        """Can the explicit stacked-grads sync replace the implicit XLA
+        grad reduction here? Mirrors the 1-bit runner's envelope: the
+        stacked per-rank layout needs pure data parallelism and a fused
+        step the engine owns."""
+        if self.onebit is not None:
+            return False, "the 1-bit runner owns the train step"
+        if self.offload is not None:
+            return False, "offload mode splits the step across host/device"
+        if self.compression_spec is not None:
+            return False, ("compression_training is not threaded through "
+                           "the stacked-grads step")
+        ok, why = self.zero_policy.grad_sync_viable()
+        if not ok:
+            return False, why
+        for ax in ("model", "seq", "pipe"):
+            if self.mesh_mgr.shape[ax] != 1:
+                return False, (f"mesh axis '{ax}' has size "
+                               f"{self.mesh_mgr.shape[ax]} (pure data "
+                               "parallelism required)")
+        if self.mesh_mgr.shape["data"] <= 1:
+            return False, "a single DP rank has nothing to sync"
+        return True, ""
+
+    def _resolve_grad_sync_algo(self, params_f32) -> None:
+        """Init-time resolution of the ZeRO-2 grad-sync wire format
+        (programs are static, so the verdict is per-engine, modulo the
+        accuracy guard's host-side exact fallback)."""
+        from ..comm_plan.runtime import resolve_algo
+        ctx = self.comm_plan_ctx
+        itemsize = jnp.dtype(self.grad_accum_dtype).itemsize
+        grad_bytes = sum(
+            int(np.prod(np.shape(p)) if np.shape(p) else 1)
+            for p in jax.tree.leaves(params_f32)) * itemsize
+        n = self.mesh_mgr.shape["data"] * self.mesh_mgr.shape["expert"]
+        algo = resolve_algo(ctx, "grad_reduce_scatter", "data", grad_bytes,
+                            axis_size=n)
+        if algo != "exact":
+            ok, why = self._grad_sync_envelope()
+            if not ok:
+                forced = any((ctx.overrides or {}).get(k)
+                             for k in ("grad_reduce_scatter",
+                                       "reduce_scatter"))
+                if forced:
+                    raise ValueError(
+                        f"comm_plan forces a quantized grad sync but "
+                        f"{why}")
+                logger.warning(
+                    "comm_plan: grad sync selected %r but %s — running "
+                    "exact", algo, why)
+                algo = "exact"
+                ctx.resolved["grad_reduce_scatter"] = "exact"
+        self._grad_sync_algo = algo
+
+    def _make_train_step_quantized(self):
+        """The comm-plan train step: per-rank grads come out of a
+        shard_map UNREDUCED (the 1-bit runner's stacked layout), the sync
+        is the explicit blockwise-int8 reduce-scatter + all-gather
+        (``comm.planned_grad_sync``), and everything from the synced
+        grads on — clip, optimizer, skip arms, sentinel — is the shared
+        ``_finalize_step`` tail, so the two programs differ ONLY in how
+        grad bytes cross the wire."""
+        gas = self.config.gradient_accumulation_steps
+        axes = self.zero_policy.grad_sync_axes()
+        cp = self.config.comm_plan
+        algo = self._grad_sync_algo
+        mesh = self.mesh
+        from ..comm.planned import planned_grad_sync
+        from ..comm_plan.runtime import local_region
+        from ..utils.jax_compat import shard_map
+
+        def local(params, micros_all, rng, scale):
+            r = jax.random.fold_in(rng, lax.axis_index(axes))
+            rngs = jax.random.split(r, gas)
+
+            def body(acc, xs):
+                micro, rr = xs
+
+                def scaled_loss(p):
+                    # shard-local model trace: mesh constraints inside
+                    # the model don't apply here (local_region makes
+                    # _spec_constraint a no-op)
+                    with local_region():
+                        out = self.apply_fn(p, micro, rr, True)
+                        loss = self.loss_fn(out, micro)
+                    return (loss * scale).astype(jnp.float32), loss
+
+                grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
+                acc = jax.tree.map(
+                    lambda a, g: a + g.astype(self.grad_accum_dtype),
+                    acc, grads)
+                return acc, loss
+
+            zero = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, self.grad_accum_dtype), params)
+            gsum, losses = lax.scan(body, zero, (micros_all, rngs))
+            return (jax.tree.map(lambda g: g[None], gsum), losses[None])
+
+        mapped = shard_map(local, mesh=mesh,
+                           in_specs=(P(), P(None, axes), P(), P()),
+                           out_specs=(P(axes), P(axes)),
+                           axis_names=set(axes), check_vma=False)
+
+        def train_step(state, micros, rng, lr_arg, spike_limit=None):
+            grads_st, losses_st = mapped(state.params, micros, rng,
+                                         state.scale.scale)
+            synced = jax.tree.map(
+                lambda g: planned_grad_sync(
+                    g, mesh=mesh, axis=axes, algo=algo,
+                    bits=cp.quant_bits, block=cp.quant_block, mean=True),
+                grads_st)
+            grads_sum = jax.tree.map(
+                lambda g, s: lax.with_sharding_constraint(
+                    g.astype(self.grad_accum_dtype), s),
+                synced, self.grad_shardings)
+            new_state, metrics = self._finalize_step(
+                state, grads_sum, float(gas), lr_arg,
+                spike_limit=spike_limit)
+            metrics["loss"] = jnp.mean(losses_st)
+            return new_state, metrics
+
+        return jax.jit(train_step, donate_argnums=(0,))
+
+    def _active_train_step(self):
+        """Pick the per-step program: the quantized-sync step when the
+        plan routed it, unless the accuracy guard latched exact (both
+        stay compiled — switching is free after the first use of each)."""
+        if (self.comm_plan_ctx is not None
+                and getattr(self, "_grad_sync_algo", "exact") != "exact"
+                and not (self._cp_guard is not None
+                         and self._cp_guard.use_exact)):
+            if self._train_step_q is None:
+                self._train_step_q = self._make_train_step_quantized()
+            return self._train_step_q, self._grad_sync_algo
+        return self._train_step, "exact"
+
     def _make_grads_step(self):
         """Offload mode: the compiled step ends at the summed grads — the
         optimizer runs on the host (reference: cpu_offload grads land in CPU
@@ -1164,14 +1345,19 @@ class DeepSpeedEngine:
             metrics = self._apply_offload_update(grads_sum, float(gas), loss,
                                                  raw_norm, overflow)
         else:
+            step_fn, sync_algo = self._active_train_step()
             limit = self._spike_limit_arg()
             if limit is None:
-                self.state, metrics = self._train_step(
+                self.state, metrics = step_fn(
                     self.state, micros, self.next_rng(), self._current_lr())
             else:
-                self.state, metrics = self._train_step(
+                self.state, metrics = step_fn(
                     self.state, micros, self.next_rng(), self._current_lr(),
                     limit)
+            if self.comm_plan_ctx is not None:
+                # host-side audit tag: which wire format this step's grad
+                # sync actually ran (tests + the guard's visibility)
+                metrics["grad_sync_algo"] = sync_algo
         self.tput_timer.stop(sync=metrics["loss"])
         if self.config.wall_clock_breakdown:
             # the jitted step is one program: the breakdown the reference
@@ -1375,16 +1561,23 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()
         self._last_metrics = metrics
         print_step = self.global_steps % self.config.steps_per_print == 0
-        if print_step or self.sentinel.wants_every_step:
+        if print_step or self.sentinel.wants_every_step \
+                or self._cp_guard is not None:
             # one batched D2H pull for every scalar the logging tier AND
             # the integrity sentinel read (graftlint TPU001: per-scalar
             # float() here was 3-4 separate blocking transfers per print
             # step). The skip streak and the sentinel statistics ride the
             # SAME pull — enabling the detector costs per-step cadence on
-            # this one transfer, never an extra sync.
+            # this one transfer, never an extra sync. The comm-plan
+            # accuracy guard reads grad_norm off the same pull (its
+            # documented cost: per-step cadence when enabled).
+            keys = set(self.sentinel.metric_keys)
+            if self._cp_guard is not None:
+                keys.add("grad_norm")
             host = jax.device_get({k: metrics[k]
-                                   for k in self.sentinel.metric_keys
-                                   if k in metrics})
+                                   for k in keys if k in metrics})
+            if self._cp_guard is not None and "grad_norm" in host:
+                self._cp_guard.observe(float(host["grad_norm"]))
             # one code path for every "wrong numbers" verdict: the folded
             # nonfinite_guard streak abort (NonFiniteError), anomaly
             # strikes, and the post-rollback abort all live in observe()

@@ -215,6 +215,32 @@ class ZeroShardingPolicy:
             return composed
         return insert_zero_axes(tuple(shape), tp_spec, axes, size)
 
+    # -- comm-plan grad sync (docs/COMM.md) ----------------------------------
+
+    def grad_sync_axes(self) -> Tuple[str, ...]:
+        """Mesh axes the PLANNED (explicit) gradient sync reduces over —
+        the batch axes, whose implicit XLA emission this policy's
+        ``grad_spec`` constraints otherwise drive. The engine's
+        stacked-grads step shard_maps over these and routes each leaf
+        through ``comm.planned_grad_sync`` when the comm plan picks a
+        quantized wire format for the stage-2 reduce-scatter."""
+        return ("data", "expert")
+
+    def grad_sync_viable(self) -> Tuple[bool, str]:
+        """Sharding-side envelope for the explicit sync: the stacked
+        per-rank layout needs whole compute params (stage <= 2) and a
+        single-member expert axis (expert params' grads must not be
+        averaged over 'expert'). The engine adds its runtime-side checks
+        (offload/1-bit/compression) on top."""
+        if self.stage > 2:
+            return False, ("ZeRO-3 shards compute params; the stacked "
+                           "local-grad layout needs them whole per rank")
+        if self.mm.shape["expert"] != 1:
+            return False, ("mesh axis 'expert' has size "
+                           f"{self.mm.shape['expert']}: expert-param "
+                           "grads must not be mean-reduced over it")
+        return True, ""
+
     # -- pytree-level helpers -------------------------------------------------
 
     def tree_shardings(self, tree, spec_fn, tp_specs=None, expert_fn=None):

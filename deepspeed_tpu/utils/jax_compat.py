@@ -57,3 +57,39 @@ def install_shard_map_compat() -> bool:
 # jaxlib aborts the PROCESS inside XLA compilation — a clean
 # AttributeError at trace time is strictly safer than a compiler crash
 # that would kill an entire pytest run.
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None):
+    """Version-portable shard_map for the comm-plan collectives: native
+    ``jax.shard_map`` when present, otherwise a CALL-LOCAL adaptation of
+    ``jax.experimental.shard_map`` (``axis_names={...}`` -> the old
+    ``auto=`` complement, ``check_vma`` -> ``check_rep``).
+
+    Unlike :func:`install_shard_map_compat` this never mutates ``jax`` —
+    only the call site that opted in rides the legacy API. The quantized
+    reduce-scatter / all-to-all paths (runtime/comm/quantized.py) are
+    fully-manual or manual-over-size->=1-DP-axes regions that were
+    verified to compile on the 0.4.x jaxlib, unlike the qwZ+TP and
+    SPMD-pipeline shapes the module docstring warns about."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _legacy
+    legacy_params = inspect.signature(_legacy).parameters
+    kwargs = {}
+    if axis_names is not None and "auto" in legacy_params:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kwargs["auto"] = auto
+    if check_vma is not None:
+        key = "check_rep" if "check_rep" in legacy_params else "check_vma"
+        kwargs[key] = check_vma
+    return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kwargs)

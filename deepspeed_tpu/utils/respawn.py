@@ -15,16 +15,24 @@ import os
 from typing import Dict
 
 
-def clean_cpu_env(n_devices: int, base: Dict[str, str] = None
+def clean_cpu_env(n_devices: int, base: Dict[str, str] = None,
+                  collective_timeout_flags: bool = True
                   ) -> Dict[str, str]:
-    """Environment for a subprocess that must see n_devices CPU devices."""
+    """Environment for a subprocess that must see n_devices CPU devices.
+
+    ``collective_timeout_flags=False`` drops the raised CPU-collective
+    rendezvous timeouts: older jaxlibs hard-ABORT on unknown XLA_FLAGS
+    ("Unknown flags in XLA_FLAGS", rc -6), so callers retry without them
+    when the first launch dies that way (__graft_entry__.dryrun_multichip
+    does)."""
     env = dict(base if base is not None else os.environ)
     flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
                      if "xla_force_host_platform_device_count" not in f)
-    env["XLA_FLAGS"] = (
-        flags + f" --xla_force_host_platform_device_count={n_devices}"
-        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
-        " --xla_cpu_collective_call_terminate_timeout_seconds=1200").strip()
+    flags += f" --xla_force_host_platform_device_count={n_devices}"
+    if collective_timeout_flags:
+        flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
+                  " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+    env["XLA_FLAGS"] = flags.strip()
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("JAX_PLATFORM_NAME", None)
     # a site hook may register a TPU PJRT plugin and force its platform;

@@ -381,6 +381,99 @@ def test_tpu008_ignores_specs_outside_constraint_sites(tmp_path):
     assert "TPU008" not in codes(findings, gating_only=False)
 
 
+def test_tpu008_constant_resolution_same_module(tmp_path):
+    """Round-10 depth: a module-level ``SPEC = P(...)`` read at a
+    constraint site is checked like the inline literal — ONE finding,
+    anchored at the definition (the fix location), however many sites
+    read it. Canonical constants stay silent."""
+    findings = lint_snippet(tmp_path, """
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        DRIFTY = P("data", None)
+        CANON = P("data")
+
+        def use(x):
+            a = lax.with_sharding_constraint(x, DRIFTY)
+            b = lax.with_sharding_constraint(x, DRIFTY)
+            c = lax.with_sharding_constraint(x, CANON)
+            return a, b, c
+    """)
+    hits = [f for f in findings if f.rule == "TPU008"]
+    assert len(hits) == 1, hits
+    assert "trailing None" in hits[0].message and "DRIFTY" in hits[0].message
+    assert hits[0].line == 5          # the assignment, not the use sites
+
+
+def test_tpu008_constant_resolution_cross_module(tmp_path):
+    """The constant lives in another module of the lint run: resolution
+    follows the import map (the TPU012 machinery); the finding anchors at
+    the USE site and names the definition."""
+    import textwrap
+    from deepspeed_tpu.analysis import lint_paths
+    (tmp_path / "specs.py").write_text(textwrap.dedent("""
+        from jax.sharding import PartitionSpec as P
+        QUEUE_SPEC = P(("expert",))
+    """))
+    (tmp_path / "user.py").write_text(textwrap.dedent("""
+        from jax import lax
+        from specs import QUEUE_SPEC
+
+        def use(x):
+            return lax.with_sharding_constraint(x, QUEUE_SPEC)
+    """))
+    findings = lint_paths([str(tmp_path / "specs.py"),
+                           str(tmp_path / "user.py")], root=str(tmp_path))
+    hits = [f for f in findings if f.rule == "TPU008"]
+    assert len(hits) == 1, hits
+    assert hits[0].path == "user.py"
+    assert "specs.py:3" in hits[0].message
+    assert "single-name tuple" in hits[0].message
+
+
+def test_tpu008_constant_negative_shadowed_and_poisoned(tmp_path):
+    """A locally-bound name shadows the module constant (the value is the
+    caller's contract), and a REASSIGNED constant is poisoned — both stay
+    silent rather than guess."""
+    findings = lint_snippet(tmp_path, """
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P("data", None)
+        FLIPPY = P("data", None)
+        FLIPPY = P("model")
+
+        def shadowed(x, SPEC):
+            return lax.with_sharding_constraint(x, SPEC)
+
+        def poisoned(x):
+            return lax.with_sharding_constraint(x, FLIPPY)
+    """)
+    assert "TPU008" not in codes(findings, gating_only=False)
+
+
+def test_tpu008_constant_fix_rewrites_definition(tmp_path):
+    """--fix canonicalizes the CONSTANT's P(...) literal (same-module
+    findings anchor there), idempotently."""
+    from deepspeed_tpu.analysis.fixes import fix_paths
+    src = textwrap.dedent("""
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P("data", None)
+
+        def use(x):
+            return lax.with_sharding_constraint(x, SPEC)
+    """)
+    f = tmp_path / "mod.py"
+    f.write_text(src)
+    n, changed = fix_paths([str(f)], root=str(tmp_path))
+    assert n == 1 and changed == [str(f)]
+    assert 'SPEC = P("data")' in f.read_text()
+    n2, _ = fix_paths([str(f)], root=str(tmp_path))
+    assert n2 == 0                      # idempotent
+
+
 # --------------------------------------------------------------------- TPU009
 
 def test_tpu009_positive_bf16_carry_widened(tmp_path):

@@ -326,6 +326,11 @@ def test_sequence_parallel_conflicting_impl_raises():
                                                "mode": "ulysses"}}))
 
 
+# tier-2 (round 10 budget): fattest passing legs demoted per the standing
+# guardrail — tier-1 crept past ~80% of the 870s budget once the comm-plan
+# legs landed and the jax_compat shard_map wrapper recovered the 1-bit
+# family on 0.4.x hosts; cheaper cousins still gate tier-1
+@pytest.mark.slow
 def test_sparse_model_forward_matches_layout_mask():
     """attention_impl='sparse' (as the engine wires it): 'dense' mode must
     equal the plain reference exactly, and a genuinely-masking fixed layout
@@ -366,6 +371,7 @@ def test_sparse_model_unknown_mode_raises_at_forward():
         model.init(jax.random.PRNGKey(0), batch)
 
 
+@pytest.mark.slow
 def test_engine_initializes_with_sparse_attention():
     """End-to-end: ds.initialize consumes the sparse_attention section —
     the knob is no longer parsed-but-dead."""

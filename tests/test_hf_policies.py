@@ -60,6 +60,11 @@ def test_hf_gptj_parity():
     np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
 
 
+# tier-2 (round 10 budget): fattest passing legs demoted per the standing
+# guardrail — tier-1 crept past ~80% of the 870s budget once the comm-plan
+# legs landed and the jax_compat shard_map wrapper recovered the 1-bit
+# family on 0.4.x hosts; cheaper cousins still gate tier-1
+@pytest.mark.slow
 def test_hf_opt_parity():
     """ReLU MLP + learned positions at +2 offset."""
     hf_cfg = transformers.OPTConfig(
@@ -168,6 +173,7 @@ def test_bloom_decode_parity():
     _decode_vs_full(hf, np.random.default_rng(8).integers(0, 96, (2, 16)))
 
 
+@pytest.mark.slow
 def test_moe_decode_parity():
     """MoE models decode (round-1 gap: generation.py raised); with a no-drop
     capacity factor the cached decode matches the full forward."""

@@ -70,6 +70,7 @@ def test_onebit_adam_warmup_matches_exact_adam():
         assert abs(l1 - l2) < 3e-3, (i, l1, l2)
 
 
+@pytest.mark.slow
 def test_onebit_adam_trains_through_freeze():
     """Warmup long enough for v to stabilize (the algorithm's intended regime
     — reference docs put freeze at 15-25% of total steps), then the
@@ -84,6 +85,7 @@ def test_onebit_adam_trains_through_freeze():
     assert engine.onebit._step_warm is not None
 
 
+@pytest.mark.slow
 def test_onebit_lamb_trains_through_freeze():
     engine = _make("OneBitLamb", freeze_step=12, lr=1e-2)
     losses = [float(engine.train_batch(random_batch(16, seed=i))["loss"])
@@ -224,6 +226,7 @@ def test_hierarchical_quantized_allreduce():
                                atol=2 * server_step + 1e-6)
 
 
+@pytest.mark.slow
 def test_onebit_fp16_loss_scaling_composes():
     """onebit + fp16 dynamic loss scaling (the reference default envelope:
     onebit/adam.py:11 runs under FP16_Optimizer): trains through the freeze
@@ -423,6 +426,11 @@ def test_zeroone_engine_program_schedule():
                     "local", "boundary"]
 
 
+# tier-2 (round 10 budget): fattest passing legs demoted per the standing
+# guardrail — tier-1 crept past ~80% of the 870s budget once the comm-plan
+# legs landed and the jax_compat shard_map wrapper recovered the 1-bit
+# family on 0.4.x hosts; cheaper cousins still gate tier-1
+@pytest.mark.slow
 def test_zeroone_trains_and_local_steps_are_collective_free():
     """End-to-end: 0/1 Adam trains through all four program kinds, and the
     HLO of the local-step program contains ZERO cross-replica collective

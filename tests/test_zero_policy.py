@@ -152,6 +152,8 @@ def test_dryrun_legs_have_no_involuntary_rematerialization():
          "import __graft_entry__ as g; g.dryrun_multichip(8)"],
         capture_output=True, text=True, timeout=1800, cwd=repo_root, env=env)
     assert proc.returncode == 0, proc.stderr[-3000:]
-    assert proc.stdout.count("ok") >= 5, proc.stdout
+    # round 10 added the moe_q leg (int8 expert a2a through the comm-plan
+    # explicit exchange) — its transitions must be remat-free too
+    assert proc.stdout.count("ok") >= 6, proc.stdout
     assert "Involuntary full rematerialization" not in proc.stderr, \
         [l for l in proc.stderr.splitlines() if "rematerialization" in l][:4]
