@@ -9,12 +9,16 @@ decode latency with the KV-cache generate loop, on the current backend.
 rates, reporting tokens/s/chip and p50/p99 request latency — the
 serving-SLO counterpart of the closed-loop sweeps above, with a
 machine-readable ``inference_bench poisson: {json}`` line in the PR-7
-dryrun-timings style.
+dryrun-timings style. ``--poisson --fleet N`` (round 11) drives the
+supervised N-replica fleet instead and injects a replica kill mid-run,
+printing a ``poisson_fleet`` row with tokens/s before/during/after the
+loss — the serving tier's resilience number.
 
     python -m deepspeed_tpu.benchmarks.inference_bench \
         [--preset gpt2-125m] [--batches 1,8] [--seqs 128,1024] [--new 64]
     python -m deepspeed_tpu.benchmarks.inference_bench --poisson \
-        [--rates 2,8] [--requests 64] [--prompt 128] [--new 64]
+        [--rates 2,8] [--requests 64] [--prompt 128] [--new 64] \
+        [--fleet 3] [--no-fail-replica]
 """
 
 from __future__ import annotations
@@ -207,7 +211,136 @@ def run_poisson(preset: str, rate: float, num_requests: int,
         "prefix_hit_tokens": eng.stats["prefix_hit_tokens"],
         "n_chips": n_chips,
     }
+    eng.close()      # loop exit stamps EXIT if a heartbeat is attached
     print("inference_bench poisson: " + json.dumps(row))
+    return row
+
+
+def run_poisson_fleet(preset: str, rate: float, num_requests: int,
+                      prompt_len: int, new_tokens: int, replicas: int = 2,
+                      serving: Optional[dict] = None,
+                      fail_replica: bool = True, seed: int = 0,
+                      model_kwargs: Optional[dict] = None) -> dict:
+    """Poisson load against the supervised multi-replica fleet
+    (serving/fleet.py), with an optional failure-injection leg: once a
+    third of the requests have completed, ``serve.replica_kill`` takes
+    out the last replica mid-decode, and the row records tokens/s
+    BEFORE / DURING / AFTER the loss — the resilience number ROADMAP
+    item 1(c) asks the first serving BENCH entry to carry. "during"
+    spans kill -> requeue-complete (detection + teardown + requeue +
+    replay); "after" is the recovered fleet. Machine-readable row::
+
+        inference_bench poisson_fleet: {"rate": ..., "replicas": ...,
+            "tps_before": ..., "tps_during": ..., "tps_after": ...,
+            "requeues": ..., "deaths": ..., ...}
+    """
+    from ..models import build_model
+    from ..serving.fleet import ServingFleet
+    from ..testing import chaos
+    model, cfg = build_model(preset, max_seq_len=prompt_len + new_tokens,
+                             **(model_kwargs or {}))
+    rng = np.random.default_rng(seed)
+    ids0 = rng.integers(0, cfg.vocab_size, (1, prompt_len))
+    # one-shot bench setup: init compiles once before the timed region
+    # graftlint: disable=TPU002
+    params = jax.jit(lambda r: model.init(r, {"input_ids": ids0})
+                     ["params"])(jax.random.PRNGKey(0))
+    scfg = dict(serving or {})
+    fleet_cfg = dict(scfg.pop("fleet", {}))
+    fleet_cfg.setdefault("replicas", replicas)
+    # snappy recovery for the bench window (production defaults are lazier)
+    fleet_cfg.setdefault("poll_interval", 0.05)
+    fleet_cfg.setdefault("heartbeat_interval", 0.05)
+    scfg["fleet"] = fleet_cfg
+    flt = ServingFleet(cfg, params, serving=scfg)
+    flt.start()
+
+    # warm EVERY replica's compile caches outside the timed window (each
+    # engine has its own jit closures; a cold replica would bill XLA
+    # latency to the serving numbers)
+    flt.warmup(prompt=list(rng.integers(1, cfg.vocab_size,
+                                        size=prompt_len)))
+    base = dict(flt.stats)              # row reports the timed window only
+
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=prompt_len))
+               for _ in range(num_requests)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=num_requests))
+    t0 = time.perf_counter()
+    t0_mono = time.monotonic()
+    reqs: List = []
+    next_i = 0
+    killed_at = None
+    kill_target = str(int(fleet_cfg["replicas"]) - 1)
+    timeline: List[tuple] = []          # (t, tokens_emitted) samples
+    while True:
+        now = time.perf_counter() - t0
+        while next_i < num_requests and arrivals[next_i] <= now:
+            reqs.append(flt.submit(prompts[next_i], new_tokens))
+            next_i += 1
+        done = sum(1 for r in reqs if r.done)
+        timeline.append((now, flt.stats["tokens_emitted"]))
+        if (fail_replica and killed_at is None
+                and done >= max(num_requests // 3, 1)):
+            chaos.arm("serve.replica_kill", "raise", match=kill_target)
+            killed_at = now
+        if next_i >= num_requests and done >= num_requests:
+            break
+        time.sleep(0.005)
+    wall = time.perf_counter() - t0
+    if killed_at is not None:
+        # the victim may have died with no in-flight work, in which case
+        # the drain above never waited on detection — give the supervisor
+        # its poll so the row's death/attribution columns are stable
+        t_wait = time.perf_counter()
+        while (flt.stats["deaths"] == base["deaths"]
+               and time.perf_counter() - t_wait < 10.0):
+            time.sleep(0.01)
+    chaos.disarm("serve.replica_kill")
+
+    def _tps(t_lo, t_hi):
+        if t_hi - t_lo <= 0:
+            return None
+        lo = min((s for s in timeline if s[0] >= t_lo),
+                 default=timeline[-1])
+        hi = max((s for s in timeline if s[0] <= t_hi),
+                 default=timeline[-1])
+        if hi[0] - lo[0] <= 0:
+            return None
+        return round((hi[1] - lo[1]) / (hi[0] - lo[0]), 1)
+
+    # recovery instant: the death ledger's restart stamp, in bench time
+    t_rec = None
+    if flt.deaths:
+        rts = flt.deaths[-1]["restarted_ts"] or flt.deaths[-1]["detected_ts"]
+        t_rec = rts - t0_mono
+    lat = sorted(r.finish_ts - (t0_mono + arr)
+                 for r, arr in zip(reqs, arrivals) if r.finish_ts)
+    n_chips = jax.device_count()
+    row = {
+        "preset": preset, "rate": float(rate), "replicas":
+            int(fleet_cfg["replicas"]), "requests": num_requests,
+        "prompt": prompt_len, "new_tokens": new_tokens,
+        "wall_s": round(wall, 3),
+        "p50_s": round(float(np.percentile(lat, 50)), 4),
+        "p99_s": round(float(np.percentile(lat, 99)), 4),
+        "tokens_per_s": round(num_requests * new_tokens / wall, 1),
+        "tokens_per_s_per_chip": round(
+            num_requests * new_tokens / wall / n_chips, 1),
+        "tps_before": _tps(0.0, killed_at) if killed_at else None,
+        "tps_during": (_tps(killed_at, t_rec)
+                       if killed_at and t_rec else None),
+        "tps_after": _tps(t_rec, wall) if t_rec else None,
+        "kill_at_s": round(killed_at, 3) if killed_at else None,
+        "recovered_at_s": round(t_rec, 3) if t_rec else None,
+        "deaths": flt.stats["deaths"] - base["deaths"],
+        "requeues": flt.stats["requeues"] - base["requeues"],
+        "completed": flt.stats["completed"] - base["completed"],
+        "failed": flt.stats["failed"] - base["failed"],
+        "timeout": flt.stats["timeout"] - base["timeout"],
+        "n_chips": n_chips,
+    }
+    flt.close()
+    print("inference_bench poisson_fleet: " + json.dumps(row))
     return row
 
 
@@ -257,6 +390,13 @@ def main(argv=None):
                    help="request rates (req/s), comma-separated")
     p.add_argument("--requests", type=int, default=64)
     p.add_argument("--prompt", type=int, default=128)
+    p.add_argument("--fleet", type=int, default=0,
+                   help="with --poisson: drive a supervised N-replica "
+                        "fleet instead of one engine; prints the "
+                        "poisson_fleet degraded-throughput row")
+    p.add_argument("--no-fail-replica", action="store_true",
+                   help="fleet leg: skip the replica-kill injection "
+                        "(steady-state fleet throughput only)")
     args = p.parse_args(argv)
     if args.spatial:
         run_spatial(args.latent, int(args.batches.split(",")[0]))
@@ -266,8 +406,14 @@ def main(argv=None):
         return
     if args.poisson:
         for rate in (float(x) for x in args.rates.split(",")):
-            run_poisson(args.preset, rate, args.requests, args.prompt,
-                        args.new)
+            if args.fleet > 1:
+                run_poisson_fleet(args.preset, rate, args.requests,
+                                  args.prompt, args.new,
+                                  replicas=args.fleet,
+                                  fail_replica=not args.no_fail_replica)
+            else:
+                run_poisson(args.preset, rate, args.requests, args.prompt,
+                            args.new)
         return
     run(args.preset, [int(x) for x in args.batches.split(",")],
         [int(x) for x in args.seqs.split(",")], args.new)

@@ -392,6 +392,38 @@ class WatchdogConfig(DeepSpeedConfigModel):
     serve_timeout: float = 0.0    # SERVE: serving-loop iteration gap; 0 = off
 
 
+class FleetConfig(DeepSpeedConfigModel):
+    """TPU-native (round 11): the supervised multi-replica serving fleet
+    (``serving/fleet.py``, docs/SERVING.md §Fleet). With ``replicas > 1``
+    the serving tier runs N continuous-batching replica engines (weights
+    shared, KV pools per-replica) behind ONE bounded admission queue. A
+    FleetSupervisor consumes each replica's SERVE heartbeat records
+    (runtime/heartbeat.py): a dead worker or ``heartbeat_timeout``
+    seconds of record silence — the rc-117 contract applied fleet-side —
+    tears down only that replica, requeues its in-flight requests with
+    exactly-once token emission, and restarts it; ``blacklist_after``
+    strikes quarantine a repeatedly-dying replica, and when live replicas
+    would drop below ``min_replicas`` the least-struck blacklisted one is
+    paroled back (the elastic agent's machinery, applied to serving).
+    ``retry_budget`` bounds requeues per request — past it the request
+    concludes FAILED instead of looping. ``default_deadline_s`` is the
+    queue-wait TTL applied to requests submitted without one (0 = none);
+    expired queued requests are shed with TIMEOUT (graceful admission
+    backpressure). ``heartbeat_dir`` points the per-replica channel at a
+    directory ``dstpu health`` can read (default: a private tempdir,
+    exposed as ``ServingFleet.heartbeat_dir``)."""
+    replicas: int = 1                  # 1 = plain single-engine serving
+    retry_budget: int = 2              # requeues per request before FAILED
+    heartbeat_timeout: float = 10.0    # replica record silence -> dead
+    heartbeat_interval: float = 0.25   # replica writer min_interval
+    poll_interval: float = 0.5         # supervisor check cadence
+    blacklist_after: int = 3           # strikes before quarantine; 0 = never
+    min_replicas: int = 1              # parole floor for live replicas
+    max_queue: int = 4096              # shared admission queue bound
+    default_deadline_s: float = 0.0    # queue-wait TTL; 0 = none
+    heartbeat_dir: Optional[str] = None  # None = private tempdir
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """TPU-native (round 8): the continuous-batching serving loop
     (deepspeed_tpu/serving/, docs/SERVING.md). The KV cache is a paged
@@ -414,6 +446,7 @@ class ServingConfig(DeepSpeedConfigModel):
     max_queue: int = 4096              # admission queue bound (backpressure)
     kv_cache_dtype: Optional[str] = None   # None = model dtype
     seed: int = 0                      # sampling PRNG seed
+    fleet: FleetConfig = Field(default_factory=FleetConfig)
 
 
 class CommPlanConfig(DeepSpeedConfigModel):

@@ -254,16 +254,55 @@ class InferenceEngine:
         ServingConfig). When the config arms ``watchdog.serve_timeout``,
         the loop is supervised by the PR-6 stall watchdog (rc 117 on a
         wedged iteration). int8 weight-only engines serve unchanged (the
-        dequant rides the paged forward's matmuls)."""
+        dequant rides the paged forward's matmuls).
+
+        With ``serving.fleet.replicas > 1`` (round 11) this returns a
+        STARTED :class:`~deepspeed_tpu.serving.fleet.ServingFleet`
+        instead: N replica loops behind one shared admission queue,
+        supervised through the heartbeat channel (replica death ->
+        requeue with exactly-once emission; docs/SERVING.md §Fleet). The
+        fleet supervises its replicas itself — the in-process stall
+        watchdog stays off (its rc-117 exit would take the whole fleet).
+        Use it as a context manager, or call ``close()``, so the loop
+        exit stamps EXIT terminal heartbeats."""
         from ..models.transformer import Transformer
         if not isinstance(self.module, Transformer):
             raise NotImplementedError(
                 "serve() requires a deepspeed_tpu.models.Transformer "
                 "(the paged runner mirrors its decode layer math)")
+        from ..config.config import ServingConfig
+        scfg = serving if serving is not None else self.config.serving
+        if isinstance(scfg, dict):
+            scfg = ServingConfig(**scfg)
+        if scfg.fleet.replicas > 1:
+            from ..serving.fleet import ServingFleet
+            from ..utils.logging import logger
+            hb_dir = scfg.fleet.heartbeat_dir
+            if heartbeat is not None and hb_dir is None:
+                # a caller-provided writer is rank-scoped; the fleet
+                # writes PER-REPLICA records (and run-scopes its channel
+                # with clear_channel, which would wipe a shared training
+                # dir's rank files) — so adopt a `fleet/` subdir of the
+                # writer's channel rather than silently dropping the
+                # operator's monitoring location
+                import os
+                hb_dir = os.path.join(heartbeat.directory, "fleet")
+                logger.warning(
+                    "serve(): fleet mode replaces the provided heartbeat "
+                    "writer with per-replica writers under %s — point "
+                    "`dstpu health` there", hb_dir)
+            if self.config.watchdog.serve_timeout > 0:
+                logger.warning(
+                    "serve(): watchdog.serve_timeout is not armed under "
+                    "a fleet — its rc-117 exit would take every replica; "
+                    "the FleetSupervisor (fleet.heartbeat_timeout) "
+                    "supervises replicas instead")
+            fleet = ServingFleet(self.module.cfg, self.params, serving=scfg,
+                                 heartbeat_dir=hb_dir, interpret=interpret)
+            fleet.start()
+            return fleet
         from ..serving.engine import ServingEngine
-        eng = ServingEngine(self.module.cfg, self.params,
-                            serving=serving if serving is not None
-                            else self.config.serving,
+        eng = ServingEngine(self.module.cfg, self.params, serving=scfg,
                             heartbeat=heartbeat, interpret=interpret)
         if self.config.watchdog.serve_timeout > 0:
             eng.arm_watchdog(self.config.watchdog.serve_timeout)

@@ -241,8 +241,11 @@ def parse_args(argv=None):
 
 def health_main(argv) -> int:
     """``dstpu health <heartbeat-dir>`` — the operator's one-glance pod
-    view: per-rank phase, step, record age, host, pid and integrity
-    FLAGS from the heartbeat channel. Exit 0 when every rank is live or
+    view: per-rank phase, step, record age, host, pid, phase GAUGES
+    (SERVE stamps queue-depth / active-lane load) and integrity
+    FLAGS from the heartbeat channel. Works on a serving fleet's
+    per-replica channel (serving/fleet.py) exactly as on a training
+    world's per-rank one. Exit 0 when every rank is live or
     concluded cleanly, 1 when any rank's last word is STALLED, any rank
     carries an integrity flag (e.g. ``SDC`` — its host's numbers cannot
     be trusted), or the channel is empty (nothing attesting = nothing
@@ -259,12 +262,19 @@ def health_main(argv) -> int:
         print(f"no heartbeat records under {a.heartbeat_dir}")
         return 1
     now = _time.time()
-    rows = [("RANK", "HOST", "PHASE", "STEP", "AGE", "PID", "FLAGS", "")]
+    rows = [("RANK", "HOST", "PHASE", "STEP", "AGE", "PID", "GAUGES",
+             "FLAGS", "")]
     bad = False
     for rank in sorted(records):
         rec = records[rank]
         age = hb.record_age(rec, now)
         phase = str(rec.get("phase"))
+        # phase-specific load gauges (SERVE: queue depth / active lanes)
+        # so a serving rank's health line answers "how loaded", not just
+        # "alive" — a fleet replica pinned at queue>0 active=0 is wedged
+        # admission, visible here before any timeout fires
+        gauges = rec.get("gauges") or {}
+        gtxt = ",".join(f"{k}={gauges[k]}" for k in sorted(gauges)) or "-"
         flags = ",".join(rec.get("flags") or ()) or "-"
         note = ""
         if phase == hb.PHASE_STALLED:
@@ -281,7 +291,7 @@ def health_main(argv) -> int:
             bad = True
         rows.append((str(rank), str(rec.get("host")), phase,
                      str(rec.get("step")), f"{age:.1f}s",
-                     str(rec.get("pid")), flags, note))
+                     str(rec.get("pid")), gtxt, flags, note))
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
     for r in rows:
         print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())

@@ -17,6 +17,10 @@ Record schema (one JSON object per line, newest last)::
     {"rank": 3, "host": "worker-3", "pid": 4711,
      "phase": "STEP", "step": 120, "ts": 1754200000.0}
 
+Records may carry an optional ``gauges`` sub-dict of small phase-specific
+load counters (the SERVE phase stamps ``{"queue": …, "active": …,
+"lanes": …}``) and a sticky ``flags`` list (integrity evidence).
+
 Design constraints:
 
 - **Crash-evidence quality.** The file is rewritten via tmp + atomic
@@ -155,9 +159,15 @@ class HeartbeatWriter:
         return heartbeat_path(self.directory, self.rank)
 
     def write(self, phase: str, step: int, force: bool = False,
-              lock_timeout: Optional[float] = None) -> bool:
+              lock_timeout: Optional[float] = None,
+              extra: Optional[dict] = None) -> bool:
         """Record {rank, host, phase, step, ts}. Returns True if a record
         was actually written (False = throttled or swallowed failure).
+
+        ``extra`` carries small phase-specific gauges under a ``gauges``
+        sub-dict (round 11: the serving loop's queue-depth / active-lane
+        counts), so ``dstpu health`` can show LOAD as well as liveness —
+        namespaced so a gauge can never shadow a schema key.
 
         Exit paths (the watchdog's rc-117 fire, the preemption signal
         handler) must pass ``lock_timeout``: the writer lock may be held
@@ -180,6 +190,8 @@ class HeartbeatWriter:
                 return False
             rec = {"rank": self.rank, "host": self.host, "pid": os.getpid(),
                    "phase": phase, "step": int(step), "ts": now}
+            if extra:
+                rec["gauges"] = {str(k): v for k, v in extra.items()}
             if self._flags:
                 rec["flags"] = list(self._flags)
             self._records.append(rec)

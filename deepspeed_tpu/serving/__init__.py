@@ -7,15 +7,21 @@
 - :mod:`~deepspeed_tpu.serving.scheduler` — FIFO admission control under
   the block budget;
 - :mod:`~deepspeed_tpu.serving.engine` — the fixed-shape serving loop
-  (one decode-step compile, SERVE heartbeat phase).
+  (one decode-step compile, SERVE heartbeat phase);
+- :mod:`~deepspeed_tpu.serving.fleet` — supervised multi-replica fleet
+  (shared admission queue, heartbeat-driven replica death detection,
+  exactly-once request requeue, blacklist/parole, graceful degradation).
 
 Entry points: ``ServingEngine(cfg, params, serving_config)`` directly, or
-``deepspeed_tpu.init_inference(...).serve()``.
+``deepspeed_tpu.init_inference(...).serve()`` (which returns a started
+``ServingFleet`` when ``serving.fleet.replicas > 1``).
 """
 
 from .engine import ServingEngine
+from .fleet import FleetRequest, FleetSupervisor, ServingFleet
 from .kv_cache import BlockPool, BlockPoolExhausted, PrefixCache, init_pool
 from .scheduler import Request, Scheduler
 
-__all__ = ["ServingEngine", "BlockPool", "BlockPoolExhausted", "PrefixCache",
+__all__ = ["ServingEngine", "ServingFleet", "FleetSupervisor",
+           "FleetRequest", "BlockPool", "BlockPoolExhausted", "PrefixCache",
            "init_pool", "Request", "Scheduler"]

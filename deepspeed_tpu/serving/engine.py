@@ -46,8 +46,8 @@ from ..utils.logging import log_dist, logger
 from .kv_cache import (NULL_BLOCK, BlockPool, BlockPoolExhausted, PrefixCache,
                        init_pool)
 from .model_runner import paged_forward
-from .scheduler import (FAILED, FINISHED, PREFILL, QUEUED, RUNNING, Request,
-                        Scheduler)
+from .scheduler import (FAILED, FINISHED, PREFILL, QUEUED, RUNNING, TIMEOUT,
+                        Request, Scheduler)
 
 PyTree = Any
 
@@ -127,8 +127,9 @@ class ServingEngine:
         self._lock = threading.Lock()
         self.steps = 0                     # decode steps executed
         self.stats: Dict[str, int] = {
-            "completed": 0, "failed": 0, "tokens_generated": 0,
-            "prefill_tokens": 0, "prefix_hit_tokens": 0}
+            "completed": 0, "failed": 0, "timeout": 0,
+            "tokens_generated": 0, "prefill_tokens": 0,
+            "prefix_hit_tokens": 0}
 
         # ---- compiled programs (fixed shapes; ONE decode specialization) ----
         L = cfg.num_layers
@@ -173,10 +174,14 @@ class ServingEngine:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                temperature: float = 0.0, eos_token_id: Optional[int] = None,
-               on_finish=None, top_k=None, top_p=None) -> Request:
+               on_finish=None, top_k=None, top_p=None,
+               deadline_s: Optional[float] = None) -> Request:
         """Enqueue a generation request (thread-safe); returns the live
         :class:`Request` whose ``output_tokens``/``state`` the caller (or
-        ``on_finish``) observes."""
+        ``on_finish``) observes. ``deadline_s`` is a queue-wait TTL: a
+        request still QUEUED that long after arrival is shed with a
+        TIMEOUT result instead of waiting behind a too-big head forever
+        (admitted requests always run to completion)."""
         if top_k is not None or top_p is not None:
             raise NotImplementedError(
                 "serving decode supports greedy / temperature sampling "
@@ -186,6 +191,8 @@ class ServingEngine:
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature),
                       eos_token_id=eos_token_id, on_finish=on_finish)
+        if deadline_s is not None:
+            req.deadline_ts = req.arrival_ts + float(deadline_s)
         return self.scheduler.submit(req)
 
     # -------------------------------------------------------------- the loop
@@ -207,6 +214,7 @@ class ServingEngine:
             if self.active:
                 done += self._decode_step()
             self.steps += 1
+            self.stats["timeout"] = self.scheduler.timed_out
             self._stamp_heartbeat()
             return done
 
@@ -217,6 +225,33 @@ class ServingEngine:
                 return
             self.step()
         raise RuntimeError(f"serving loop not idle after {max_steps} steps")
+
+    def run_forever(self, stop=None, idle_wait: float = 0.01) -> None:
+        """The long-lived server entry: iterate until ``stop`` (a
+        ``threading.Event``) is set, idle-waiting (and still stamping the
+        SERVE heartbeat) between requests. The loop's EXIT is always
+        stamped as a terminal heartbeat via :meth:`close` — a finished
+        serving loop must read as a conclusion, never as rc-117 silence
+        (``dstpu health`` shows ``clean exit``, not ``SILENT``)."""
+        stop = stop if stop is not None else threading.Event()
+        try:
+            while not stop.is_set():
+                if self.idle:
+                    with self._lock:
+                        self._stamp_heartbeat()
+                    stop.wait(idle_wait)
+                    continue
+                self.step()
+        finally:
+            self.close()
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # context-manager exit IS the loop exit: stamp the EXIT terminal
+        # heartbeat so a drained-and-abandoned server never reads silent
+        self.close()
 
     def generate_batch(self, prompts: Sequence[Sequence[int]],
                        max_new_tokens: int = 32, temperature: float = 0.0,
@@ -255,7 +290,13 @@ class ServingEngine:
             self._watchdog.beat(self.steps)
         if self._heartbeat is not None:
             try:
-                self._heartbeat.write(PHASE_SERVE, self.steps)
+                # queue-depth / active-lane gauges ride the record so
+                # `dstpu health` shows load, not just liveness
+                self._heartbeat.write(
+                    PHASE_SERVE, self.steps,
+                    extra={"queue": self.scheduler.pending,
+                           "active": self.active,
+                           "lanes": self.max_batch})
             except Exception:
                 pass                      # diagnostics must not kill serving
 
@@ -269,7 +310,11 @@ class ServingEngine:
 
     def _admit(self) -> int:
         """Fill free lanes from the queue head; returns requests that
-        FINISHED during admission (max_new_tokens == 1 one-shots)."""
+        FINISHED during admission (max_new_tokens == 1 one-shots).
+        Expired queued requests are shed first, even with every lane
+        busy — the deadline bounds queue wait precisely when nothing can
+        be admitted."""
+        self.scheduler.shed_expired()
         done = 0
         while self._free_slot() is not None:
             req = self.scheduler.next_admission()

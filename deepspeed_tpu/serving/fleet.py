@@ -1,0 +1,821 @@
+"""ServingFleet — supervised multi-replica serving with request requeue.
+
+PR 8's serving engine is one replica with no failure story: a wedged or
+killed loop takes every in-flight request with it. This module shrinks
+the serving failure domain to one replica (ROADMAP item 1(c)): N
+continuous-batching replica engines — weights SHARED in-process, KV
+pools per-replica — pull work from ONE bounded admission queue, and a
+:class:`FleetSupervisor` watches each replica's SERVE heartbeat records
+(runtime/heartbeat.py) the way the PR-6 launcher stack watches training
+ranks. Losing a replica costs one replica, not the fleet.
+
+Failure semantics (the contract the chaos matrix in tests/test_fleet.py
+pins):
+
+* **Detection is the rc-117 silence contract, fleet-side.** Each replica
+  worker stamps a SERVE record (with queue/active gauges) every loop
+  iteration onto the fleet's heartbeat channel. A dead worker thread, or
+  ``heartbeat_timeout`` seconds of record silence from a live one (the
+  chaos ``serve.replica_hang`` shape — a loop wedged in a failpoint or a
+  stuck device), declares the replica DOWN. The supervisor stamps a
+  ``STALLED`` terminal record as evidence (``dstpu health`` on
+  ``fleet.heartbeat_dir`` shows it) and records the replica's last
+  heartbeat in ``fleet.deaths`` for attribution.
+* **Teardown is replica-local.** Only the dead replica is torn down and
+  (unless blacklisted) restarted with a fresh engine; surviving replicas
+  keep their engines, pools and compiled programs — fleet throughput
+  recovers without touching them (pinned by test).
+* **Requeue is exactly-once.** A FleetRequest carries its
+  tokens-emitted-so-far; a requeued request re-enters the queue with
+  ``prompt + emitted`` as its prompt and only the REMAINING budget as
+  ``max_new_tokens``, so the resumed replica replays the generated
+  prefix through its prefix cache (prefill, full-block reuse when
+  cached) and ``on_token`` callbacks never re-fire a token. Tokens a
+  dying replica generated but never emitted are deliberately dropped —
+  greedy decode regenerates them identically; emission, not generation,
+  is the exactly-once boundary. The emission/discard race is closed
+  under the per-replica lock: the supervisor marks a replica DOWN under
+  the same lock the worker syncs tokens under, so a declared-dead
+  replica can never emit concurrently with its requests being re-served.
+* **Retry budget.** Every requeue costs one retry; past
+  ``retry_budget`` the request concludes FAILED (callback fires, status
+  observable) instead of bouncing between dying replicas forever. The
+  ``serve.requeue`` failpoint fires inside the requeue itself: a crash
+  THERE parks the request on an orphan list the supervisor retries next
+  poll — a requeue failure defers a request, never loses it.
+* **Blacklist / parole.** ``blacklist_after`` strikes quarantine a
+  repeatedly-dying replica (no restart); when live replicas would drop
+  below ``min_replicas`` the least-struck blacklisted replica is paroled
+  back — the elastic agent's host machinery (PR 6), applied to serving.
+* **Graceful degradation.** The fleet keeps serving at reduced capacity
+  with replicas down; per-request deadlines (``deadline_s`` /
+  ``fleet.default_deadline_s``) shed expired queued requests with a
+  TIMEOUT status — bounded-latency load shedding, not silent starvation.
+
+Chaos failpoints (testing/chaos.py): ``serve.replica_kill`` and
+``serve.replica_hang`` fire at the top of each worker iteration, KEYED
+by the replica index (``match=1`` takes out replica 1 only). In-process
+replicas use ``raise`` / ``hang`` modes — ``kill`` mode would
+``os._exit`` the whole process; it belongs to a future process-per-
+replica deployment, where the same heartbeat channel does the same job.
+
+Threading model: one worker thread per replica (dispatch and token
+sync/stamp under the replica lock; the engine step runs OUTSIDE it so a
+wedge inside XLA can never hold the lock the supervisor needs to fence
+the replica), one supervisor thread (``poll_interval`` cadence;
+``poll()`` is public for deterministic tests). ``submit()`` is
+thread-safe from any thread. A hung worker is abandoned (daemon
+threads; its per-replica pool leaks until process exit — the price of
+in-process replicas, documented in docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import tempfile
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..runtime import heartbeat as hb
+from ..testing import chaos
+from ..utils.logging import log_dist, logger
+from .engine import ServingEngine
+from .scheduler import (FAILED, FINISHED, QUEUED, RUNNING, TIMEOUT,
+                        check_admissible)
+
+PyTree = Any
+
+#: replica lifecycle states
+LIVE, DOWN, BLACKLISTED = "LIVE", "DOWN", "BLACKLISTED"
+
+
+@dataclass
+class FleetRequest:
+    """One generation request riding the fleet — survives replica death.
+
+    ``output_tokens`` holds only EMITTED tokens (synced from a live
+    replica under its lock, ``on_token`` fired per token); it is the
+    exactly-once ledger a requeue resumes from. ``retries`` counts
+    requeues; ``state`` ends FINISHED, FAILED (budget exhausted or a
+    deterministic per-request failure) or TIMEOUT (deadline shed)."""
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    eos_token_id: Optional[int] = None
+    deadline_ts: Optional[float] = None
+    on_token: Optional[Callable[["FleetRequest", int], None]] = None
+    on_finish: Optional[Callable[["FleetRequest"], None]] = None
+    rid: int = 0
+    state: str = QUEUED
+    output_tokens: List[int] = field(default_factory=list)
+    retries: int = 0
+    replica: Optional[int] = None      # current / last assignment
+    error: Optional[str] = None
+    arrival_ts: float = field(default_factory=time.monotonic)
+    finish_ts: Optional[float] = None
+    _synced: int = 0                   # engine tokens consumed this leg
+    _done_evt: threading.Event = field(default_factory=threading.Event)
+
+    @property
+    def done(self) -> bool:
+        return self.state in (FINISHED, FAILED, TIMEOUT)
+
+    @property
+    def remaining(self) -> int:
+        return max(self.max_new_tokens - len(self.output_tokens), 0)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline_ts is None:
+            return False
+        return (time.monotonic() if now is None else now) > self.deadline_ts
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request concludes; True iff it did in time."""
+        return self._done_evt.wait(timeout)
+
+    def _finish(self, state: str, error: Optional[str] = None) -> bool:
+        """First conclusion wins — exactly-once for on_finish too."""
+        if self.done:
+            return False
+        self.state = state
+        self.error = error
+        self.finish_ts = time.monotonic()
+        self._done_evt.set()
+        if self.on_finish is not None:
+            try:
+                self.on_finish(self)
+            except Exception:
+                logger.exception("fleet: on_finish callback for request "
+                                 "%d raised", self.rid)
+        return True
+
+
+class _Replica:
+    """One replica slot: engine + worker thread + heartbeat writer.
+
+    A restart builds a NEW _Replica for the same index (strikes carried
+    over) — an abandoned hung worker holds the OLD object, whose DOWN
+    state makes its loop exit if it ever wakes, and whose engine/pool it
+    can scribble on harmlessly."""
+
+    def __init__(self, idx: int, generation: int = 0, strikes: int = 0):
+        self.idx = idx
+        self.generation = generation
+        self.strikes = strikes
+        self.state = LIVE
+        self.warming = False           # silence-exempt during warmup()
+        self.engine: Optional[ServingEngine] = None
+        self.thread: Optional[threading.Thread] = None
+        self.writer: Optional[hb.HeartbeatWriter] = None
+        self.lock = threading.Lock()   # worker step/sync vs supervisor down
+        self.inflight: Dict[int, Any] = {}   # rid -> (FleetRequest, eng req)
+        self.error: Optional[str] = None
+        self.started_ts = time.monotonic()
+
+
+class ServingFleet:
+    """N supervised replica serving loops behind one admission queue
+    (module docstring has the failure semantics).
+
+    ``serving`` is a ``ServingConfig`` (or dict); its ``fleet`` section
+    (``FleetConfig``) sizes and tunes the fleet. ``params`` is shared by
+    reference across replicas — per-replica state is the KV pool and the
+    compiled programs.
+    """
+
+    def __init__(self, cfg, params: PyTree, serving=None,
+                 heartbeat_dir: Optional[str] = None,
+                 interpret: bool = False):
+        from ..config.config import ServingConfig
+        if serving is None:
+            serving = ServingConfig()
+        elif isinstance(serving, dict):
+            serving = ServingConfig(**serving)
+        self.cfg = cfg
+        self.params = params
+        self.scfg = serving
+        self.fcfg = serving.fleet
+        self.interpret = interpret
+        self.n_replicas = max(1, int(self.fcfg.replicas))
+        self.heartbeat_dir = (heartbeat_dir or self.fcfg.heartbeat_dir
+                              or tempfile.mkdtemp(prefix="dstpu-fleet-hb-"))
+        self._queue: deque = deque()             # guarded by _qlock
+        self._qlock = threading.Lock()
+        self._stats_lock = threading.Lock()      # counters bumped from N
+        #                                          workers + supervisor
+        self._orphans: List[FleetRequest] = []   # failed requeues, retried
+        #: fenced-but-wedged replicas whose teardown awaits their lock
+        self._pending_down: List[tuple] = []
+        self._outstanding: Dict[int, FleetRequest] = {}
+        self._rid = 0
+        self._stop = threading.Event()
+        self._started = False
+        self._lock = threading.Lock()            # replica-list mutations
+        self._replicas: List[_Replica] = [_Replica(i)
+                                          for i in range(self.n_replicas)]
+        self.supervisor = FleetSupervisor(self)
+        #: death ledger: {replica, generation, reason, evidence (last
+        #: heartbeat record), strikes, detected_ts, action,
+        #: restarted_ts} — the attribution trail tests and the bench read
+        self.deaths: List[dict] = []
+        self.stats: Dict[str, int] = {
+            "submitted": 0, "completed": 0, "failed": 0, "timeout": 0,
+            "requeues": 0, "deaths": 0, "restarts": 0, "paroles": 0,
+            "blacklisted": 0, "tokens_emitted": 0}
+        # run-scoped channel: stale records from a previous fleet in a
+        # reused dir must not trip silence at t=0 (PR-6 contract)
+        hb.clear_channel(self.heartbeat_dir)
+        log_dist(
+            f"ServingFleet: {self.n_replicas} replicas, "
+            f"retry_budget={self.fcfg.retry_budget}, "
+            f"heartbeat_timeout={self.fcfg.heartbeat_timeout}s, "
+            f"heartbeat_dir={self.heartbeat_dir}", ranks=[0])
+
+    # -------------------------------------------------------------- lifecycle
+
+    def start(self) -> "ServingFleet":
+        if self._started:
+            return self
+        self._started = True
+        for rep in self._replicas:
+            self._launch(rep)
+        self.supervisor.start()
+        return self
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the supervisor and workers; stamp EXIT terminal records
+        for every live replica so a closed fleet reads as concluded, not
+        silent. ``timeout`` bounds the WHOLE close (an abandoned hung
+        worker must not stall shutdown). Outstanding requests are left
+        un-concluded — drain first if they matter."""
+        self.supervisor.stop()
+        self._stop.set()
+        deadline = time.monotonic() + timeout
+        for rep in self._replicas:
+            if rep.state != LIVE:
+                continue                # hung/blacklisted: abandoned daemons
+            t = rep.thread
+            if t is not None and t.is_alive():
+                t.join(max(0.0, deadline - time.monotonic()))
+            if rep.writer is not None:
+                rep.writer.stamp_terminal(hb.PHASE_EXIT, lock_timeout=1.0)
+
+    def __enter__(self) -> "ServingFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------- submission
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               temperature: float = 0.0, eos_token_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               on_token=None, on_finish=None) -> FleetRequest:
+        """Enqueue onto the SHARED fleet queue (thread-safe, bounded —
+        raises on a full queue or an inadmissible request, the caller
+        must know synchronously). ``deadline_s`` defaults to
+        ``fleet.default_deadline_s`` (0 = wait forever)."""
+        chaos.failpoint("serve.enqueue")
+        prompt = [int(t) for t in prompt]
+        # eager admissibility — the SAME predicate every replica's
+        # scheduler applies (shared pool geometry): a request no replica
+        # could ever admit must be rejected now, not discovered
+        # asynchronously at dispatch
+        bs = int(self.scfg.block_size)
+        check_admissible(
+            len(prompt), int(max_new_tokens), bs,
+            int(self.scfg.pool_blocks),
+            min(int(self.scfg.max_blocks_per_seq) * bs,
+                self.cfg.max_seq_len))
+        if deadline_s is None and self.fcfg.default_deadline_s > 0:
+            deadline_s = self.fcfg.default_deadline_s
+        with self._qlock:
+            if len(self._queue) >= int(self.fcfg.max_queue):
+                raise RuntimeError(
+                    f"fleet queue full ({self.fcfg.max_queue}); apply "
+                    "backpressure upstream")
+            self._rid += 1
+            req = FleetRequest(
+                prompt=prompt, max_new_tokens=int(max_new_tokens),
+                temperature=float(temperature), eos_token_id=eos_token_id,
+                on_token=on_token, on_finish=on_finish, rid=self._rid)
+            if deadline_s is not None:
+                req.deadline_ts = req.arrival_ts + float(deadline_s)
+            self._queue.append(req)
+            self._outstanding[req.rid] = req
+        self._bump("submitted")
+        return req
+
+    @property
+    def pending(self) -> int:
+        with self._qlock:
+            return len(self._queue) + len(self._orphans)
+
+    @property
+    def idle(self) -> bool:
+        with self._qlock:
+            return not self._outstanding
+
+    def live_replicas(self) -> List[int]:
+        with self._lock:
+            return [r.idx for r in self._replicas if r.state == LIVE]
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Wait until every submitted request concludes (FINISHED /
+        FAILED / TIMEOUT); True iff all did within ``timeout``."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._qlock:
+                reqs = list(self._outstanding.values())
+            if not reqs:
+                return True
+            reqs[0].wait(min(0.05, max(deadline - time.monotonic(), 0.0)))
+            with self._qlock:
+                for rid in [r.rid for r in reqs if r.done]:
+                    self._outstanding.pop(rid, None)
+        with self._qlock:
+            return not self._outstanding
+
+    def warmup(self, prompt: Optional[Sequence[int]] = None,
+               max_new_tokens: int = 2) -> None:
+        """Compile every live replica's prefill bucket + decode step OFF
+        the serving path (each replica engine has its own jit closures —
+        compiles do not share). The silence detector cannot tell a long
+        legitimate step (an XLA compile) from a wedge — that is inherent
+        to the rc-117 contract — so warm the fleet before arming a tight
+        ``heartbeat_timeout``, and keep the timeout above the worst-case
+        legitimate step latency. While a replica warms its ``warming``
+        flag exempts it from the SILENCE verdict (its worker is parked
+        on the replica lock and cannot stamp; declaring the healthy
+        warming replica dead would cause exactly the flap warmup
+        prevents) — thread death is still detected. Restarted replicas
+        are warmed before they rejoin (see ``_restart``) for the same
+        reason."""
+        prompt = list(prompt) if prompt is not None else [1, 2, 3]
+        with self._lock:
+            reps = [r for r in self._replicas if r.state == LIVE]
+        for rep in reps:
+            rep.warming = True
+            try:
+                with rep.lock:
+                    if rep.state != LIVE or rep.engine is None:
+                        continue
+                    rep.engine.submit(prompt, max_new_tokens)
+                    rep.engine.run_until_idle()
+                    if rep.writer is not None:
+                        # fresh ts before the silence clock resumes
+                        rep.writer.write(hb.PHASE_SERVE, rep.engine.steps,
+                                         force=True)
+            finally:
+                rep.warming = False
+
+    def generate_batch(self, prompts: Sequence[Sequence[int]],
+                       max_new_tokens: int = 32, temperature: float = 0.0,
+                       eos_token_id=None,
+                       timeout: float = 120.0) -> List[List[int]]:
+        """Convenience: submit all, drain, return outputs in order."""
+        reqs = [self.submit(p, max_new_tokens, temperature,
+                            eos_token_id=eos_token_id) for p in prompts]
+        if not self.drain(timeout):
+            raise RuntimeError(f"fleet did not drain within {timeout}s")
+        return [r.output_tokens for r in reqs]
+
+    # ---------------------------------------------------------- replica setup
+
+    def _launch(self, rep: _Replica, warm: bool = False) -> None:
+        rep.engine = ServingEngine(self.cfg, self.params, serving=self.scfg,
+                                   interpret=self.interpret)
+        if warm:
+            # a restarted replica must not rejoin until it can actually
+            # serve: its fresh engine's decode compile would otherwise
+            # read as heartbeat silence under a tight timeout and flap
+            # the replica straight back to DOWN
+            try:
+                rep.engine.submit([1, 2, 3], 2)
+                rep.engine.run_until_idle()
+            except Exception:
+                logger.exception("fleet: replica %d warm-up failed",
+                                 rep.idx)
+        # refresh_interval=0: NO background re-stamper — a wedged replica
+        # loop must read as silence (the whole point); the worker itself
+        # is the liveness signal, min_interval paces the writes
+        rep.writer = hb.HeartbeatWriter(
+            self.heartbeat_dir, rank=rep.idx, host=f"replica-{rep.idx}",
+            min_interval=float(self.fcfg.heartbeat_interval),
+            refresh_interval=0.0)
+        rep.started_ts = time.monotonic()
+        # launch stamp: overwrite any previous generation's record (e.g.
+        # the STALLED verdict of the engine this one replaces) so this
+        # generation's silence is measured from ITS OWN record — a
+        # terminal leftover would otherwise exempt a hung restart from
+        # silence detection forever
+        rep.writer.write(hb.PHASE_SERVE, 0, force=True,
+                         extra={"queue": 0, "active": 0,
+                                "lanes": int(self.scfg.max_batch)})
+        rep.thread = threading.Thread(
+            target=self._worker, args=(rep,),
+            name=f"dstpu-fleet-replica-{rep.idx}", daemon=True)
+        rep.thread.start()
+
+    # ------------------------------------------------------------ worker loop
+
+    def _worker(self, rep: _Replica) -> None:
+        """One replica's serve loop: chaos gates, dispatch from the shared
+        queue, one engine step, token sync, heartbeat stamp. ANY escape
+        (chaos ``serve.replica_kill``, a real device failure) is replica
+        death: record the error and fall silent — the supervisor detects,
+        attributes and requeues. A loop wedged inside a step or failpoint
+        (``serve.replica_hang``) is the silence case."""
+        eng = rep.engine
+        try:
+            while not self._stop.is_set() and rep.state == LIVE:
+                chaos.failpoint("serve.replica_hang", key=str(rep.idx))
+                chaos.failpoint("serve.replica_kill", key=str(rep.idx))
+                with rep.lock:
+                    if rep.state != LIVE:
+                        return
+                    self._dispatch(rep)
+                    worked = bool(eng.active or eng.scheduler.pending)
+                # the step runs OUTSIDE rep.lock: a wedge inside XLA must
+                # not hold the lock the supervisor needs to fence this
+                # replica — only the short dispatch/sync sections contend
+                if worked:
+                    eng.step()
+                with rep.lock:
+                    if rep.state != LIVE:
+                        return          # fenced mid-step: the supervisor
+                        #                 requeued our work; emitting now
+                        #                 would double-fire tokens
+                    if worked:
+                        self._sync(rep)
+                    self._stamp(rep)
+                if not worked:
+                    time.sleep(0.005)
+        except BaseException as e:     # noqa: BLE001 — death IS the contract
+            rep.error = repr(e)
+            logger.warning("fleet: replica %d loop died: %s", rep.idx, e)
+            # no terminal stamp: a genuinely killed process could not
+            # stamp either — the record goes silent / the thread dies,
+            # and detection must work from that evidence alone
+
+    def _dispatch(self, rep: _Replica) -> None:
+        """Pull from the shared queue into this replica while it has free
+        lanes and an empty engine queue (keeping the per-engine queue
+        empty is the load-balancing: a request never waits on a busy
+        replica while another has a free lane). Expired requests are shed
+        here with TIMEOUT. Caller holds rep.lock."""
+        eng = rep.engine
+        while (eng.scheduler.pending == 0
+               and eng.active < eng.max_batch):
+            with self._qlock:
+                req = self._queue.popleft() if self._queue else None
+            if req is None:
+                return
+            if req.expired():
+                self._conclude(req, TIMEOUT,
+                               "deadline exceeded while queued")
+                continue
+            if req.done:               # concluded while queued (close etc.)
+                continue
+            # the remaining TTL rides into the engine: a dispatched
+            # request the replica cannot admit yet (block budget) is
+            # still deadline-bounded by the ENGINE's shed — the fleet
+            # queue can no longer see it
+            dl = (max(req.deadline_ts - time.monotonic(), 0.0)
+                  if req.deadline_ts is not None else None)
+            try:
+                er = eng.submit(req.prompt + req.output_tokens,
+                                req.remaining,
+                                temperature=req.temperature,
+                                eos_token_id=req.eos_token_id,
+                                deadline_s=dl)
+            except BaseException:
+                # an exploding enqueue (chaos serve.enqueue, engine-side
+                # validation) kills THIS replica, but the popped request
+                # must go back on the shared queue first — in neither
+                # queue nor inflight it would be lost forever
+                with self._qlock:
+                    self._queue.appendleft(req)
+                raise
+            req.replica, req._synced = rep.idx, 0
+            req.state = RUNNING
+            rep.inflight[req.rid] = (req, er)
+
+    def _sync(self, rep: _Replica) -> None:
+        """Emit newly generated tokens (exactly once — this is the only
+        place fleet ``output_tokens`` grows) and conclude finished engine
+        requests. Caller holds rep.lock; the supervisor flips state to
+        DOWN under the same lock, so emission never races a requeue."""
+        for rid in list(rep.inflight):
+            req, er = rep.inflight[rid]
+            toks = er.output_tokens
+            while req._synced < len(toks):
+                tok = int(toks[req._synced])
+                req._synced += 1
+                req.output_tokens.append(tok)
+                self._bump("tokens_emitted")
+                if req.on_token is not None:
+                    try:
+                        req.on_token(req, tok)
+                    except Exception:
+                        logger.exception("fleet: on_token callback for "
+                                         "request %d raised", req.rid)
+            if er.done:
+                del rep.inflight[rid]
+                if er.state == FAILED:
+                    # deterministic per-request failure (the engine marked
+                    # it before propagating would have killed the replica;
+                    # reaching here means the engine concluded it cleanly)
+                    self._conclude(req, FAILED, er.error)
+                elif er.state == TIMEOUT:
+                    self._conclude(req, TIMEOUT, er.error)
+                else:
+                    self._conclude(req, FINISHED)
+
+    def _stamp(self, rep: _Replica) -> None:
+        if rep.writer is None:
+            return
+        try:
+            eng = rep.engine
+            with self._qlock:
+                qdepth = len(self._queue)
+            rep.writer.write(hb.PHASE_SERVE, eng.steps,
+                             extra={"queue": qdepth, "active": eng.active,
+                                    "lanes": eng.max_batch})
+        except Exception:
+            pass                        # diagnostics must not kill a replica
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        # dict += from N worker threads + the supervisor is a lost-update
+        # race; every counter goes through this one lock
+        with self._stats_lock:
+            self.stats[key] += n
+
+    def _conclude(self, req: FleetRequest, state: str,
+                  error: Optional[str] = None) -> None:
+        if req._finish(state, error):
+            self._bump({FINISHED: "completed", FAILED: "failed",
+                        TIMEOUT: "timeout"}[state])
+        with self._qlock:
+            self._outstanding.pop(req.rid, None)
+
+    # ------------------------------------------------- death handling (called
+    # by FleetSupervisor; the mechanics live here, the detection there)
+
+    def _replica_down(self, rep: _Replica, reason: str,
+                      evidence: Optional[dict]) -> None:
+        """Tear down ONE replica: mark DOWN under its lock (fencing any
+        late token sync — the worker re-checks state under the same lock
+        before emitting, and steps run outside it, so this acquire only
+        ever waits on the short dispatch/sync sections), stamp STALLED
+        evidence, requeue its in-flight requests, then
+        strike/blacklist/restart.
+
+        If the lock cannot be acquired (the worker is wedged INSIDE its
+        critical section — e.g. a blocked user on_token callback), the
+        replica is only FENCED (state -> DOWN; the worker exits at its
+        next state check) and the teardown is parked for the next poll:
+        requeueing while the wedged worker could still wake and emit
+        would double-fire tokens, and exactly-once beats promptness. A
+        section wedged forever defers its requests forever — the same
+        verdict a process-wide wedge earns from the rc-117 stack."""
+        if not rep.lock.acquire(timeout=5.0):
+            rep.state = DOWN
+            with self._qlock:
+                self._pending_down.append((rep, reason, evidence))
+            logger.warning(
+                "fleet: replica %d fenced but wedged inside its critical "
+                "section — teardown deferred", rep.idx)
+            return
+        try:
+            if rep.state == DOWN:
+                pass                    # parked teardown: finish it now
+            elif rep.state != LIVE:
+                return                  # already fully handled
+            rep.state = DOWN
+            inflight = list(rep.inflight.values())
+            rep.inflight.clear()
+        finally:
+            rep.lock.release()
+        rep.strikes += 1
+        self._bump("deaths")
+        if rep.writer is not None:
+            # the verdict, durable: dstpu health shows STALLED for this
+            # replica until a restart generation overwrites the rank file
+            rep.writer.stamp_terminal(hb.PHASE_STALLED, lock_timeout=1.0)
+        death = {"replica": rep.idx, "generation": rep.generation,
+                 "reason": reason, "error": rep.error, "evidence": evidence,
+                 "strikes": rep.strikes, "detected_ts": time.monotonic(),
+                 "action": None, "restarted_ts": None}
+        self.deaths.append(death)
+        logger.warning(
+            "fleet: replica %d DOWN (%s; strike %d): last heartbeat %s",
+            rep.idx, reason, rep.strikes,
+            "none" if evidence is None else
+            f"phase={evidence.get('phase')} step={evidence.get('step')}")
+        # reversed: each requeue appendlefts, so walking newest-first
+        # leaves the earliest-admitted request at the queue HEAD —
+        # FIFO standing preserved across the teardown
+        for req, er in reversed(inflight):
+            self._requeue(req, er)
+        blacklist_after = int(self.fcfg.blacklist_after)
+        if blacklist_after > 0 and rep.strikes >= blacklist_after:
+            rep.state = BLACKLISTED
+            with self._lock:
+                self._replicas[rep.idx] = rep
+            self._bump("blacklisted")
+            death["action"] = "blacklist"
+            logger.warning("fleet: replica %d BLACKLISTED after %d strikes",
+                           rep.idx, rep.strikes)
+            return
+        # the decision is recorded BEFORE the (warm-including, slow)
+        # relaunch: readers draining on survivors must see the verdict
+        # as soon as it is made, not after the replacement compiled
+        death["action"] = "restart"
+        self._restart(rep.idx, rep.generation + 1, rep.strikes)
+        death["restarted_ts"] = time.monotonic()
+
+    def _requeue(self, req: FleetRequest, er) -> None:
+        """Exactly-once requeue: conclude what the dead replica already
+        concluded, finish requests whose budget is spent, retry-budget
+        the rest back onto the queue HEAD (they were admitted first —
+        FIFO standing is preserved). ``serve.requeue`` crashes here park
+        the request on the orphan list for the next supervisor poll."""
+        try:
+            chaos.failpoint("serve.requeue")
+            if er is not None and er.done and er.state in (FAILED, TIMEOUT):
+                self._conclude(req, er.state, er.error)
+                return
+            if (req.remaining <= 0
+                    or (req.eos_token_id is not None and req.output_tokens
+                        and req.output_tokens[-1] == req.eos_token_id)):
+                self._conclude(req, FINISHED)
+                return
+            if req.expired():
+                self._conclude(req, TIMEOUT, "deadline exceeded at requeue")
+                return
+            req.retries += 1
+            if req.retries > int(self.fcfg.retry_budget):
+                self._conclude(
+                    req, FAILED,
+                    f"retry budget exhausted ({self.fcfg.retry_budget} "
+                    f"requeues) after replica failures")
+                return
+            req.replica, req.state, req._synced = None, QUEUED, 0
+            with self._qlock:
+                self._queue.appendleft(req)
+            self._bump("requeues")
+        except chaos.ChaosError as e:
+            logger.warning("fleet: requeue of request %d failed (%s) — "
+                           "orphaned for retry", req.rid, e)
+            with self._qlock:
+                self._orphans.append(req)
+
+    def _retry_orphans(self) -> None:
+        with self._qlock:
+            orphans, self._orphans = self._orphans, []
+        for req in orphans:
+            self._requeue(req, None)
+
+    def _shed_expired(self) -> None:
+        # ONE `now` for both passes: a deadline crossing between the
+        # partitioning comprehensions would otherwise drop a request
+        # from the queue without ever concluding it
+        now = time.monotonic()
+        with self._qlock:
+            expired = [r for r in self._queue if r.expired(now)]
+            if expired:
+                self._queue = deque(r for r in self._queue
+                                    if not r.expired(now))
+        for req in expired:
+            self._conclude(req, TIMEOUT, "deadline exceeded while queued")
+
+    def _restart(self, idx: int, generation: int, strikes: int,
+                 parole: bool = False) -> None:
+        fresh = _Replica(idx, generation=generation, strikes=strikes)
+        with self._lock:
+            self._replicas[idx] = fresh
+        self._bump("restarts")           # counted at initiation: observers
+        if parole:                       # must not wait out the warm-up
+            self._bump("paroles")
+        self._launch(fresh, warm=True)
+        logger.warning("fleet: replica %d %s (generation %d)",
+                       idx, "PAROLED" if parole else "restarted", generation)
+
+    def _maybe_parole(self) -> None:
+        """Capacity floor: with live replicas below ``min_replicas``,
+        parole the least-struck blacklisted replica back (strikes stand —
+        it can be re-blacklisted) rather than serving starved."""
+        with self._lock:
+            live = sum(1 for r in self._replicas if r.state == LIVE)
+            candidates = [r for r in self._replicas
+                          if r.state == BLACKLISTED]
+        if live >= int(self.fcfg.min_replicas) or not candidates:
+            return
+        victim = min(candidates, key=lambda r: (r.strikes, r.idx))
+        self._restart(victim.idx, victim.generation + 1, victim.strikes,
+                      parole=True)
+
+
+class FleetSupervisor:
+    """Consumes the fleet's heartbeat channel and replica thread liveness;
+    detection only — teardown/requeue mechanics live on the fleet.
+
+    DOWN verdicts, in evidence order:
+
+    * a dead worker thread (the in-process analog of a rank exit) — the
+      last heartbeat record is the attribution;
+    * ``heartbeat_timeout`` seconds of record silence from a live thread
+      (rc-117 contract: the record is non-terminal and stale, or the
+      replica never wrote despite ``heartbeat_timeout`` since launch) —
+      the wedge/hang case.
+
+    ``poll()`` is the public deterministic entry (tests call it
+    directly); ``start()`` runs it on a daemon thread every
+    ``poll_interval`` seconds. Each poll also retries orphaned requeues,
+    sheds expired queued requests, and applies the parole floor."""
+
+    def __init__(self, fleet: ServingFleet):
+        self.fleet = fleet
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="dstpu-fleet-supervisor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        interval = max(float(self.fleet.fcfg.poll_interval), 0.01)
+        while not self._stop.wait(interval):
+            try:
+                self.poll()
+            except Exception:
+                logger.exception("fleet supervisor poll failed")
+
+    # ------------------------------------------------------------- detection
+
+    def poll(self) -> List[dict]:
+        """One supervision pass; returns the deaths it declared (a
+        fenced-but-wedged teardown records its death only once its lock
+        frees, possibly on a later poll — the ledger snapshot below
+        captures whichever pass it lands on)."""
+        fleet = self.fleet
+        records = hb.read_heartbeats(fleet.heartbeat_dir)
+        now = time.monotonic()
+        n_deaths = len(fleet.deaths)
+        with fleet._lock:
+            reps = list(fleet._replicas)
+        # finish any fenced-but-wedged teardowns first: their lock may
+        # have freed (worker exited at its DOWN fence) since last poll
+        with fleet._qlock:
+            pending, fleet._pending_down = fleet._pending_down, []
+        for rep, reason, ev in pending:
+            fleet._replica_down(rep, reason, ev)
+        for rep in reps:
+            if rep.state != LIVE:
+                continue
+            evidence = records.get(rep.idx)
+            verdict = self._verdict(rep, evidence, now)
+            if verdict is not None:
+                fleet._replica_down(rep, verdict, evidence)
+        fleet._retry_orphans()
+        fleet._shed_expired()
+        fleet._maybe_parole()
+        return list(fleet.deaths[n_deaths:])
+
+    def _verdict(self, rep: _Replica, evidence: Optional[dict],
+                 now: float) -> Optional[str]:
+        if rep.thread is not None and not rep.thread.is_alive():
+            return "crash"
+        if rep.warming:
+            # warmup() holds the replica lock through an XLA compile;
+            # the parked worker cannot stamp — silence is expected and
+            # healthy here (thread death above still applies)
+            return None
+        timeout = float(self.fleet.fcfg.heartbeat_timeout)
+        if timeout <= 0:
+            return None
+        if evidence is None:
+            # expected-but-never-wrote: launched long enough ago that the
+            # first loop iteration's stamp is overdue (PR-6's
+            # BackendSupervisor expected_ranks case, fleet-side)
+            if now - rep.started_ts > timeout:
+                return "silence"
+            return None
+        if evidence.get("phase") in hb.TERMINAL_PHASES:
+            return None                 # a conclusion, not silence
+        if hb.record_age(evidence) > timeout:
+            return "silence"
+        return None

@@ -13,6 +13,15 @@ lanes. Design contract:
 * **Strict FIFO.** If the head of the queue does not fit, nothing behind
   it is admitted either — a stream of small requests cannot starve a big
   one (fairness under a full pool is a pinned test).
+* **Per-request deadlines (round 11).** Strict FIFO has an unbounded-wait
+  edge: a too-big head makes everything behind it wait for as long as the
+  head waits. A request submitted with ``deadline_s`` (a TTL relative to
+  arrival) is SHED with a ``TIMEOUT`` result once the deadline passes and
+  it is still queued — checked at every admission pass, anywhere in the
+  queue, so backpressure degrades into bounded-latency load shedding
+  instead of silent starvation. A request already admitted (PREFILL /
+  RUNNING) is never shed: its blocks are paid for and killing it would
+  waste the work — deadlines bound *queue wait*, not generation.
 * **In-flight batching.** ``next_admission`` is consulted every loop
   iteration, so new prefills enter as soon as finishing sequences return
   their blocks — no batch drain barrier.
@@ -36,11 +45,40 @@ from ..testing import chaos
 from ..utils.logging import logger
 from .kv_cache import BlockPool, PrefixCache
 
-#: request lifecycle states
-QUEUED, PREFILL, RUNNING, FINISHED, FAILED = (
-    "QUEUED", "PREFILL", "RUNNING", "FINISHED", "FAILED")
+#: request lifecycle states. TIMEOUT (round 11) is a terminal shed: the
+#: request's deadline passed while it was still QUEUED — never applied to
+#: an admitted request.
+QUEUED, PREFILL, RUNNING, FINISHED, FAILED, TIMEOUT = (
+    "QUEUED", "PREFILL", "RUNNING", "FINISHED", "FAILED", "TIMEOUT")
 
 _rid = itertools.count()
+
+
+def check_admissible(prompt_tokens: int, max_new_tokens: int,
+                     block_size: int, num_blocks: int,
+                     max_model_len: Optional[int],
+                     label: str = "request") -> None:
+    """THE admissibility predicate, shared by engine-level
+    ``Scheduler.submit`` and fleet-level ``ServingFleet.submit`` (every
+    replica has the same pool geometry): empty prompts, requests beyond
+    ``max_model_len``, and lifetime block budgets no pool of
+    ``num_blocks`` (one reserved null block) could EVER cover are
+    rejected synchronously — under strict FIFO an inadmissible head
+    would wedge the queue forever while the loop keeps heartbeating."""
+    if prompt_tokens <= 0:
+        raise ValueError("empty prompt")
+    total = prompt_tokens + max_new_tokens
+    if max_model_len is not None and total > max_model_len:
+        raise ValueError(
+            f"{label}: prompt + max_new_tokens = {total} "
+            f"exceeds max_model_len {max_model_len}")
+    life = prompt_tokens + max(max_new_tokens - 1, 0)
+    need = -(-max(life, 0) // block_size)       # BlockPool.blocks_for_tokens
+    allocatable = num_blocks - 1                # null block reserved
+    if need > allocatable:
+        raise ValueError(
+            f"{label}: needs {need} KV blocks, pool has {allocatable} "
+            "total — raise serving.pool_blocks or shrink the request")
 
 
 @dataclass
@@ -53,6 +91,9 @@ class Request:
     top_p: Optional[float] = None
     eos_token_id: Optional[int] = None
     on_finish: Optional[Callable[["Request"], None]] = None
+    #: absolute monotonic deadline; a still-QUEUED request past it is shed
+    #: with TIMEOUT at the next admission pass (None = wait forever)
+    deadline_ts: Optional[float] = None
     rid: int = field(default_factory=lambda: next(_rid))
     # -- filled by the engine -------------------------------------------------
     state: str = QUEUED
@@ -69,7 +110,12 @@ class Request:
 
     @property
     def done(self) -> bool:
-        return self.state in (FINISHED, FAILED)
+        return self.state in (FINISHED, FAILED, TIMEOUT)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline_ts is None:
+            return False
+        return (time.monotonic() if now is None else now) > self.deadline_ts
 
     def _finish(self, state: str = FINISHED,
                 error: Optional[str] = None) -> None:
@@ -101,6 +147,7 @@ class Scheduler:
         self.max_model_len = max_model_len
         self._queue: deque = deque()
         self._lock = threading.Lock()
+        self.timed_out = 0           # requests shed past their deadline
 
     # ------------------------------------------------------------ queue side
 
@@ -109,22 +156,9 @@ class Scheduler:
         caller must know synchronously — a silently dropped request is a
         hung client)."""
         chaos.failpoint("serve.enqueue")
-        total = len(req.prompt) + req.max_new_tokens
-        if not req.prompt:
-            raise ValueError("empty prompt")
-        if self.max_model_len is not None and total > self.max_model_len:
-            raise ValueError(
-                f"request {req.rid}: prompt + max_new_tokens = {total} "
-                f"exceeds max_model_len {self.max_model_len}")
-        # a lifetime budget beyond the WHOLE pool could never be admitted:
-        # under strict FIFO it would wedge the queue forever (and no
-        # watchdog would fire — the loop keeps iterating). Reject now.
-        allocatable = self.pool.num_blocks - 1
-        if self.blocks_needed(req) > allocatable:
-            raise ValueError(
-                f"request {req.rid}: needs {self.blocks_needed(req)} KV "
-                f"blocks, pool has {allocatable} total — raise "
-                "serving.pool_blocks or shrink the request")
+        check_admissible(len(req.prompt), req.max_new_tokens,
+                         self.pool.block_size, self.pool.num_blocks,
+                         self.max_model_len, label=f"request {req.rid}")
         with self._lock:
             if len(self._queue) >= self.max_queue:
                 raise RuntimeError(
@@ -150,11 +184,34 @@ class Scheduler:
         life = len(req.prompt) + max(req.max_new_tokens - 1, 0)
         return self.pool.blocks_for_tokens(life - prefix_tokens)
 
+    def shed_expired(self) -> List[Request]:
+        """Remove every still-queued request whose deadline has passed and
+        conclude each with a TIMEOUT result (callback fires — the caller
+        learns synchronously that the request was shed, not silently
+        dropped). Runs at every admission pass; callbacks fire OUTSIDE the
+        queue lock so an on_finish that resubmits cannot deadlock."""
+        now = time.monotonic()
+        with self._lock:
+            expired = [r for r in self._queue if r.expired(now)]
+            if expired:
+                self._queue = deque(r for r in self._queue
+                                    if not r.expired(now))
+                self.timed_out += len(expired)
+        for req in expired:
+            logger.warning("serving: request %d shed past its deadline "
+                           "after %.2fs queued", req.rid,
+                           now - req.arrival_ts)
+            req._finish(TIMEOUT, error="deadline exceeded while queued")
+        return expired
+
     def next_admission(self) -> Optional[Request]:
         """Pop the head iff its block budget fits (strict FIFO: a head
-        that does not fit blocks everything behind it). Tries prefix-cache
-        eviction before giving up — cached-but-unused blocks must never
-        starve admissions."""
+        that does not fit blocks everything behind it). The caller runs
+        :meth:`shed_expired` once per admission PASS (the engine's
+        ``_admit`` does, even with every lane busy) — not per pop, which
+        would rescan the whole queue for each admitted request. Tries
+        prefix-cache eviction before giving up — cached-but-unused
+        blocks must never starve admissions."""
         with self._lock:
             if not self._queue:
                 return None

@@ -50,6 +50,17 @@ caller, never wedge the loop) and ``serve.oom`` (BlockPool.alloc — an
 injected allocation failure must leave the request QUEUED and the loop
 serving, indistinguishable from a genuinely full pool).
 
+Fleet failpoints (round-11, serving/fleet.py): ``serve.replica_kill``
+and ``serve.replica_hang`` fire at the top of each replica worker
+iteration, KEYED by the replica index (``match=1`` takes out replica 1
+only) — ``raise`` mode is replica death (in-flight requests must
+requeue with exactly-once emission), ``hang`` is the silence case the
+FleetSupervisor detects through the heartbeat channel. ``serve.requeue``
+fires inside the requeue itself: a crash THERE must orphan-and-retry
+the request, never lose it. In-process fleets use ``raise``/``hang``;
+``kill`` mode would exit the whole process and belongs to
+process-per-replica deployments.
+
 Query mode (round-7, the training-integrity sentinel): ``flag`` never
 raises or kills — production code ASKS :func:`flag` whether the site is
 armed and fired, and perturbs its own data when it is (a grad spike

@@ -12,6 +12,11 @@
 # fast-forward, post-rollback reproduction -> rc-118 abort, and the
 # cross-replica SDC bit-flip -> detection + host attribution (single-proc
 # 8-device vote and the REAL 2-process world).
+# Round 11 adds the serving-fleet matrices (tests/test_fleet.py): replica
+# kill mid-decode -> exactly-once requeue with token-exact outputs,
+# replica hang -> heartbeat-silence detection + blacklist/parole,
+# retry-budget exhaustion -> FAILED, requeue-crash -> orphan retry, and
+# serve.oom under the fleet.
 # Includes the `slow`-marked engine-in-child tests tier-1 skips.
 # See docs/RESILIENCE.md for the failpoint catalog and exit-code contract.
 #
@@ -31,6 +36,7 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_heartbeat.py \
     tests/test_multinode_runner.py \
     tests/test_launcher_elastic.py \
+    tests/test_fleet.py \
     "tests/test_multiprocess.py::test_two_process_sharded_save_with_per_rank_failpoint" \
     "tests/test_multiprocess.py::test_two_process_sdc_bitflip_detected_and_attributed" \
     -q -p no:cacheprovider "$@"

@@ -349,9 +349,72 @@ def test_serving_stamps_serve_heartbeat(tmp_path, tiny):
     eng.run_until_idle()
     eng.close()
     with open(heartbeat_path(str(tmp_path), 0), encoding="utf-8") as f:
-        phases = [json.loads(ln)["phase"] for ln in f if ln.strip()]
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    phases = [r["phase"] for r in recs]
     assert PHASE_SERVE in phases         # the loop was supervised
     assert read_heartbeats(str(tmp_path))[0]["phase"] == PHASE_EXIT
+    # SERVE records carry queue/active/lanes load gauges (round 11)
+    serve = [r for r in recs if r["phase"] == PHASE_SERVE]
+    assert all(set(r["gauges"]) == {"queue", "active", "lanes"}
+               for r in serve)
+    assert any(r["gauges"]["active"] > 0 for r in serve)
+
+
+def test_serving_context_manager_stamps_exit_and_health_reads_gauges(
+        tmp_path, tiny, capsys):
+    """Loop exit through the context manager stamps the EXIT terminal
+    heartbeat, and `dstpu health` surfaces the SERVE gauges — a finished
+    serving loop must read as a conclusion, never as silence."""
+    from deepspeed_tpu.launcher.runner import health_main
+    from deepspeed_tpu.runtime.heartbeat import (PHASE_EXIT,
+                                                 HeartbeatWriter,
+                                                 read_heartbeats)
+    cfg, params = tiny
+    hb = HeartbeatWriter(str(tmp_path), rank=0, min_interval=0.0,
+                         refresh_interval=0.0)
+    with ServingEngine(cfg, params, serving=SERVE_CFG, heartbeat=hb) as eng:
+        eng.submit([5, 6, 7], 3)
+        eng.run_until_idle()
+        # still serving inside the block: latest record is SERVE w/ gauges
+        rec = read_heartbeats(str(tmp_path))[0]
+        assert rec["phase"] == "SERVE" and "gauges" in rec
+        assert health_main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "GAUGES" in out and "lanes=4" in out
+    assert read_heartbeats(str(tmp_path))[0]["phase"] == PHASE_EXIT
+    assert health_main([str(tmp_path)]) == 0
+    assert "clean exit" in capsys.readouterr().out
+
+
+def test_scheduler_deadline_sheds_queued_with_timeout(tiny):
+    """Engine-level satellite: a queued request past its deadline is shed
+    with TIMEOUT at the next admission pass instead of waiting forever
+    behind a too-big head (the strict-FIFO unbounded-wait edge); admitted
+    requests are never shed."""
+    import time as _time
+    cfg, params = tiny
+    eng = ServingEngine(cfg, params,
+                        serving={"block_size": 16, "pool_blocks": 4,
+                                 "max_batch": 1, "max_blocks_per_seq": 3,
+                                 "prefix_cache": False})
+    rng = np.random.default_rng(23)
+    shed = []
+    # head takes the lane and nearly the pool; the deadlined follower
+    # can never be admitted behind it and must be shed, not starved
+    head = eng.submit(list(rng.integers(1, 64, size=30)), 16,
+                      deadline_s=30.0)          # admitted -> never shed
+    late = eng.submit(list(rng.integers(1, 64, size=30)), 16,
+                      deadline_s=0.01, on_finish=lambda r: shed.append(r))
+    eng.step()
+    assert head.state in ("PREFILL", "RUNNING")
+    _time.sleep(0.03)
+    eng.step()                                   # admission pass sheds
+    assert late.state == "TIMEOUT" and late.done
+    assert "deadline" in late.error and shed == [late]
+    assert eng.stats["timeout"] == 1
+    eng.run_until_idle()
+    assert head.state == "FINISHED"              # deadline was queue-wait only
+    assert eng.scheduler.timed_out == 1
 
 
 def test_serving_eos_and_temperature_lanes(tiny):
