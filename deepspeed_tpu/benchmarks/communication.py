@@ -223,25 +223,9 @@ def latest_comm_sweep(baseline_dir: str, n_devices: Optional[int] = None
     (``COMMBENCH_r*.json`` reports or ``comm_sweep*.json`` recordings);
     sweeps from a different device count are skipped — their latencies
     aren't comparable."""
-    paths = sorted(glob.glob(os.path.join(baseline_dir,
-                                          "COMMBENCH_r*.json")) +
-                   glob.glob(os.path.join(baseline_dir,
-                                          "comm_sweep*.json")),
-                   key=os.path.getmtime, reverse=True)
-    for path in paths:
-        try:
-            with open(path) as f:
-                doc = json.load(f)
-        except (OSError, ValueError):
-            continue
-        rows = doc.get("rows") if isinstance(doc, dict) else None
-        if not rows:
-            continue
-        if n_devices is not None and doc.get("n") is not None and \
-                int(doc["n"]) != int(n_devices):
-            continue
-        return os.path.basename(path), rows
-    return None, []
+    from .sweeps import latest_recorded_sweep
+    return latest_recorded_sweep(
+        baseline_dir, ("COMMBENCH_r*.json", "comm_sweep*.json"), n_devices)
 
 
 def check_sweep_regression(current: List[Dict], baseline: List[Dict],

@@ -14,23 +14,37 @@ supervised N-replica fleet instead and injects a replica kill mid-run,
 printing a ``poisson_fleet`` row with tokens/s before/during/after the
 loss — the serving tier's resilience number.
 
+Round 12 adds the newest-recorded-sweep regression convention (the
+COMMBENCH / dryrun-timings pattern): ``--record PATH`` writes the
+serving rows as JSON (commit as ``SERVEBENCH_rNN.json``), every
+``--poisson`` run compares its rows against the newest recorded sweep in
+``--baseline-dir`` (same device count), >2x p50 latency or <1/2 the
+recorded tokens/s prints a LOUD regression, and
+``DSTPU_SERVE_BENCH_GATE=1`` makes it fatal. ``--chunk N`` arms chunked
+prefill for the serving rows (mode column records it).
+
     python -m deepspeed_tpu.benchmarks.inference_bench \
         [--preset gpt2-125m] [--batches 1,8] [--seqs 128,1024] [--new 64]
     python -m deepspeed_tpu.benchmarks.inference_bench --poisson \
         [--rates 2,8] [--requests 64] [--prompt 128] [--new 64] \
-        [--fleet 3] [--no-fail-replica]
+        [--fleet 3] [--no-fail-replica] [--chunk 0] [--record PATH]
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+#: >2x recorded p50 (or < recorded tokens/s / 2) = loud regression
+SERVE_REGRESSION_FACTOR = 2.0
 
 
 def _fence(out):
@@ -200,8 +214,10 @@ def run_poisson(preset: str, rate: float, num_requests: int,
     n_chips = jax.device_count()
     gen_tokens = num_requests * new_tokens
     row = {
+        "mode": "poisson",
         "preset": preset, "rate": float(rate), "requests": num_requests,
         "prompt": prompt_len, "new_tokens": new_tokens,
+        "chunk": int((serving or {}).get("prefill_chunk_tokens", 0)),
         "wall_s": round(wall, 3),
         "p50_s": round(float(np.percentile(lat, 50)), 4),
         "p99_s": round(float(np.percentile(lat, 99)), 4),
@@ -317,9 +333,11 @@ def run_poisson_fleet(preset: str, rate: float, num_requests: int,
                  for r, arr in zip(reqs, arrivals) if r.finish_ts)
     n_chips = jax.device_count()
     row = {
+        "mode": "poisson_fleet",
         "preset": preset, "rate": float(rate), "replicas":
             int(fleet_cfg["replicas"]), "requests": num_requests,
         "prompt": prompt_len, "new_tokens": new_tokens,
+        "chunk": int(scfg.get("prefill_chunk_tokens", 0)),
         "wall_s": round(wall, 3),
         "p50_s": round(float(np.percentile(lat, 50)), 4),
         "p99_s": round(float(np.percentile(lat, 99)), 4),
@@ -342,6 +360,60 @@ def run_poisson_fleet(preset: str, rate: float, num_requests: int,
     flt.close()
     print("inference_bench poisson_fleet: " + json.dumps(row))
     return row
+
+
+def record_serve_bench(rows: List[Dict], path: str) -> str:
+    """Write serving-bench rows in the SERVEBENCH report shape (the
+    comm-sweep convention: ``{"n": device_count, "rows": [...]}`` so
+    baselines from a different topology are skipped)."""
+    doc = {"n": jax.device_count(), "rows": rows}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"inference_bench: recorded {len(rows)} serving rows -> {path}")
+    return path
+
+
+def latest_serve_bench(baseline_dir: str, n_devices: Optional[int] = None
+                       ) -> Tuple[Optional[str], List[Dict]]:
+    """(name, rows) of the newest recorded serving sweep in
+    ``baseline_dir`` (``SERVEBENCH_r*.json`` reports or
+    ``serve_bench*.json`` recordings); sweeps from a different device
+    count are skipped — their throughputs aren't comparable."""
+    from .sweeps import latest_recorded_sweep
+    return latest_recorded_sweep(
+        baseline_dir, ("SERVEBENCH_r*.json", "serve_bench*.json"),
+        n_devices)
+
+
+def check_serve_regression(current: List[Dict], baseline: List[Dict],
+                           factor: float = SERVE_REGRESSION_FACTOR
+                           ) -> List[str]:
+    """Rows whose p50 latency exceeds ``factor`` x the recorded one, or
+    whose tokens/s fell below recorded / ``factor`` — keyed by
+    (mode, preset, rate, prompt, new_tokens, replicas, chunk). Missing
+    rows are NOT flagged (a narrower re-run is legitimate)."""
+    def key(r):
+        return (r.get("mode", "poisson"), r.get("preset"),
+                r.get("rate"), r.get("prompt"), r.get("new_tokens"),
+                r.get("replicas"), r.get("chunk", 0))
+
+    base = {key(r): r for r in baseline}
+    problems = []
+    for r in current:
+        b = base.get(key(r))
+        if b is None:
+            continue
+        p50, bp50 = r.get("p50_s"), b.get("p50_s")
+        if p50 and bp50 and float(p50) > factor * float(bp50):
+            problems.append(
+                f"{r.get('mode')}@rate={r.get('rate')}: p50 {p50:.3f}s vs "
+                f"recorded {bp50:.3f}s ({p50 / bp50:.1f}x > {factor:g}x)")
+        tps, btps = r.get("tokens_per_s"), b.get("tokens_per_s")
+        if tps and btps and float(tps) < float(btps) / factor:
+            problems.append(
+                f"{r.get('mode')}@rate={r.get('rate')}: tokens/s {tps:.1f} "
+                f"vs recorded {btps:.1f} (<1/{factor:g})")
+    return problems
 
 
 def run_spatial(size: int, batch: int, channels: int = 64,
@@ -397,6 +469,17 @@ def main(argv=None):
     p.add_argument("--no-fail-replica", action="store_true",
                    help="fleet leg: skip the replica-kill injection "
                         "(steady-state fleet throughput only)")
+    p.add_argument("--chunk", type=int, default=0,
+                   help="serving.prefill_chunk_tokens for the poisson "
+                        "legs (0 = whole prefill)")
+    p.add_argument("--record", default="",
+                   help="write the poisson rows to this JSON path "
+                        "(commit as SERVEBENCH_rNN.json)")
+    p.add_argument("--baseline-dir", default=".",
+                   help="directory searched for the newest recorded "
+                        "serving sweep to compare against (>2x p50 or "
+                        "<1/2 tokens/s = loud regression; "
+                        "DSTPU_SERVE_BENCH_GATE=1 makes it fatal)")
     args = p.parse_args(argv)
     if args.spatial:
         run_spatial(args.latent, int(args.batches.split(",")[0]))
@@ -405,15 +488,33 @@ def main(argv=None):
         run_ragged(args.preset, args.ragged_batch, args.ragged_seq, args.new)
         return
     if args.poisson:
+        serving = ({"prefill_chunk_tokens": args.chunk}
+                   if args.chunk > 0 else None)
+        rows = []
         for rate in (float(x) for x in args.rates.split(",")):
             if args.fleet > 1:
-                run_poisson_fleet(args.preset, rate, args.requests,
-                                  args.prompt, args.new,
-                                  replicas=args.fleet,
-                                  fail_replica=not args.no_fail_replica)
+                rows.append(run_poisson_fleet(
+                    args.preset, rate, args.requests, args.prompt,
+                    args.new, replicas=args.fleet, serving=serving,
+                    fail_replica=not args.no_fail_replica))
             else:
-                run_poisson(args.preset, rate, args.requests, args.prompt,
-                            args.new)
+                rows.append(run_poisson(args.preset, rate, args.requests,
+                                        args.prompt, args.new,
+                                        serving=serving))
+        base_name, baseline = latest_serve_bench(args.baseline_dir,
+                                                 jax.device_count())
+        problems = (check_serve_regression(rows, baseline)
+                    if baseline else [])
+        if problems:
+            msg = (f"SERVING REGRESSION vs {base_name}:\n  "
+                   + "\n  ".join(problems))
+            if os.environ.get("DSTPU_SERVE_BENCH_GATE") == "1":
+                raise SystemExit(msg)
+            print(msg)
+        elif base_name:
+            print(f"inference_bench: no serving regression vs {base_name}")
+        if args.record:
+            record_serve_bench(rows, args.record)
         return
     run(args.preset, [int(x) for x in args.batches.split(",")],
         [int(x) for x in args.seqs.split(",")], args.new)

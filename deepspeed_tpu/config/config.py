@@ -413,6 +413,16 @@ class FleetConfig(DeepSpeedConfigModel):
     directory ``dstpu health`` can read (default: a private tempdir,
     exposed as ``ServingFleet.heartbeat_dir``)."""
     replicas: int = 1                  # 1 = plain single-engine serving
+    # disaggregated serving (round 12, serving/disagg.py): with BOTH > 0
+    # the fleet runs prefill-role and decode-role replicas over ONE
+    # shared paged-KV state, connected by the bounded block-handoff
+    # queue — `replicas` is ignored in favor of the role counts. A dead
+    # prefill replica's half-prefilled request requeues exactly-once
+    # (partial blocks released, chunk progress carried in the death
+    # ledger); a dead decode replica requeues through the token-exact
+    # prompt+emitted path.
+    prefill_replicas: int = 0          # disagg prefill-role replicas
+    decode_replicas: int = 0           # disagg decode-role replicas
     retry_budget: int = 2              # requeues per request before FAILED
     heartbeat_timeout: float = 10.0    # replica record silence -> dead
     heartbeat_interval: float = 0.25   # replica writer min_interval
@@ -444,8 +454,25 @@ class ServingConfig(DeepSpeedConfigModel):
     max_blocks_per_seq: int = 64       # table width; caps prompt+generation
     prefix_cache: bool = True          # reuse shared full-block prefixes
     max_queue: int = 4096              # admission queue bound (backpressure)
-    kv_cache_dtype: Optional[str] = None   # None = model dtype
+    kv_cache_dtype: Optional[str] = None   # None = model dtype; "int8" =
+    #                                    quantized pool (round 12)
     seed: int = 0                      # sampling PRNG seed
+    # chunked prefill (round 12): > 0 advances a prompt's prefill at most
+    # this many tokens per loop iteration, interleaved with decode steps
+    # — a long prompt no longer adds head-of-line latency to running
+    # lanes. 0 = whole prefill per admission (the round-8 behavior).
+    # Token-exact vs whole prefill; compiles one extra prefill bucket at
+    # most per chunk size (the chunk's block-rounded width).
+    prefill_chunk_tokens: int = 0
+    # per-lane top-k / top-p in the COMPILED decode step (round 12):
+    # off by default because the nucleus filter puts a [B, V] sort in
+    # every decode step; when off, submit(top_k=/top_p=) raises as
+    # before. Parity with models.generation._sample is pinned by test.
+    sampling_filters: bool = False
+    # disaggregated serving (round 12): bound on finished-prefill items
+    # waiting in the prefill->decode block-handoff queue (backpressure:
+    # a full queue stalls prefill, never drops an item)
+    handoff_queue: int = 16
     fleet: FleetConfig = Field(default_factory=FleetConfig)
 
 

@@ -274,7 +274,19 @@ class InferenceEngine:
         scfg = serving if serving is not None else self.config.serving
         if isinstance(scfg, dict):
             scfg = ServingConfig(**scfg)
-        if scfg.fleet.replicas > 1:
+        if (scfg.fleet.prefill_replicas > 0) != \
+                (scfg.fleet.decode_replicas > 0):
+            # one-sided disagg must fail HERE, not silently fall through
+            # to single-engine serving (ServingFleet's own guard would
+            # never run)
+            raise ValueError(
+                "serving.fleet: prefill_replicas and decode_replicas "
+                "must both be > 0 for disaggregated serving (got "
+                f"{scfg.fleet.prefill_replicas}/"
+                f"{scfg.fleet.decode_replicas})")
+        disagg = (scfg.fleet.prefill_replicas > 0
+                  and scfg.fleet.decode_replicas > 0)
+        if scfg.fleet.replicas > 1 or disagg:
             from ..serving.fleet import ServingFleet
             from ..utils.logging import logger
             hb_dir = scfg.fleet.heartbeat_dir

@@ -10,18 +10,28 @@
   (one decode-step compile, SERVE heartbeat phase);
 - :mod:`~deepspeed_tpu.serving.fleet` — supervised multi-replica fleet
   (shared admission queue, heartbeat-driven replica death detection,
-  exactly-once request requeue, blacklist/parole, graceful degradation).
+  exactly-once request requeue, blacklist/parole, graceful degradation);
+- :mod:`~deepspeed_tpu.serving.disagg` — disaggregated serving (round
+  12): PrefillEngine/DecodeEngine roles over a bounded paged-KV block
+  handoff, zero-copy via the shared refcounted pool.
 
-Entry points: ``ServingEngine(cfg, params, serving_config)`` directly, or
+Entry points: ``ServingEngine(cfg, params, serving_config)`` directly,
+``DisaggEngine`` for the single-process disagg pair, or
 ``deepspeed_tpu.init_inference(...).serve()`` (which returns a started
-``ServingFleet`` when ``serving.fleet.replicas > 1``).
+``ServingFleet`` when ``serving.fleet.replicas > 1`` or both
+``fleet.prefill_replicas``/``decode_replicas`` are set).
 """
 
-from .engine import ServingEngine
+from .disagg import (BlockHandoff, DecodeEngine, DisaggEngine, HandoffItem,
+                     PrefillEngine)
+from .engine import ServingEngine, lane_topk_topp
 from .fleet import FleetRequest, FleetSupervisor, ServingFleet
-from .kv_cache import BlockPool, BlockPoolExhausted, PrefixCache, init_pool
+from .kv_cache import (BlockPool, BlockPoolExhausted, PrefixCache,
+                       SharedPagedState, init_pool)
 from .scheduler import Request, Scheduler
 
 __all__ = ["ServingEngine", "ServingFleet", "FleetSupervisor",
            "FleetRequest", "BlockPool", "BlockPoolExhausted", "PrefixCache",
-           "init_pool", "Request", "Scheduler"]
+           "SharedPagedState", "init_pool", "Request", "Scheduler",
+           "DisaggEngine", "PrefillEngine", "DecodeEngine", "BlockHandoff",
+           "HandoffItem", "lane_topk_topp"]

@@ -43,13 +43,61 @@ from ..models.transformer import TransformerConfig
 from ..runtime.heartbeat import PHASE_SERVE
 from ..testing import chaos
 from ..utils.logging import log_dist, logger
-from .kv_cache import (NULL_BLOCK, BlockPool, BlockPoolExhausted, PrefixCache,
-                       init_pool)
+from .kv_cache import (NULL_BLOCK, BlockPoolExhausted, SharedPagedState)
 from .model_runner import paged_forward
 from .scheduler import (FAILED, FINISHED, PREFILL, QUEUED, RUNNING, TIMEOUT,
                         Request, Scheduler)
 
 PyTree = Any
+
+_KV_DTYPES = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+              "f32": jnp.float32, "float32": jnp.float32,
+              "int8": jnp.int8, None: None}
+
+
+def resolve_kv_dtype(serving):
+    """``serving.kv_cache_dtype`` -> jnp dtype (None = model dtype);
+    shared by engine construction and the disagg pair's shared-state
+    builder so both roles resolve identically."""
+    if serving.kv_cache_dtype not in _KV_DTYPES:
+        raise ValueError(
+            f"serving.kv_cache_dtype={serving.kv_cache_dtype!r} is not "
+            f"supported; choose one of "
+            f"{sorted(k for k in _KV_DTYPES if k)} or null for the "
+            "model dtype")
+    return _KV_DTYPES[serving.kv_cache_dtype]
+
+
+def lane_topk_topp(logits: jnp.ndarray, top_k: jnp.ndarray,
+                   top_p: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized PER-LANE top-k / top-p filter for the compiled decode
+    step (round 12): ``logits`` [B, V] (already temperature-scaled),
+    ``top_k`` [B] i32 (<= 0 = off), ``top_p`` [B] f32 (>= 1 = off).
+
+    Exactly ``models.generation._sample``'s masking math per lane — kth
+    value keeps ties (every logit >= the kth largest survives), then HF
+    TopPLogitsWarper nucleus semantics on the top-k-masked logits
+    (``apply_top_p``: positional in the sorted order, top token always
+    survives) — so a one-lane filter + categorical at the same key is
+    token-identical to one-shot ``generate()`` sampling (pinned by
+    test).
+
+    ONE ordering pass: both filters read the same descending argsort
+    (top-k masking only demotes a suffix of the sorted view, so the
+    nucleus pass reuses the order), and the result scatters back through
+    it — no second argsort, no inverse argsort."""
+    B, V = logits.shape
+    order = jnp.argsort(-logits, axis=-1)                        # [B, V]
+    sl = jnp.take_along_axis(logits, order, axis=-1)             # desc
+    k = jnp.clip(top_k, 1, V)
+    kth = jnp.take_along_axis(sl, (k - 1)[:, None], axis=-1)     # [B, 1]
+    keep_k = (top_k[:, None] <= 0) | (sl >= kth)
+    probs = jax.nn.softmax(jnp.where(keep_k, sl, -1e30), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_p = ((cum - probs) < top_p[:, None]) | (top_p[:, None] >= 1.0)
+    final_sorted = jnp.where(keep_k & keep_p, sl, -1e30)
+    return jnp.full_like(logits, -1e30).at[
+        jnp.arange(B)[:, None], order].set(final_sorted)
 
 
 @dataclass
@@ -62,6 +110,21 @@ class _Seq:
     last_tok: int                      # sampled, not yet written back
 
 
+@dataclass
+class _Prefilling:
+    """A prompt mid-chunked-prefill (round 12): blocks are fully
+    allocated (admission control is unchanged — lifetime budget up
+    front), ``done`` tokens of K/V are in the pool, and each loop
+    iteration advances at most ``serving.prefill_chunk_tokens`` more —
+    decode steps run in between, so a long prompt never stalls running
+    lanes for more than one chunk."""
+    req: Request
+    blocks: List[int]
+    table: np.ndarray
+    done: int                          # tokens already in the pool
+    total: int                         # == len(req.prompt)
+
+
 class ServingEngine:
     """Continuous-batching server over a paged KV cache (module docstring).
 
@@ -71,13 +134,18 @@ class ServingEngine:
     automatically.
     """
 
+    #: heartbeat-gauge role tag; disagg subclasses override (visible in
+    #: ``dstpu health`` as ``role=PREFILL`` / ``role=DECODE``)
+    role: Optional[str] = None
+
     def __init__(self,
                  cfg: TransformerConfig,
                  params: PyTree,
                  serving=None,
                  heartbeat=None,
                  rng: Optional[jax.Array] = None,
-                 interpret: bool = False):
+                 interpret: bool = False,
+                 shared: Optional[SharedPagedState] = None):
         from ..config.config import ServingConfig
         if serving is None:
             serving = ServingConfig()
@@ -103,23 +171,31 @@ class ServingEngine:
                 "(length-dependent table); use linear/llama3 scaling or "
                 "one-shot generate()")
         self.params = ensure_scan_layout(params, cfg.num_layers)
-        _KV_DTYPES = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
-                      "f32": jnp.float32, "float32": jnp.float32,
-                      None: None}
-        if serving.kv_cache_dtype not in _KV_DTYPES:
-            raise ValueError(
-                f"serving.kv_cache_dtype={serving.kv_cache_dtype!r} is not "
-                f"supported; choose one of {sorted(k for k in _KV_DTYPES if k)} "
-                "or null for the model dtype (the int8 KV tier is a "
-                "one-shot generate() feature)")
-        kv_dtype = _KV_DTYPES[serving.kv_cache_dtype]
-        self.pools = init_pool(cfg, serving.pool_blocks, bs, dtype=kv_dtype)
-        self.pool = BlockPool(serving.pool_blocks, bs)
-        self.prefix_cache = (PrefixCache(self.pool)
-                             if serving.prefix_cache else None)
+        kv_dtype = resolve_kv_dtype(serving)
+        if kv_dtype == jnp.int8 and (jax.default_backend() == "tpu"
+                                     or interpret):
+            # dtype-mismatch guard AT CONSTRUCTION: the Pallas decode
+            # kernel reads the pool's native dtype — it has no int8
+            # dequant tier yet, and discovering that mid-decode would be
+            # a shape error inside the compiled step
+            raise NotImplementedError(
+                "serving.kv_cache_dtype='int8' decodes through the jnp "
+                "gather reference path (dequantize-on-read); the Pallas "
+                "paged-attention kernel does not read int8 pools — run "
+                "on the CPU backend or use bf16/f32 pools on TPU")
+        # the paged-KV state: PRIVATE by default, SHARED when a
+        # disaggregated pair (serving/disagg.py) passes one in — block
+        # IDs then mean the same pool slots to both roles, which is what
+        # makes the prefill->decode handoff zero-copy
+        self._shared = shared if shared is not None else SharedPagedState(
+            cfg, serving, dtype=kv_dtype)
         self.scheduler = Scheduler(self.pool, serving.max_queue,
                                    self.max_model_len, self.prefix_cache)
         self._slots: List[Optional[_Seq]] = [None] * self.max_batch
+        self._prefilling: Optional[_Prefilling] = None
+        self._warming = False      # role warms: no prefix-cache inserts
+        self._chunk = int(serving.prefill_chunk_tokens)
+        self._use_filters = bool(serving.sampling_filters)
         self._rng = rng if rng is not None else jax.random.PRNGKey(
             serving.seed)
         self._heartbeat = heartbeat
@@ -132,32 +208,38 @@ class ServingEngine:
             "prefix_hit_tokens": 0}
 
         # ---- compiled programs (fixed shapes; ONE decode specialization) ----
-        L = cfg.num_layers
+        use_filters = self._use_filters
 
-        def _pick(logits, r, temps):
+        def _pick(logits, r, temps, tks, tps):
             """Per-lane sampling: greedy lanes take argmax, temperature
             lanes a categorical over logits / temp — one compiled program
-            for any mix."""
+            for any mix. With ``serving.sampling_filters`` (a
+            construction-time constant: the program is still compiled
+            once) the vectorized per-lane top-k/top-p filter runs on the
+            scaled logits first."""
             greedy = jnp.argmax(logits, axis=-1)
             scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            if use_filters:
+                scaled = lane_topk_topp(scaled, tks, tps)
             sampled = jax.random.categorical(r, scaled, axis=-1)
             return jnp.where(temps <= 0.0, greedy, sampled)
 
-        def _decode(params, pools, toks, bt, ctx, r, temps):
+        def _decode(params, pools, toks, bt, ctx, r, temps, tks, tps):
             # toks [B] sit at logical position ctx[b]; after the write the
             # valid length is ctx + 1
             logits, pools = paged_forward(
                 cfg, params, toks[:, None], pools, bt, ctx, ctx + 1, bs,
                 interpret=self.interpret)
-            return _pick(logits[:, -1], r, temps), pools
+            return _pick(logits[:, -1], r, temps, tks, tps), pools
 
-        def _prefill(params, pools, ids, bt, q0, ctx, last_idx, r, temps):
+        def _prefill(params, pools, ids, bt, q0, ctx, last_idx, r, temps,
+                     tks, tps):
             logits, pools = paged_forward(
                 cfg, params, ids, pools, bt, q0, ctx, bs,
                 interpret=self.interpret)
             last = jax.lax.dynamic_index_in_dim(logits, last_idx, 1,
                                                 keepdims=False)   # [1, V]
-            return _pick(last, r, temps), pools
+            return _pick(last, r, temps, tks, tps), pools
 
         # pools are donated: the loop's only live copy moves through the
         # step, so the update is in-place on TPU (no 2x pool HBM)
@@ -167,8 +249,28 @@ class ServingEngine:
             f"ServingEngine: pool={serving.pool_blocks}x{bs} tokens "
             f"(~{(serving.pool_blocks - 1) * bs} cacheable), "
             f"max_batch={self.max_batch}, max_model_len="
-            f"{self.max_model_len}, prefix_cache={serving.prefix_cache}",
+            f"{self.max_model_len}, prefix_cache={serving.prefix_cache}, "
+            f"prefill_chunk={self._chunk or 'whole'}",
             ranks=[0])
+
+    # -- the paged-KV state, possibly SHARED with a disagg partner role --
+
+    @property
+    def pool(self):
+        return self._shared.pool
+
+    @property
+    def pools(self):
+        return self._shared.pools
+
+    @property
+    def prefix_cache(self):
+        return self._shared.prefix_cache
+
+    def _run_device(self, fn, *args):
+        """One jitted call over the live pool buffers (donation-safe
+        under the shared state's device lock)."""
+        return self._shared.run(fn, self.params, *args)
 
     # ------------------------------------------------------------- submission
 
@@ -181,15 +283,25 @@ class ServingEngine:
         ``on_finish``) observes. ``deadline_s`` is a queue-wait TTL: a
         request still QUEUED that long after arrival is shed with a
         TIMEOUT result instead of waiting behind a too-big head forever
-        (admitted requests always run to completion)."""
-        if top_k is not None or top_p is not None:
+        (admitted requests always run to completion).
+
+        ``top_k``/``top_p`` (round 12) require
+        ``serving.sampling_filters`` — the vectorized per-lane filter
+        rides the compiled decode step (one program for any mix of
+        filtered/greedy lanes); with the flag off they raise, as the
+        filter would put a [B, V] sort in every decode step."""
+        if (top_k is not None or top_p is not None) \
+                and not self._use_filters:
             raise NotImplementedError(
-                "serving decode supports greedy / temperature sampling "
-                "per-lane; top_k/top_p nucleus filtering is a "
-                "one-shot generate() feature for now")
+                "per-lane top_k/top_p need serving.sampling_filters=true "
+                "(the nucleus filter adds a [B, V] sort to the compiled "
+                "decode step); without it use greedy/temperature or "
+                "one-shot generate()")
         req = Request(prompt=[int(t) for t in prompt],
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature),
+                      top_k=int(top_k) if top_k is not None else None,
+                      top_p=float(top_p) if top_p is not None else None,
                       eos_token_id=eos_token_id, on_finish=on_finish)
         if deadline_s is not None:
             req.deadline_ts = req.arrival_ts + float(deadline_s)
@@ -203,14 +315,63 @@ class ServingEngine:
 
     @property
     def idle(self) -> bool:
-        return self.active == 0 and self.scheduler.pending == 0
+        return (self.active == 0 and self.scheduler.pending == 0
+                and self._prefilling is None)
+
+    @property
+    def has_work(self) -> bool:
+        """Would a :meth:`step` make progress? (fleet worker pacing)."""
+        return bool(self.active or self.scheduler.pending
+                    or self._prefilling is not None)
+
+    @property
+    def wants_dispatch(self) -> bool:
+        """Should the fleet hand this engine another request? Keeping the
+        per-engine queue empty IS the load balancing."""
+        return self.scheduler.pending == 0 and self.active < self.max_batch
+
+    def held_state(self, timeout: float = 1.0):
+        """Death-path collection (disagg fleet): atomically detach and
+        return ``(block_lists, requests)`` for every sequence this engine
+        holds — decode lanes and any in-flight prefill — so a dead
+        replica's share of a SHARED pool can be released once its thread
+        is provably gone (releasing earlier could race the abandoned
+        worker's final in-flight step). Returns None if the engine lock
+        cannot be taken within ``timeout`` (a wedge inside a step): the
+        caller parks and retries."""
+        if not self._lock.acquire(timeout=timeout):
+            return None
+        try:
+            blocks: List[List[int]] = []
+            reqs: List[Request] = []
+            if self._prefilling is not None:
+                blocks.append(self._prefilling.blocks)
+                reqs.append(self._prefilling.req)
+                self._prefilling = None
+            for i, s in enumerate(self._slots):
+                if s is not None:
+                    blocks.append(s.blocks)
+                    reqs.append(s.req)
+                    self._slots[i] = None
+            self._collect_held(blocks, reqs)
+            return blocks, reqs
+        finally:
+            self._lock.release()
+
+    def _collect_held(self, blocks, reqs) -> None:
+        """Subclass hook: detach role-specific block holders (runs under
+        the engine lock inside :meth:`held_state`)."""
 
     def step(self) -> int:
-        """One loop iteration: admit+prefill into free lanes, then one
-        fixed-shape decode step over the active set. Returns the number
-        of requests completed during the iteration."""
+        """One loop iteration: admit (whole prefill, or START a chunked
+        one), advance an in-flight chunked prefill by AT MOST one chunk,
+        then one fixed-shape decode step over the active set — so with
+        ``serving.prefill_chunk_tokens > 0`` running lanes emit a token
+        every iteration even while a long prompt prefills (the fairness
+        bound tests pin). Returns requests completed this iteration."""
         with self._lock:
             done = self._admit()
+            done += self._advance_prefill()
             if self.active:
                 done += self._decode_step()
             self.steps += 1
@@ -291,12 +452,14 @@ class ServingEngine:
         if self._heartbeat is not None:
             try:
                 # queue-depth / active-lane gauges ride the record so
-                # `dstpu health` shows load, not just liveness
-                self._heartbeat.write(
-                    PHASE_SERVE, self.steps,
-                    extra={"queue": self.scheduler.pending,
-                           "active": self.active,
-                           "lanes": self.max_batch})
+                # `dstpu health` shows load, not just liveness; disagg
+                # roles also stamp role=PREFILL/DECODE
+                gauges = {"queue": self.scheduler.pending,
+                          "active": self.active,
+                          "lanes": self.max_batch}
+                if self.role is not None:
+                    gauges["role"] = self.role
+                self._heartbeat.write(PHASE_SERVE, self.steps, extra=gauges)
             except Exception:
                 pass                      # diagnostics must not kill serving
 
@@ -308,14 +471,34 @@ class ServingEngine:
                 return i
         return None
 
+    def _admission_capacity(self) -> bool:
+        """Can a new prefill begin? Base engine: a free decode lane (the
+        finished prefill needs one); disagg roles override."""
+        return self._free_slot() is not None
+
     def _admit(self) -> int:
         """Fill free lanes from the queue head; returns requests that
         FINISHED during admission (max_new_tokens == 1 one-shots).
         Expired queued requests are shed first, even with every lane
         busy — the deadline bounds queue wait precisely when nothing can
-        be admitted."""
+        be admitted. With chunked prefill armed, admission only STARTS a
+        prefill (allocates the lifetime blocks); the chunks themselves
+        run one per loop iteration in :meth:`_advance_prefill`, so at
+        most ONE request is admitted per iteration and decode is never
+        blocked behind a whole long prompt."""
         self.scheduler.shed_expired()
         done = 0
+        if self._chunked_mode():
+            if self._prefilling is None and self._admission_capacity():
+                req = self.scheduler.next_admission()
+                if req is not None:
+                    try:
+                        self._prefilling = self._start_prefill(req)
+                    except (BlockPoolExhausted, chaos.ChaosError) as e:
+                        logger.warning("serving: admission of request %d "
+                                       "deferred (%s)", req.rid, e)
+                        self.scheduler.requeue_front(req)
+            return done
         while self._free_slot() is not None:
             req = self.scheduler.next_admission()
             if req is None:
@@ -330,6 +513,116 @@ class ServingEngine:
                 self.scheduler.requeue_front(req)
                 return done
         return done
+
+    def _chunked_mode(self) -> bool:
+        return self._chunk > 0
+
+    def _start_prefill(self, req: Request) -> _Prefilling:
+        """Allocate a request's LIFETIME blocks (admission control is
+        identical to whole prefill) and stage it for chunked prefill."""
+        P = len(req.prompt)
+        req.state = PREFILL
+        n_pref, forked = (self.prefix_cache.match(req.prompt)
+                          if self.prefix_cache is not None else (0, []))
+        try:
+            total_blocks = self.pool.blocks_for_tokens(
+                P + max(req.max_new_tokens - 1, 0))
+            priv = self.pool.alloc(total_blocks - len(forked))
+        except BaseException:
+            if forked:
+                self.pool.release(forked)
+            req.state = QUEUED
+            raise
+        blocks = list(forked) + priv
+        table = np.full((self.nbk,), NULL_BLOCK, np.int32)
+        table[:len(blocks)] = blocks
+        req.prefix_hit_tokens = n_pref
+        req.prefill_progress = n_pref
+        self.stats["prefix_hit_tokens"] += n_pref
+        return _Prefilling(req, blocks, table, done=n_pref, total=P)
+
+    def _advance_prefill(self) -> int:
+        """Run AT MOST one chunk of the in-flight chunked prefill (the
+        ``serve.chunk`` failpoint fires per chunk). On the final chunk
+        the next token is sampled from the last real position's logits
+        and the sequence is installed — into a decode lane here, into
+        the block handoff for a disagg prefill role."""
+        pf = self._prefilling
+        if pf is None:
+            return 0
+        req = pf.req
+        n = (pf.total - pf.done if self._chunk <= 0
+             else min(self._chunk, pf.total - pf.done))
+        chunk_toks = req.prompt[pf.done:pf.done + n]
+        Tb = -(-n // self.block_size) * self.block_size
+        ids = np.zeros((1, Tb), np.int32)
+        ids[0, :n] = chunk_toks
+        self._rng, r = jax.random.split(self._rng)
+        try:
+            chaos.failpoint("serve.chunk")
+            tok = self._run_device(
+                self._prefill_fn, jnp.asarray(ids),
+                jnp.asarray(pf.table[None]),
+                jnp.asarray([pf.done], jnp.int32),
+                jnp.asarray([pf.done + n], jnp.int32),
+                jnp.asarray(n - 1, jnp.int32), r,
+                jnp.asarray([req.temperature], jnp.float32),
+                *self._filter_args(req))
+        except BaseException as e:
+            # a failed chunk must not leak the lifetime allocation —
+            # release EVERYTHING (partial K/V is recomputed on retry; the
+            # chunk progress survives on req.prefill_progress for the
+            # fleet's death ledger). Chaos/interrupt-class escapes leave
+            # the request QUEUED for a requeue path; a plain Exception is
+            # a deterministic per-request failure
+            self._prefilling = None
+            self.pool.release(pf.blocks)
+            if isinstance(e, Exception) \
+                    and not isinstance(e, chaos.ChaosError):
+                self.stats["failed"] += 1
+                req._finish(FAILED, error=repr(e))
+            else:
+                req.state = QUEUED
+            raise
+        pf.done += n
+        req.prefill_progress = pf.done
+        self.stats["prefill_tokens"] += n
+        if pf.done < pf.total:
+            return 0                      # sampled token of a mid-chunk
+            #                               call is discarded — only the
+            #                               final chunk's is real
+        self._prefilling = None
+        first = int(np.asarray(tok)[0])
+        req.first_token_ts = time.monotonic()
+        req.output_tokens.append(first)
+        self.stats["tokens_generated"] += 1
+        if self.prefix_cache is not None and not self._warming:
+            # a warm's dummy prompt must not fork blocks into the
+            # (possibly SHARED) prefix cache on every launch/restart
+            self.prefix_cache.insert(req.prompt,
+                                     pf.blocks[:pf.total // self.block_size])
+        seq = _Seq(req, pf.blocks, pf.table, pf.total, first)
+        if req.max_new_tokens <= 1 or (req.eos_token_id is not None
+                                       and first == req.eos_token_id):
+            self._finish(seq)
+            return 1
+        self._install(seq)
+        return 0
+
+    def _install(self, seq: _Seq) -> None:
+        """Place a fully-prefilled sequence where decode will find it —
+        a free lane here; the disagg prefill role hands it off instead."""
+        seq.req.state = RUNNING
+        self._slots[self._free_slot()] = seq
+
+    def _filter_args(self, *reqs):
+        """(top_k [n] i32, top_p [n] f32) device args for the compiled
+        sampler (0 / 1.0 = off; always passed so the program shape never
+        depends on the traffic)."""
+        tks = np.asarray([r.top_k or 0 for r in reqs], np.int32)
+        tps = np.asarray([r.top_p if r.top_p is not None else 1.0
+                          for r in reqs], np.float32)
+        return jnp.asarray(tks), jnp.asarray(tps)
 
     def _prefill_request(self, req: Request) -> int:
         P = len(req.prompt)
@@ -349,6 +642,7 @@ class ServingEngine:
         table = np.full((self.nbk,), NULL_BLOCK, np.int32)
         table[:len(blocks)] = blocks
         req.prefix_hit_tokens = n_pref
+        req.prefill_progress = n_pref
         self.stats["prefix_hit_tokens"] += n_pref
 
         # prefill the suffix, bucket-padded to a block multiple so the
@@ -359,12 +653,13 @@ class ServingEngine:
         ids[0, :len(suffix)] = suffix
         self._rng, r = jax.random.split(self._rng)
         try:
-            tok, self.pools = self._prefill_fn(
-                self.params, self.pools, jnp.asarray(ids),
+            tok = self._run_device(
+                self._prefill_fn, jnp.asarray(ids),
                 jnp.asarray(table[None]), jnp.asarray([n_pref], jnp.int32),
                 jnp.asarray([P], jnp.int32),
                 jnp.asarray(len(suffix) - 1, jnp.int32), r,
-                jnp.asarray([req.temperature], jnp.float32))
+                jnp.asarray([req.temperature], jnp.float32),
+                *self._filter_args(req))
         except BaseException as e:
             # a failed forward (device OOM, interrupt) must not leak the
             # refcounted blocks — capacity survives the exception. A
@@ -382,6 +677,7 @@ class ServingEngine:
         first = int(np.asarray(tok)[0])
         req.first_token_ts = time.monotonic()
         req.output_tokens.append(first)
+        req.prefill_progress = P
         self.stats["tokens_generated"] += 1
         self.stats["prefill_tokens"] += len(suffix)
         if self.prefix_cache is not None:
@@ -401,6 +697,8 @@ class ServingEngine:
         toks = np.zeros((B,), np.int32)
         ctx = np.zeros((B,), np.int32)
         temps = np.zeros((B,), np.float32)
+        tks = np.zeros((B,), np.int32)
+        tps = np.ones((B,), np.float32)
         tables = np.full((B, self.nbk), NULL_BLOCK, np.int32)
         for i, s in enumerate(self._slots):
             if s is None:
@@ -408,11 +706,14 @@ class ServingEngine:
             toks[i] = s.last_tok
             ctx[i] = s.ctx
             temps[i] = s.req.temperature
+            tks[i] = s.req.top_k or 0
+            tps[i] = s.req.top_p if s.req.top_p is not None else 1.0
             tables[i] = s.table
         self._rng, r = jax.random.split(self._rng)
-        nxt, self.pools = self._decode_fn(
-            self.params, self.pools, jnp.asarray(toks), jnp.asarray(tables),
-            jnp.asarray(ctx), r, jnp.asarray(temps))
+        nxt = self._run_device(
+            self._decode_fn, jnp.asarray(toks), jnp.asarray(tables),
+            jnp.asarray(ctx), r, jnp.asarray(temps), jnp.asarray(tks),
+            jnp.asarray(tps))
         nxt = np.asarray(nxt)
         done = 0
         for i, s in enumerate(self._slots):

@@ -81,7 +81,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from ..runtime import heartbeat as hb
 from ..testing import chaos
 from ..utils.logging import log_dist, logger
-from .engine import ServingEngine
+from .engine import ServingEngine, resolve_kv_dtype
+from .kv_cache import SharedPagedState
 from .scheduler import (FAILED, FINISHED, QUEUED, RUNNING, TIMEOUT,
                         check_admissible)
 
@@ -112,6 +113,9 @@ class FleetRequest:
     output_tokens: List[int] = field(default_factory=list)
     retries: int = 0
     replica: Optional[int] = None      # current / last assignment
+    #: disagg: prompt tokens the last (possibly dead) prefill leg got
+    #: into the pool — requeue carries it for the death ledger
+    prefill_progress: int = 0
     error: Optional[str] = None
     arrival_ts: float = field(default_factory=time.monotonic)
     finish_ts: Optional[float] = None
@@ -171,6 +175,10 @@ class _Replica:
         self.writer: Optional[hb.HeartbeatWriter] = None
         self.lock = threading.Lock()   # worker step/sync vs supervisor down
         self.inflight: Dict[int, Any] = {}   # rid -> (FleetRequest, eng req)
+        #: disagg decode role: a handoff item popped but not yet
+        #: installed (the serve.handoff_drop death window) — its blocks
+        #: ride the quarantine if the replica dies here
+        self.holding: Optional[Any] = None
         self.error: Optional[str] = None
         self.started_ts = time.monotonic()
 
@@ -198,7 +206,45 @@ class ServingFleet:
         self.scfg = serving
         self.fcfg = serving.fleet
         self.interpret = interpret
-        self.n_replicas = max(1, int(self.fcfg.replicas))
+        # disaggregated roles (round 12, serving/disagg.py): prefill
+        # replicas fill paged blocks and hand them — zero-copy, over ONE
+        # shared pool — to decode replicas through the bounded handoff
+        self.n_prefill = int(self.fcfg.prefill_replicas)
+        self.n_decode = int(self.fcfg.decode_replicas)
+        if (self.n_prefill > 0) != (self.n_decode > 0):
+            raise ValueError(
+                "serving.fleet: prefill_replicas and decode_replicas "
+                "must both be > 0 for disaggregated serving (got "
+                f"{self.n_prefill}/{self.n_decode})")
+        self.disagg = self.n_prefill > 0
+        if self.disagg:
+            from .disagg import BlockHandoff
+            self.n_replicas = self.n_prefill + self.n_decode
+            self._shared = SharedPagedState(
+                cfg, serving, dtype=resolve_kv_dtype(serving))
+            self._handoff = BlockHandoff(
+                self._shared.pool, capacity=int(serving.handoff_queue),
+                on_push=self._register_handoff)
+            #: engine-request rid -> FleetRequest, recorded at dispatch so
+            #: the push-time registration hook (which runs on the prefill
+            #: worker thread, without its replica lock) needs no replica
+            #: state — guarded by _qlock
+            self._er2freq: Dict[int, FleetRequest] = {}
+            #: engine-request rid -> (freq, er) for items in (or through)
+            #: the handoff queue: registered atomically at push, consumed
+            #: at decode dispatch / deadline shed — the exactly-once
+            #: ledger across the role boundary (guarded by _qlock)
+            self._handoff_inflight: Dict[int, tuple] = {}
+            #: (replica, block-lists) of dead disagg replicas, released
+            #: into the SHARED pool only once the replica thread is
+            #: provably gone (its abandoned final step may still write
+            #: through its old tables; releasing earlier could hand those
+            #: blocks to a new owner mid-scribble)
+            self._quarantine: List[tuple] = []
+        else:
+            self.n_replicas = max(1, int(self.fcfg.replicas))
+            self._shared = None
+            self._handoff = None
         self.heartbeat_dir = (heartbeat_dir or self.fcfg.heartbeat_dir
                               or tempfile.mkdtemp(prefix="dstpu-fleet-hb-"))
         self._queue: deque = deque()             # guarded by _qlock
@@ -261,6 +307,11 @@ class ServingFleet:
                 t.join(max(0.0, deadline - time.monotonic()))
             if rep.writer is not None:
                 rep.writer.stamp_terminal(hb.PHASE_EXIT, lock_timeout=1.0)
+        if self.disagg:
+            # items still crossing the role boundary return their blocks
+            # (their requests are left un-concluded, same as the queue)
+            self._handoff.drain_release()
+            self._drain_quarantine()
 
     def __enter__(self) -> "ServingFleet":
         return self.start()
@@ -363,8 +414,18 @@ class ServingFleet:
                 with rep.lock:
                     if rep.state != LIVE or rep.engine is None:
                         continue
-                    rep.engine.submit(prompt, max_new_tokens)
-                    rep.engine.run_until_idle()
+                    if self.disagg:
+                        # role engines compile off-path without touching
+                        # the real handoff (a warm item crossing roles
+                        # would never conclude — it has no FleetRequest)
+                        rep.engine.warm()
+                    else:
+                        # twice — zeros-pools AND donated-pools
+                        # specializations (see _launch): the second
+                        # compile must not land mid-serving
+                        for _ in range(2):
+                            rep.engine.submit(prompt, max_new_tokens)
+                            rep.engine.run_until_idle()
                     if rep.writer is not None:
                         # fresh ts before the silence clock resumes
                         rep.writer.write(hb.PHASE_SERVE, rep.engine.steps,
@@ -385,17 +446,48 @@ class ServingFleet:
 
     # ---------------------------------------------------------- replica setup
 
+    def _role(self, idx: int) -> Optional[str]:
+        if not self.disagg:
+            return None
+        return "PREFILL" if idx < self.n_prefill else "DECODE"
+
     def _launch(self, rep: _Replica, warm: bool = False) -> None:
-        rep.engine = ServingEngine(self.cfg, self.params, serving=self.scfg,
-                                   interpret=self.interpret)
+        if self.disagg:
+            from .disagg import DecodeEngine, PrefillEngine
+            if rep.idx < self.n_prefill:
+                rep.engine = PrefillEngine(
+                    self.cfg, self.params, serving=self.scfg,
+                    shared=self._shared, handoff=self._handoff,
+                    interpret=self.interpret)
+            else:
+                rep.engine = DecodeEngine(
+                    self.cfg, self.params, serving=self.scfg,
+                    shared=self._shared, handoff=self._handoff,
+                    auto_pull=False, interpret=self.interpret)
+        else:
+            rep.engine = ServingEngine(self.cfg, self.params,
+                                       serving=self.scfg,
+                                       interpret=self.interpret)
         if warm:
             # a restarted replica must not rejoin until it can actually
             # serve: its fresh engine's decode compile would otherwise
             # read as heartbeat silence under a tight timeout and flap
             # the replica straight back to DOWN
             try:
-                rep.engine.submit([1, 2, 3], 2)
-                rep.engine.run_until_idle()
+                if self.disagg:
+                    rep.engine.warm()
+                else:
+                    # TWICE: the first pass compiles against the fresh
+                    # zero-initialized pools, the second against the
+                    # DONATED committed pools every steady-state call
+                    # uses — under some device contexts (e.g. a global
+                    # mesh left by training code in-process) the two
+                    # specialize separately, and the second compile must
+                    # not land mid-serving where a tight
+                    # heartbeat_timeout reads it as a wedge
+                    for _ in range(2):
+                        rep.engine.submit([1, 2, 3], 2)
+                        rep.engine.run_until_idle()
             except Exception:
                 logger.exception("fleet: replica %d warm-up failed",
                                  rep.idx)
@@ -412,9 +504,11 @@ class ServingFleet:
         # generation's silence is measured from ITS OWN record — a
         # terminal leftover would otherwise exempt a hung restart from
         # silence detection forever
-        rep.writer.write(hb.PHASE_SERVE, 0, force=True,
-                         extra={"queue": 0, "active": 0,
-                                "lanes": int(self.scfg.max_batch)})
+        launch_gauges = {"queue": 0, "active": 0,
+                         "lanes": int(self.scfg.max_batch)}
+        if rep.engine.role is not None:
+            launch_gauges["role"] = rep.engine.role
+        rep.writer.write(hb.PHASE_SERVE, 0, force=True, extra=launch_gauges)
         rep.thread = threading.Thread(
             target=self._worker, args=(rep,),
             name=f"dstpu-fleet-replica-{rep.idx}", daemon=True)
@@ -430,6 +524,7 @@ class ServingFleet:
         attributes and requeues. A loop wedged inside a step or failpoint
         (``serve.replica_hang``) is the silence case."""
         eng = rep.engine
+        decode_role = self.disagg and rep.idx >= self.n_prefill
         try:
             while not self._stop.is_set() and rep.state == LIVE:
                 chaos.failpoint("serve.replica_hang", key=str(rep.idx))
@@ -437,8 +532,11 @@ class ServingFleet:
                 with rep.lock:
                     if rep.state != LIVE:
                         return
-                    self._dispatch(rep)
-                    worked = bool(eng.active or eng.scheduler.pending)
+                    if decode_role:
+                        self._dispatch_decode(rep)
+                    else:
+                        self._dispatch(rep)
+                    worked = eng.has_work
                 # the step runs OUTSIDE rep.lock: a wedge inside XLA must
                 # not hold the lock the supervisor needs to fence this
                 # replica — only the short dispatch/sync sections contend
@@ -448,8 +546,19 @@ class ServingFleet:
                     if rep.state != LIVE:
                         return          # fenced mid-step: the supervisor
                         #                 requeued our work; emitting now
-                        #                 would double-fire tokens
+                        #                 would double-fire tokens (a
+                        #                 handoff pushed during the fenced
+                        #                 step survives — its registration
+                        #                 makes the teardown requeue skip
+                        #                 it, and a decode replica serves
+                        #                 the item exactly once)
                     if worked:
+                        if self.disagg and not decode_role:
+                            # drop handed-off requests from THIS replica's
+                            # ledger BEFORE syncing: their tokens (the
+                            # first token included) are emitted by the
+                            # decode side only — one emitter per request
+                            self._collect_handoffs(rep)
                         self._sync(rep)
                     self._stamp(rep)
                 if not worked:
@@ -466,10 +575,11 @@ class ServingFleet:
         lanes and an empty engine queue (keeping the per-engine queue
         empty is the load-balancing: a request never waits on a busy
         replica while another has a free lane). Expired requests are shed
-        here with TIMEOUT. Caller holds rep.lock."""
+        here with TIMEOUT. Caller holds rep.lock. (Disagg: prefill-role
+        replicas dispatch one request at a time — ``wants_dispatch`` —
+        and decode-role replicas never dispatch from here at all.)"""
         eng = rep.engine
-        while (eng.scheduler.pending == 0
-               and eng.active < eng.max_batch):
+        while eng.wants_dispatch:
             with self._qlock:
                 req = self._queue.popleft() if self._queue else None
             if req is None:
@@ -503,6 +613,125 @@ class ServingFleet:
             req.replica, req._synced = rep.idx, 0
             req.state = RUNNING
             rep.inflight[req.rid] = (req, er)
+            if self.disagg:
+                # push-time registration (on the prefill worker thread,
+                # inside the engine step, WITHOUT rep.lock) resolves the
+                # fleet request through this map instead of touching
+                # replica state
+                with self._qlock:
+                    self._er2freq[er.rid] = req
+
+    # ---------------------------------------------------- disagg role plumbing
+
+    def _register_handoff(self, item) -> None:
+        """BlockHandoff.on_push hook (runs under the handoff lock, on the
+        pushing prefill worker's thread): record the item in the
+        cross-role exactly-once ledger ATOMICALLY with the enqueue, so a
+        decode replica can never pop an unregistered item, and a teardown
+        requeue can never double-serve a pushed one."""
+        er = item.req
+        with self._qlock:
+            freq = self._er2freq.pop(er.rid, None)
+            if freq is not None:
+                self._handoff_inflight[er.rid] = (freq, er)
+
+    def _collect_handoffs(self, rep: _Replica) -> None:
+        """Prefill worker post-step: requests pushed to the handoff this
+        step leave THIS replica's inflight ledger UNCONDITIONALLY — the
+        push itself moved ownership (registration is atomic with the
+        enqueue), and a fast decode replica may have ALREADY popped the
+        item and consumed the registration; keying the removal on the
+        registration's presence would leave the request in BOTH
+        replicas' ledgers with two workers racing the same ``_synced``
+        cursor. Caller holds rep.lock."""
+        for er in rep.engine.take_handed_off():
+            for frid, (_freq, er2) in list(rep.inflight.items()):
+                if er2 is er:
+                    rep.inflight.pop(frid)
+                    break
+
+    def _dispatch_decode(self, rep: _Replica) -> None:
+        """Decode worker: shed expired handoff items, then pop items into
+        free lanes. The ``serve.handoff_drop`` failpoint fires between
+        pop and install — a crash there is a decode-replica death with a
+        popped item in hand: the request is already on rep.inflight (the
+        death path requeues it through the token-exact prompt+emitted
+        path) and the item's blocks ride ``rep.holding`` into the shared-
+        pool quarantine. Caller holds rep.lock."""
+        self._shed_handoff()
+        eng = rep.engine
+        while eng.lanes_free:
+            item = self._handoff.pop()
+            if item is None:
+                return
+            with self._qlock:
+                pair = self._handoff_inflight.pop(item.req.rid, None)
+                if pair is not None:
+                    # takeover is ATOMIC with the pop: a prefill-replica
+                    # teardown deciding whether to requeue this request
+                    # reads (registration, owner) under the same lock, so
+                    # it either sees the registration (skip) or sees this
+                    # replica as owner (skip) — never a gap that would
+                    # requeue a request a live decode replica is serving
+                    pair[0].replica = rep.idx
+            if pair is None or pair[0].done:
+                # no live fleet request behind the item (concluded while
+                # queued, or a close() edge): release and drop — blocks
+                # must never leak the shared pool's accounting
+                self._shared.pool.release(item.blocks)
+                continue
+            freq, er = pair
+            rep.inflight[freq.rid] = (freq, er)
+            rep.holding = item
+            chaos.failpoint("serve.handoff_drop")
+            rep.engine.install_item(item)
+            rep.holding = None
+
+    def _shed_handoff(self) -> None:
+        """Deadline-aware handoff: conclude fleet requests whose items
+        expired in the queue (runs at decode dispatch AND on the
+        supervisor cadence — the latter covers a fleet with every decode
+        replica down)."""
+        for item in self._handoff.shed_expired():
+            with self._qlock:
+                pair = self._handoff_inflight.pop(item.req.rid, None)
+            if pair is not None:
+                self._conclude(pair[0], TIMEOUT,
+                               "deadline exceeded in handoff queue")
+
+    def _drain_quarantine(self) -> None:
+        """Release dead disagg replicas' blocks into the SHARED pool once
+        their worker threads are provably gone (supervisor cadence). A
+        still-wedged engine (held_state timed out at teardown) is
+        re-probed each pass; one wedged forever leaks its blocks — the
+        same verdict the per-replica-pool design gives an abandoned
+        worker, and the price of zero-copy sharing."""
+        with self._qlock:
+            pending, self._quarantine = self._quarantine, []
+        keep = []
+        for rep, blocks in pending:
+            if blocks is None:
+                hs = (rep.engine.held_state(timeout=0.2)
+                      if rep.engine is not None else ([], []))
+                if hs is None:
+                    keep.append((rep, None))
+                    continue
+                blocks = list(hs[0])
+                if rep.holding is not None:
+                    blocks.append(rep.holding.blocks)
+                    rep.holding = None
+            if rep.thread is not None and rep.thread.is_alive():
+                keep.append((rep, blocks))
+                continue
+            for bl in blocks:
+                try:
+                    self._shared.pool.release(bl)
+                except ValueError:
+                    logger.exception(
+                        "fleet: quarantine release of replica %d blocks "
+                        "found inconsistent refcounts", rep.idx)
+        with self._qlock:
+            self._quarantine.extend(keep)
 
     def _sync(self, rep: _Replica) -> None:
         """Emit newly generated tokens (exactly once — this is the only
@@ -525,6 +754,9 @@ class ServingFleet:
                                          "request %d raised", req.rid)
             if er.done:
                 del rep.inflight[rid]
+                if self.disagg:
+                    with self._qlock:
+                        self._er2freq.pop(er.rid, None)
                 if er.state == FAILED:
                     # deterministic per-request failure (the engine marked
                     # it before propagating would have killed the replica;
@@ -542,9 +774,14 @@ class ServingFleet:
             eng = rep.engine
             with self._qlock:
                 qdepth = len(self._queue)
-            rep.writer.write(hb.PHASE_SERVE, eng.steps,
-                             extra={"queue": qdepth, "active": eng.active,
-                                    "lanes": eng.max_batch})
+            gauges = {"queue": qdepth, "active": eng.active,
+                      "lanes": eng.max_batch}
+            if eng.role is not None:
+                # PREFILL / DECODE visible in `dstpu health` (round 12)
+                gauges["role"] = eng.role
+                if self.disagg:
+                    gauges["handoff"] = self._handoff.pending
+            rep.writer.write(hb.PHASE_SERVE, eng.steps, extra=gauges)
         except Exception:
             pass                        # diagnostics must not kill a replica
 
@@ -598,6 +835,22 @@ class ServingFleet:
             rep.state = DOWN
             inflight = list(rep.inflight.values())
             rep.inflight.clear()
+            if self.disagg:
+                # the dead replica's share of the SHARED pool (decode
+                # lanes / half-prefilled chunks / a popped-but-
+                # uninstalled item) is detached NOW — under the replica
+                # lock, so the worker can't be mid-dispatch — and
+                # released only once the thread is provably dead (the
+                # abandoned final step may still write through its old
+                # tables): _drain_quarantine on the supervisor cadence
+                hs = (rep.engine.held_state(timeout=1.0)
+                      if rep.engine is not None else ([], []))
+                q_blocks = None if hs is None else list(hs[0])
+                if q_blocks is not None and rep.holding is not None:
+                    q_blocks.append(rep.holding.blocks)
+                    rep.holding = None
+                with self._qlock:
+                    self._quarantine.append((rep, q_blocks))
         finally:
             rep.lock.release()
         rep.strikes += 1
@@ -620,7 +873,7 @@ class ServingFleet:
         # leaves the earliest-admitted request at the queue HEAD —
         # FIFO standing preserved across the teardown
         for req, er in reversed(inflight):
-            self._requeue(req, er)
+            self._requeue(req, er, from_idx=rep.idx)
         blacklist_after = int(self.fcfg.blacklist_after)
         if blacklist_after > 0 and rep.strikes >= blacklist_after:
             rep.state = BLACKLISTED
@@ -638,14 +891,39 @@ class ServingFleet:
         self._restart(rep.idx, rep.generation + 1, rep.strikes)
         death["restarted_ts"] = time.monotonic()
 
-    def _requeue(self, req: FleetRequest, er) -> None:
+    def _requeue(self, req: FleetRequest, er,
+                 from_idx: Optional[int] = None) -> None:
         """Exactly-once requeue: conclude what the dead replica already
         concluded, finish requests whose budget is spent, retry-budget
         the rest back onto the queue HEAD (they were admitted first —
-        FIFO standing is preserved). ``serve.requeue`` crashes here park
-        the request on the orphan list for the next supervisor poll."""
+        FIFO standing is preserved). ``from_idx`` names the dying
+        replica (None for orphan retries): a disagg request whose owner
+        moved past it — pushed into the handoff, or already popped by a
+        decode replica — is NOT requeued. ``serve.requeue`` crashes here
+        park the request on the orphan list for the next supervisor
+        poll."""
         try:
             chaos.failpoint("serve.requeue")
+            if self.disagg and er is not None:
+                with self._qlock:
+                    self._er2freq.pop(er.rid, None)
+                    handed = er.rid in self._handoff_inflight
+                    taken_over = (from_idx is not None
+                                  and req.replica is not None
+                                  and req.replica != from_idx)
+                if handed or taken_over:
+                    # the dying prefill replica's push DID land (fenced
+                    # mid-step): either the item still sits registered in
+                    # the handoff queue, or a decode replica already
+                    # popped it and took ownership (assignment atomic
+                    # with the pop under _qlock) — it will be served
+                    # exactly once there; requeueing the request too
+                    # would serve it twice
+                    return
+                if er.prefill_progress:
+                    # chunk progress carried: how far the dead leg's
+                    # prefill got, for the death ledger / observability
+                    req.prefill_progress = int(er.prefill_progress)
             if er is not None and er.done and er.state in (FAILED, TIMEOUT):
                 self._conclude(req, er.state, er.error)
                 return
@@ -792,6 +1070,12 @@ class FleetSupervisor:
                 fleet._replica_down(rep, verdict, evidence)
         fleet._retry_orphans()
         fleet._shed_expired()
+        if fleet.disagg:
+            # handoff deadlines must hold even with every decode replica
+            # down, and dead replicas' shared-pool blocks release once
+            # their threads are provably gone
+            fleet._shed_handoff()
+            fleet._drain_quarantine()
         fleet._maybe_parole()
         return list(fleet.deaths[n_deaths:])
 

@@ -32,7 +32,8 @@ padded/inactive lanes in the fixed-shape decode step, and never read
 from __future__ import annotations
 
 import hashlib
-from typing import Dict, List, Optional, Sequence, Tuple
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
@@ -54,10 +55,21 @@ def init_pool(cfg, num_blocks: int, block_size: int,
     Flat slot layout (slot = block * block_size + offset) so the decode
     step's K/V write is ONE scatter over the slot axis; the paged-attention
     kernel views the same buffer as ``[L, nh, num_blocks, block_size, hd]``
-    (a free reshape) to DMA whole blocks through the block table."""
+    (a free reshape) to DMA whole blocks through the block table.
+
+    ``dtype=jnp.int8`` (round 12): the quantized pool tier — k/v store
+    int8 with a per-(layer, head, slot) f32 scale (symmetric over the
+    head dim, the dense generate() cache's ``_kv_quantize`` format),
+    halving pool HBM vs bf16. The paged forward quantizes on write and
+    dequantizes on read (see serving/model_runner.py)."""
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, cfg.num_heads, num_blocks * block_size,
              cfg.head_dim)
+    if dtype == jnp.int8:
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+                "v_scale": jnp.zeros(shape[:-1] + (1,), jnp.float32)}
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -65,7 +77,14 @@ class BlockPool:
     """Host-side block allocator with refcounts (see module docstring).
 
     ``num_blocks`` COUNTS the reserved null block: a pool of N blocks has
-    N - 1 allocatable."""
+    N - 1 allocatable.
+
+    Thread-safe (round 12): disaggregated serving shares ONE pool between
+    prefill-role and decode-role replicas on different threads, so
+    alloc/fork/release are atomic under an internal lock. ``free_count``
+    probes stay optimistic — a racing allocation after a passing probe
+    surfaces as :class:`BlockPoolExhausted`, which every admission path
+    already treats as keep-queued."""
 
     def __init__(self, num_blocks: int, block_size: int):
         if num_blocks < 2:
@@ -75,6 +94,7 @@ class BlockPool:
         self.block_size = int(block_size)
         self._free: List[int] = list(range(num_blocks - 1, NULL_BLOCK, -1))
         self._refs: Dict[int, int] = {}
+        self._mu = threading.Lock()
 
     @property
     def free_count(self) -> int:
@@ -93,37 +113,42 @@ class BlockPool:
         the ``serve.oom`` failpoint can force that path (chaos tests pin
         queued-not-crashed)."""
         chaos.failpoint("serve.oom")
-        if n > len(self._free):
-            raise BlockPoolExhausted(
-                f"need {n} blocks, {len(self._free)} free "
-                f"(pool {self.num_blocks - 1} x {self.block_size} tokens)")
-        out = [self._free.pop() for _ in range(n)]
-        for b in out:
-            self._refs[b] = 1
-        return out
+        with self._mu:
+            if n > len(self._free):
+                raise BlockPoolExhausted(
+                    f"need {n} blocks, {len(self._free)} free "
+                    f"(pool {self.num_blocks - 1} x {self.block_size} "
+                    "tokens)")
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._refs[b] = 1
+            return out
 
     def fork(self, blocks: Sequence[int]) -> List[int]:
         """Share ``blocks`` with another holder: +1 refcount each. The
         caller must treat them as READ-ONLY (full-block prefix sharing
         guarantees it never writes below its fork point)."""
-        for b in blocks:
-            if b == NULL_BLOCK or b not in self._refs:
-                raise ValueError(f"fork of unallocated block {b}")
-            self._refs[b] += 1
-        return list(blocks)
+        with self._mu:
+            for b in blocks:
+                if b == NULL_BLOCK or b not in self._refs:
+                    raise ValueError(f"fork of unallocated block {b}")
+            for b in blocks:
+                self._refs[b] += 1
+            return list(blocks)
 
     def release(self, blocks: Sequence[int]) -> None:
         """Drop one reference per block; a block returns to the free list
         when its last holder releases it."""
-        for b in blocks:
-            refs = self._refs.get(b)
-            if refs is None:
-                raise ValueError(f"release of unallocated block {b}")
-            if refs > 1:
-                self._refs[b] = refs - 1
-            else:
-                del self._refs[b]
-                self._free.append(b)
+        with self._mu:
+            for b in blocks:
+                refs = self._refs.get(b)
+                if refs is None:
+                    raise ValueError(f"release of unallocated block {b}")
+                if refs > 1:
+                    self._refs[b] = refs - 1
+                else:
+                    del self._refs[b]
+                    self._free.append(b)
 
     def refcount(self, block: int) -> int:
         return self._refs.get(block, 0)
@@ -160,6 +185,9 @@ class PrefixCache:
         self._entries: Dict[str, Tuple[Tuple[int, ...], int, List[int],
                                        int]] = {}
         self._clock = 0
+        # round 12: multiple prefill-role replicas share one cache —
+        # match/insert/evict are atomic (RLock: clear() calls evict())
+        self._mu = threading.RLock()
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -189,8 +217,9 @@ class PrefixCache:
         taking a reference — the scheduler uses it to net the hit out of
         the block budget and to protect the entry from its own
         make-room eviction."""
-        n, key, _ = self._lookup(tokens)
-        return n, key
+        with self._mu:
+            n, key, _ = self._lookup(tokens)
+            return n, key
 
     def match(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
         """Longest cached FULL-BLOCK prefix of ``tokens``, capped at
@@ -198,13 +227,14 @@ class PrefixCache:
         token to prefill (the last prompt token's logits seed sampling).
         Returns ``(n_cached_tokens, forked_blocks)`` — the blocks already
         carry the caller's refcount."""
-        n, key, blocks = self._lookup(tokens)
-        if key is None:
-            return 0, []
-        self._clock += 1
-        ent = self._entries[key]
-        self._entries[key] = (ent[0], ent[1], ent[2], self._clock)
-        return n, self.pool.fork(blocks)
+        with self._mu:
+            n, key, blocks = self._lookup(tokens)
+            if key is None:
+                return 0, []
+            self._clock += 1
+            ent = self._entries[key]
+            self._entries[key] = (ent[0], ent[1], ent[2], self._clock)
+            return n, self.pool.fork(blocks)
 
     def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> None:
         """Register every full-block prefix of a prefilled prompt. The
@@ -216,16 +246,18 @@ class PrefixCache:
             return
         shared = tuple(int(t) for t in tokens[:nfull * bs])
         keys = _chain_keys(shared, bs, nfull)
-        for k in range(1, nfull + 1):
-            key = keys[k - 1]
-            self._clock += 1
-            ent = self._entries.get(key)
-            if ent is not None and ent[1] == k \
-                    and ent[0][:k * bs] == shared[:k * bs]:
-                self._entries[key] = (ent[0], ent[1], ent[2], self._clock)
-                continue
-            held = self.pool.fork(list(blocks[:k]))
-            self._entries[key] = (shared, k, held, self._clock)
+        with self._mu:
+            for k in range(1, nfull + 1):
+                key = keys[k - 1]
+                self._clock += 1
+                ent = self._entries.get(key)
+                if ent is not None and ent[1] == k \
+                        and ent[0][:k * bs] == shared[:k * bs]:
+                    self._entries[key] = (ent[0], ent[1], ent[2],
+                                          self._clock)
+                    continue
+                held = self.pool.fork(list(blocks[:k]))
+                self._entries[key] = (shared, k, held, self._clock)
 
     def evict(self, need_blocks: int,
               protect: Optional[str] = None) -> int:
@@ -237,15 +269,47 @@ class PrefixCache:
         blocks no live request still holds — refcounts make eviction
         safe mid-flight."""
         evicted = 0
-        while self.pool.free_count < need_blocks:
-            victims = [k for k in self._entries if k != protect]
-            if not victims:
-                break
-            key = min(victims, key=lambda k: self._entries[k][3])
-            _, _, blocks, _ = self._entries.pop(key)
-            self.pool.release(blocks)
-            evicted += 1
+        with self._mu:
+            while self.pool.free_count < need_blocks:
+                victims = [k for k in self._entries if k != protect]
+                if not victims:
+                    break
+                key = min(victims, key=lambda k: self._entries[k][3])
+                _, _, blocks, _ = self._entries.pop(key)
+                self.pool.release(blocks)
+                evicted += 1
         return evicted
 
     def clear(self) -> None:
         self.evict(self.pool.num_blocks)
+
+
+class SharedPagedState:
+    """The paged-KV state a disaggregated prefill/decode pair SHARES
+    (round 12, serving/disagg.py): one device pool dict, one refcounted
+    :class:`BlockPool`, one :class:`PrefixCache` — so a prefill role can
+    hand finished blocks to a decode role by transferring block IDs, with
+    zero device-side copies (the handoff moves logical ownership, never
+    bytes).
+
+    ``device_lock`` serializes the roles' jitted calls: both programs
+    DONATE the pool buffers (the in-place-update discipline of
+    serving/engine.py), so exactly one program may hold the live buffer
+    at a time — each call takes the pools, runs, and writes the returned
+    pools back under the lock. A single-threaded engine pays one
+    uncontended acquire per step."""
+
+    def __init__(self, cfg, serving, dtype=None):
+        self.pool = BlockPool(serving.pool_blocks, serving.block_size)
+        self.pools: Dict[str, Any] = init_pool(
+            cfg, serving.pool_blocks, serving.block_size, dtype=dtype)
+        self.prefix_cache = (PrefixCache(self.pool)
+                             if serving.prefix_cache else None)
+        self.device_lock = threading.Lock()
+
+    def run(self, fn, params, *args):
+        """Execute ``fn(params, pools, *args) -> (out, new_pools)`` with
+        the live pool buffers, serialized against the other role."""
+        with self.device_lock:
+            out, self.pools = fn(params, self.pools, *args)
+            return out

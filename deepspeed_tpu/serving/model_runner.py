@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.generation import _dense, _layer_norm, _moe_mlp
+from ..models.generation import _dense, _kv_quantize, _layer_norm, _moe_mlp
 from ..models.transformer import TransformerConfig
 from ..ops.attention import paged_attention
 
@@ -68,7 +68,21 @@ def paged_forward(cfg: TransformerConfig,
 
     Params must be the scan-layers layout (``ensure_scan_layout``).
     post-LN encoders don't decode; int8 weight-only params work unchanged
-    (the dequant rides ``_kernel_of``); int8 KV pools are not supported.
+    (the dequant rides ``_kernel_of``).
+
+    int8 KV pools (round 12): when ``pools`` carries ``k_scale`` /
+    ``v_scale`` (``init_pool(dtype=jnp.int8)``), K/V rows are QUANTIZED
+    ON WRITE — symmetric int8 over the head dim with one f32 scale per
+    (layer, head, slot), the dense generate() cache's ``_kv_quantize``
+    format — and DEQUANTIZED ON READ (the layer's pool slice, before the
+    block gather; the jnp reference path — the Pallas decode kernel does
+    not read int8 pools, guarded at engine construction). Error per
+    element is bounded by that row's absmax / 254. Deliberate cost of
+    this correctness-first tier: the read dequantizes the WHOLE per-layer
+    pool slice (O(pool), not O(attended blocks)) into a transient
+    f32->compute-dtype copy — the at-rest HBM saving is real, the
+    per-step read is not; gathering-then-dequantizing (or dequant inside
+    the kernel) is the ROADMAP item-4 rung.
     """
     if cfg.post_ln:
         raise NotImplementedError("post-LN encoders (BERT) do not serve")
@@ -79,6 +93,11 @@ def paged_forward(cfg: TransformerConfig,
     nbk = block_tables.shape[1]
     bs = int(block_size)
     k_pool, v_pool = pools["k"], pools["v"]
+    quant_kv = "k_scale" in pools
+    if k_pool.dtype == jnp.int8 and not quant_kv:
+        raise ValueError(
+            "int8 KV pool without k_scale/v_scale leaves — build pools "
+            "with serving.kv_cache.init_pool(dtype=jnp.int8)")
     num_slots = k_pool.shape[2]
     if num_slots % bs:
         raise ValueError(f"pool slots {num_slots} not divisible by "
@@ -126,7 +145,8 @@ def paged_forward(cfg: TransformerConfig,
     flat_slots = slots.reshape(B * T)
 
     def layer(carry, xs):
-        x, k_pool, v_pool = carry
+        x, kv = carry
+        k_pool, v_pool = kv["k"], kv["v"]
         p, window, li = xs
         h = _layer_norm(x, p["ln1"], cfg.layer_norm_eps, rms)
         qkv = _dense(h, p["attn_qkv"])
@@ -154,18 +174,48 @@ def paged_forward(cfg: TransformerConfig,
         # flat slots (padded lanes hit the null block)
         k_rows = k.transpose(0, 2, 1, 3).reshape(B * T, nh, hd)
         v_rows = v.transpose(0, 2, 1, 3).reshape(B * T, nh, hd)
-        k_pool = k_pool.at[li, :, flat_slots].set(
-            k_rows.astype(k_pool.dtype))
-        v_pool = v_pool.at[li, :, flat_slots].set(
-            v_rows.astype(v_pool.dtype))
+        kv_new = dict(kv)
+        if quant_kv:
+            # quantize-on-write: THE dense path's per-channel format
+            # (same helper — axis=-1 math is rank-agnostic over rows)
+            (kq, ks), (vq, vs) = _kv_quantize(k_rows), _kv_quantize(v_rows)
+            k_pool = k_pool.at[li, :, flat_slots].set(kq)
+            v_pool = v_pool.at[li, :, flat_slots].set(vq)
+            kv_new["k_scale"] = kv["k_scale"].at[li, :, flat_slots].set(ks)
+            kv_new["v_scale"] = kv["v_scale"].at[li, :, flat_slots].set(vs)
+        else:
+            k_pool = k_pool.at[li, :, flat_slots].set(
+                k_rows.astype(k_pool.dtype))
+            v_pool = v_pool.at[li, :, flat_slots].set(
+                v_rows.astype(v_pool.dtype))
+        kv_new["k"], kv_new["v"] = k_pool, v_pool
         # attention through the block table (kernel on TPU decode,
-        # exact jnp gather elsewhere)
-        kp5 = k_pool.reshape(L, nh, nb_pool, bs, hd)
-        vp5 = v_pool.reshape(L, nh, nb_pool, bs, hd)
-        o = paged_attention(q, kp5, vp5, bt, ctx, sm_scale=sm_scale,
-                            alibi_slopes=slopes, softcap=cfg.attn_softcap,
-                            window=window, layer_idx=li, q_start=q_start,
-                            interpret=interpret)
+        # exact jnp gather elsewhere; int8 tier: dequantize THIS layer's
+        # pool slice and run the layer-free reference view)
+        if quant_kv:
+            kl = jax.lax.dynamic_index_in_dim(k_pool, li, 0, keepdims=False)
+            vl = jax.lax.dynamic_index_in_dim(v_pool, li, 0, keepdims=False)
+            ksl = jax.lax.dynamic_index_in_dim(kv_new["k_scale"], li, 0,
+                                               keepdims=False)
+            vsl = jax.lax.dynamic_index_in_dim(kv_new["v_scale"], li, 0,
+                                               keepdims=False)
+            kp5 = (kl.astype(jnp.float32) * ksl).astype(cfg.dtype).reshape(
+                nh, nb_pool, bs, hd)
+            vp5 = (vl.astype(jnp.float32) * vsl).astype(cfg.dtype).reshape(
+                nh, nb_pool, bs, hd)
+            o = paged_attention(q, kp5, vp5, bt, ctx, sm_scale=sm_scale,
+                                alibi_slopes=slopes,
+                                softcap=cfg.attn_softcap, window=window,
+                                layer_idx=None, q_start=q_start,
+                                interpret=interpret)
+        else:
+            kp5 = k_pool.reshape(L, nh, nb_pool, bs, hd)
+            vp5 = v_pool.reshape(L, nh, nb_pool, bs, hd)
+            o = paged_attention(q, kp5, vp5, bt, ctx, sm_scale=sm_scale,
+                                alibi_slopes=slopes,
+                                softcap=cfg.attn_softcap, window=window,
+                                layer_idx=li, q_start=q_start,
+                                interpret=interpret)
         o = o.transpose(0, 2, 1, 3).reshape(B, T, nh * hd)
         attn_out = _dense(o, p["attn_proj"])
         if cfg.post_block_norms:
@@ -192,10 +242,10 @@ def paged_forward(cfg: TransformerConfig,
                 m = _layer_norm(m, p["post_mlp_norm"],
                                 cfg.layer_norm_eps, rms)
             x_out = x_mid + m
-        return (x_out, k_pool, v_pool), None
+        return (x_out, kv_new), None
 
     xs = (params["blocks"], windows, jnp.arange(cfg.num_layers))
-    (x, k_new, v_new), _ = jax.lax.scan(layer, (x, k_pool, v_pool), xs)
+    (x, kv_out), _ = jax.lax.scan(layer, (x, dict(pools)), xs)
     x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps, rms)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bth,vh->btv", x, wte.astype(x.dtype))
@@ -204,4 +254,4 @@ def paged_forward(cfg: TransformerConfig,
     if cfg.final_logit_softcap:
         from ..ops.attention import apply_softcap
         logits = apply_softcap(logits, cfg.final_logit_softcap)
-    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+    return logits.astype(jnp.float32), kv_out

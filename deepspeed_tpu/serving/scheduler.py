@@ -47,9 +47,13 @@ from .kv_cache import BlockPool, PrefixCache
 
 #: request lifecycle states. TIMEOUT (round 11) is a terminal shed: the
 #: request's deadline passed while it was still QUEUED — never applied to
-#: an admitted request.
-QUEUED, PREFILL, RUNNING, FINISHED, FAILED, TIMEOUT = (
-    "QUEUED", "PREFILL", "RUNNING", "FINISHED", "FAILED", "TIMEOUT")
+#: an admitted request. HANDOFF (round 12) is the disaggregated-serving
+#: window between a finished prefill and its installation into a decode
+#: lane: the request's blocks sit in the block-handoff queue
+#: (serving/disagg.py) with its sampler state (first token, table).
+QUEUED, PREFILL, RUNNING, FINISHED, FAILED, TIMEOUT, HANDOFF = (
+    "QUEUED", "PREFILL", "RUNNING", "FINISHED", "FAILED", "TIMEOUT",
+    "HANDOFF")
 
 _rid = itertools.count()
 
@@ -99,6 +103,11 @@ class Request:
     state: str = QUEUED
     output_tokens: List[int] = field(default_factory=list)
     prefix_hit_tokens: int = 0
+    #: prompt tokens whose K/V reached the pool (round 12): chunked
+    #: prefill advances it per chunk, so a requeue after a mid-prefill
+    #: replica death carries how far the dead leg got (death ledger /
+    #: observability; the retry recomputes from its own prefix hits)
+    prefill_progress: int = 0
     arrival_ts: float = field(default_factory=time.monotonic)
     first_token_ts: Optional[float] = None
     finish_ts: Optional[float] = None

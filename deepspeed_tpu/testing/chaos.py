@@ -61,6 +61,18 @@ the request, never lose it. In-process fleets use ``raise``/``hang``;
 ``kill`` mode would exit the whole process and belongs to
 process-per-replica deployments.
 
+Disaggregated-serving failpoints (round-12, serving/disagg.py):
+``serve.chunk`` fires per chunked-prefill chunk (serving/engine.py —
+a crash mid-prefill must release the partial allocation and requeue the
+request exactly-once, chunk progress carried); ``serve.handoff`` fires
+inside ``BlockHandoff.push`` BEFORE the item is queued (a crash leaves
+the blocks with the dying prefill role — never a half-queued item);
+``serve.handoff_drop`` fires between a decode-side pop and the lane
+install (a crash there is a decode death holding a popped item — its
+blocks ride the quarantine, the request requeues through the
+token-exact prompt+emitted path). The crash-at-every-failpoint matrix
+lives in tests/test_disagg.py.
+
 Query mode (round-7, the training-integrity sentinel): ``flag`` never
 raises or kills — production code ASKS :func:`flag` whether the site is
 armed and fired, and perturbs its own data when it is (a grad spike

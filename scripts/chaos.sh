@@ -17,6 +17,11 @@
 # replica hang -> heartbeat-silence detection + blacklist/parole,
 # retry-budget exhaustion -> FAILED, requeue-crash -> orphan retry, and
 # serve.oom under the fleet.
+# Round 12 adds the disaggregated-serving matrices (tests/test_disagg.py):
+# replica kill at serve.chunk / serve.handoff / serve.handoff_drop ->
+# every request completes token-exact or FAILED-within-retry-budget with
+# the SHARED pool's refcount accounting balanced after recovery, plus
+# handoff backpressure/deadline units and chunk-progress carry.
 # Includes the `slow`-marked engine-in-child tests tier-1 skips.
 # See docs/RESILIENCE.md for the failpoint catalog and exit-code contract.
 #
@@ -37,6 +42,7 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_multinode_runner.py \
     tests/test_launcher_elastic.py \
     tests/test_fleet.py \
+    tests/test_disagg.py \
     "tests/test_multiprocess.py::test_two_process_sharded_save_with_per_rank_failpoint" \
     "tests/test_multiprocess.py::test_two_process_sdc_bitflip_detected_and_attributed" \
     -q -p no:cacheprovider "$@"

@@ -498,3 +498,112 @@ def test_serving_arch_matrix_token_exact():
         for p, r in zip(prompts, reqs):
             assert r.output_tokens == _oracle_tokens(cfg, params, p, 5), \
                 f"arch {kw} diverged"
+
+
+# ---------------------------------------------------------------------------
+# round 12: chunked prefill, per-lane top-k/top-p, int8 paged KV pool
+# ---------------------------------------------------------------------------
+
+def test_chunked_prefill_token_exact_and_compile_bound(tiny):
+    """A non-block-aligned chunk size is token-exact vs whole prefill,
+    and the chunk machinery adds at most ONE extra prefill bucket (all
+    full chunks share the chunk's block-rounded width; the final partial
+    chunk lands in an existing bucket here)."""
+    cfg, params = tiny
+    rng = np.random.default_rng(23)
+    prompts = [list(rng.integers(1, 64, size=n)) for n in (35, 50, 7)]
+    whole = ServingEngine(cfg, params, serving=SERVE_CFG)
+    outs_whole = whole.generate_batch(prompts, max_new_tokens=5)
+    chunked = ServingEngine(cfg, params,
+                            serving=dict(SERVE_CFG,
+                                         prefill_chunk_tokens=10))
+    outs_chunked = chunked.generate_batch(prompts, max_new_tokens=5)
+    assert outs_chunked == outs_whole
+    for p, o in zip(prompts, outs_whole):
+        assert o == _oracle_tokens(cfg, params, p, 5)
+    cache_size = getattr(chunked._prefill_fn, "_cache_size", None)
+    if cache_size is not None:
+        # chunks of 10 bucket to 16: every call (full chunks AND the
+        # <=10-token finals) is the same [1, 16] program. The bound is
+        # <= 2, not == 1: the very first prefill call can specialize
+        # separately (fresh jnp.zeros pools vs donated committed pools —
+        # e.g. when an earlier test left a global mesh set), which is a
+        # one-time sharding entry, not a per-bucket retrace. Whole
+        # prefill pays one bucket PER suffix width (48, 64, 16 here), so
+        # chunking must strictly reduce specializations.
+        assert cache_size() <= 2
+        whole_size = getattr(whole._prefill_fn, "_cache_size")()
+        assert cache_size() < whole_size
+
+
+def test_lane_topk_topp_parity_with_generate_sample():
+    """The vectorized per-lane filter + categorical at one key is
+    token-identical to models.generation._sample at the same key, per
+    lane, across greedy/top-k/top-p/combined lanes (the satellite's
+    parity contract)."""
+    from deepspeed_tpu.models.generation import _sample
+    from deepspeed_tpu.serving.engine import lane_topk_topp
+    rng = np.random.default_rng(0)
+    lanes = [(0.7, 5, None), (1.0, None, 0.9), (0.5, 8, 0.5),
+             (1.3, None, None), (0.9, 1, None), (0.8, 3, 0.95)]
+    logits = jnp.asarray(rng.normal(size=(len(lanes), 64)),
+                         jnp.float32)
+    temps = jnp.asarray([t for t, _, _ in lanes], jnp.float32)
+    tks = jnp.asarray([k or 0 for _, k, _ in lanes], jnp.int32)
+    tps = jnp.asarray([p if p is not None else 1.0 for _, _, p in lanes],
+                      jnp.float32)
+    key = jax.random.PRNGKey(42)
+    filtered = lane_topk_topp(logits / temps[:, None], tks, tps)
+    for b, (t, k, p) in enumerate(lanes):
+        ref = int(np.asarray(_sample(logits[b:b + 1], key, t, k, p))[0])
+        got = int(np.asarray(jax.random.categorical(
+            key, filtered[b:b + 1], axis=-1))[0])
+        assert got == ref, f"lane {b} ({t}, {k}, {p}) diverged"
+
+
+def test_sampling_filters_guard_and_greedy_invariance(tiny):
+    """top_k/top_p raise without serving.sampling_filters (off by
+    default: the nucleus filter puts a sort in the decode step); with the
+    flag on, greedy lanes stay oracle-exact next to filtered lanes and
+    the decode step still compiles once."""
+    cfg, params = tiny
+    eng_off = ServingEngine(cfg, params, serving=SERVE_CFG)
+    with pytest.raises(NotImplementedError):
+        eng_off.submit([1, 2, 3], 4, top_k=5)
+    eng = ServingEngine(cfg, params,
+                        serving=dict(SERVE_CFG, sampling_filters=True))
+    p = [5, 9, 2, 33, 7]
+    r_greedy = eng.submit(p, 5)
+    r_filt = eng.submit(p, 5, temperature=0.8, top_k=4, top_p=0.9)
+    eng.run_until_idle()
+    assert r_greedy.output_tokens == _oracle_tokens(cfg, params, p, 5)
+    assert len(r_filt.output_tokens) == 5
+    cache_size = getattr(eng._decode_fn, "_cache_size", None)
+    if cache_size is not None:
+        assert cache_size() == 1
+
+
+def test_int8_kv_pool_parity_and_guard(tiny):
+    """The quantized pool tier (serving.kv_cache_dtype='int8'):
+    quantize-on-write / dequant-on-read with the dense path's per-channel
+    scale format. Greedy outputs match the f32 oracle within the int8
+    error bound (token-equal on this fixture — f32 compute, real logit
+    gaps), the pool leaves are genuinely int8 + f32 scales, and the
+    dtype guard rejects the Pallas-kernel path at construction."""
+    cfg, params = tiny
+    rng = np.random.default_rng(29)
+    prompts = [list(rng.integers(1, 64, size=n)) for n in (5, 21)]
+    eng = ServingEngine(cfg, params,
+                        serving=dict(SERVE_CFG, kv_cache_dtype="int8"))
+    assert eng.pools["k"].dtype == jnp.int8
+    assert eng.pools["k_scale"].dtype == jnp.float32
+    outs = eng.generate_batch(prompts, max_new_tokens=6)
+    for p, o in zip(prompts, outs):
+        assert o == _oracle_tokens(cfg, params, p, 6), \
+            "int8 pool beyond the quantization error bound"
+    # construction guard: the Pallas kernel (interpret=True forces it on
+    # CPU) has no int8 dequant tier — fail loudly now, not mid-decode
+    with pytest.raises(NotImplementedError):
+        ServingEngine(cfg, params,
+                      serving=dict(SERVE_CFG, kv_cache_dtype="int8"),
+                      interpret=True)
