@@ -1,4 +1,5 @@
-"""graftlint rules TPU001–TPU010.
+"""graftlint rules TPU001–TPU010 and TPU014 (TPU011–013 live in
+rules_collective.py).
 
 Each rule targets one class of bug that regresses the gas-amortized train
 step silently: the bench still runs, just slower (host syncs, retraces)
@@ -862,6 +863,60 @@ class NamedScopeRule(Rule):
                 "pl.pallas_call without jax.named_scope: the kernel is "
                 "anonymous in profiler traces; wrap the launch in "
                 "jax.named_scope('<kernel-name>')")
+
+
+@register
+class DevicePutInStepRule(Rule):
+    """TPU014 — explicit device placement / host round-trip in a traced
+    or hot step path.
+
+    ``jax.device_put`` inside traced code is at best a placement hint
+    the compiler already owns (shardings / out_shardings say it
+    better) and at worst a mid-program cross-device copy XLA cannot
+    schedule around — and in pipeline code it is exactly the
+    inter-stage boundary crossing that belongs to the MPMD transfer
+    channel (``runtime/pipe/mpmd/channel``), where it is explicit,
+    fault-injectable (``pipe.xfer``), and supervised. On the HOST step
+    path, a ``device_put`` whose argument is itself a host pull
+    (``np.asarray(...)`` / ``jax.device_get(...)``) is a full
+    device→host→device round-trip per step — the transfer the channel
+    (or donation) exists to eliminate. Host-side placement outside the
+    step path (init, checkpoint restore, offload staging, the channel
+    itself) is the sanctioned idiom and is not flagged.
+    """
+
+    code = "TPU014"
+    name = "device-put-in-step"
+    severity = Severity.ERROR
+    summary = "device_put/host round-trip in a jitted step path"
+
+    _PULLS = {"numpy.asarray", "numpy.array", "jax.device_get"}
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        scope = module.scope
+        for node in module.all_calls:
+            if _qual(module, node.func) != "jax.device_put":
+                continue
+            traced = scope.in_traced(node)
+            hot = scope.in_hot(node)
+            if traced:
+                yield self.finding(
+                    module, node,
+                    "jax.device_put inside traced code: placement belongs "
+                    "to the compiler (shardings/out_shardings); an "
+                    "inter-stage crossing belongs to the MPMD transfer "
+                    "channel (runtime/pipe/mpmd/channel)")
+                continue
+            if hot and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Call) and \
+                        _qual(module, arg.func) in self._PULLS:
+                    yield self.finding(
+                        module, node,
+                        "device->host->device round-trip on the step path "
+                        "(device_put of a host pull): route the transfer "
+                        "through the MPMD channel or keep the value on "
+                        "device", severity=Severity.WARNING)
 
 
 @register

@@ -28,9 +28,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
+
+#: discovery patterns for recorded pipe sweeps (newest-recorded-sweep
+#: convention, shared loader in benchmarks/sweeps.py)
+PIPE_BENCH_PATTERNS = ("PIPEBENCH_r*.json", "pipe_bench*.json")
 
 
 def _bubble_rows(pairs):
@@ -126,6 +131,112 @@ def _wallclock_and_memory(pp, n_micro, hidden, layers, seq, mb, steps):
     }
 
 
+def _pipe_bench_row(pp, n_micro, hidden, layers, seq, mb, steps):
+    """One machine-readable SPMD-vs-MPMD placement row (round 13).
+
+    ``spmd_step_s`` is the 1F1B stacked-scan executor's wall per
+    optimizer-equivalent step, ``mpmd_step_s`` the per-stage-programs
+    executor on submeshes of the same mesh — same model, same schedule
+    tables, so the delta IS the placement cost (host-driven dispatch +
+    explicit transfers vs one compiled scan). On jax builds without
+    ``jax.shard_map`` the SPMD cell records null (the documented 0.4.x
+    gap) and the MPMD cell still anchors the convention.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.pipeline import build_pipelined_model
+    from ..parallel.mesh import MeshManager, set_global_mesh
+    from ..runtime.pipe.schedule import bubble_fraction, build_1f1b_tables
+
+    mm = MeshManager(pp_size=pp)
+    set_global_mesh(mm)
+    mesh = mm.mesh
+    kw = dict(hidden_size=hidden, num_layers=layers, num_heads=4,
+              vocab_size=512, max_seq_len=seq, dtype=jnp.float32,
+              attention_impl="reference")
+    piped, cfg = build_pipelined_model("gpt2-tiny", pp=pp, n_micro=n_micro,
+                                       **kw)
+    params = piped.init(jax.random.PRNGKey(0),
+                        {"input_ids": np.zeros((n_micro * mb, seq),
+                                               np.int32)})["params"]
+    batch = {"input_ids": jnp.asarray(np.random.default_rng(0).integers(
+        0, 512, size=(n_micro * mb, seq)))}
+
+    def timed(fn):
+        fn()                                   # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / steps
+
+    mpmd_s = timed(lambda: piped.mpmd_value_and_grad(params, batch,
+                                                     mesh=mesh))
+    spmd_s = None
+    if hasattr(jax, "shard_map"):
+        fn = jax.jit(lambda p, b: piped.train_value_and_grad(p, b,
+                                                             mesh=mesh))
+        compiled = fn.lower(params, batch).compile()
+        spmd_s = timed(lambda: compiled(params, batch))
+    t = build_1f1b_tables(n_micro, pp)
+    return {
+        "pp": pp, "n_micro": n_micro, "hidden": hidden, "layers": layers,
+        "seq": seq, "mb": mb,
+        "spmd_step_s": None if spmd_s is None else round(spmd_s, 4),
+        "mpmd_step_s": round(mpmd_s, 4),
+        "bubble_theory": round(bubble_fraction(n_micro, pp), 4),
+        "bubble_1f1b_measured": round(1.0 - n_micro / t["ticks"], 4),
+    }
+
+
+def _row_key(row):
+    return (row.get("pp"), row.get("n_micro"), row.get("hidden"),
+            row.get("layers"), row.get("seq"), row.get("mb"))
+
+
+def latest_pipe_bench(baseline_dir: str, n_devices=None):
+    """(basename, rows) of the newest recorded pipe sweep matching this
+    device count — the shared newest-recorded-sweep convention."""
+    from .sweeps import latest_recorded_sweep
+    return latest_recorded_sweep(baseline_dir, PIPE_BENCH_PATTERNS,
+                                 n_devices=n_devices)
+
+
+def check_pipe_regression(rows, baseline_rows):
+    """Messages for rows whose mpmd wall/step regressed > 2x vs the
+    recorded sweep (CI-host speed varies ~30%; 2x is signal). SPMD cells
+    compare only when both sweeps have one."""
+    base = {_row_key(r): r for r in baseline_rows}
+    msgs = []
+    for row in rows:
+        ref = base.get(_row_key(row))
+        if ref is None:
+            continue
+        for field in ("mpmd_step_s", "spmd_step_s"):
+            new, old = row.get(field), ref.get(field)
+            if new and old and new > 2.0 * old:
+                msgs.append(
+                    f"pipe_bench regression {field} "
+                    f"pp={row['pp']} n_micro={row['n_micro']}: "
+                    f"{new:.4f}s vs recorded {old:.4f}s (>2x)")
+    return msgs
+
+
+def _record_sweep(rows, baseline_dir):
+    import jax
+    doc = {"n": len(jax.devices()), "rows": rows}
+    os.makedirs(baseline_dir, exist_ok=True)
+    k = 1
+    while os.path.exists(os.path.join(baseline_dir,
+                                      f"PIPEBENCH_r{k:02d}.json")):
+        k += 1
+    path = os.path.join(baseline_dir, f"PIPEBENCH_r{k:02d}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return path
+
+
 def _ensure_devices(n):
     """Re-exec in a clean subprocess configured for n virtual CPU devices
     when the current process's jax is already pinned to another backend
@@ -141,6 +252,16 @@ def _ensure_devices(n):
     proc = subprocess.run(
         [sys.executable, "-m", "deepspeed_tpu.benchmarks.pipeline_bench"]
         + sys.argv[1:], env=env)
+    if proc.returncode == -6:
+        # older jaxlibs hard-abort on the raised CPU-collective timeout
+        # flags ("Unknown flags in XLA_FLAGS") — retry without them, the
+        # dryrun_multichip recipe
+        env = clean_cpu_env(n, collective_timeout_flags=False)
+        env["DSTPU_PIPEBENCH_CHILD"] = "1"
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "deepspeed_tpu.benchmarks.pipeline_bench"]
+            + sys.argv[1:], env=env)
     sys.exit(proc.returncode)
 
 
@@ -153,19 +274,47 @@ def main(argv=None):
     p.add_argument("--mb", type=int, default=2)
     p.add_argument("--steps", type=int, default=3)
     p.add_argument("--micros", type=int, nargs="+", default=[4, 8, 16])
+    p.add_argument("--placements", action="store_true",
+                   help="also run the SPMD-vs-MPMD placement rows "
+                        "(pipe_bench: lines, round 13)")
+    p.add_argument("--record", action="store_true",
+                   help="write the placement rows as the next "
+                        "PIPEBENCH_r<k>.json under --baseline-dir")
+    p.add_argument("--baseline-dir", default=".", dest="baseline_dir")
     args = p.parse_args(argv)
-    import os
     if os.environ.get("DSTPU_PIPEBENCH_CHILD") != "1":
         _ensure_devices(max(args.pp * 2, 8))
 
     print(json.dumps({"bubble_table": _bubble_rows(
         [(m, args.pp) for m in args.micros]
         + [(8, 2), (16, 8)])}))
+    import jax
+    if hasattr(jax, "shard_map"):
+        for n_micro in args.micros:
+            row = _wallclock_and_memory(args.pp, n_micro, args.hidden,
+                                        args.layers, args.seq, args.mb,
+                                        args.steps)
+            print(json.dumps(row))
+    else:
+        print(json.dumps({"skipped": "spmd wallclock/memory rows: this "
+                          "jax build has no jax.shard_map (0.4.x)"}))
+    if not (args.placements or args.record):
+        return
+    rows = []
     for n_micro in args.micros:
-        row = _wallclock_and_memory(args.pp, n_micro, args.hidden,
-                                    args.layers, args.seq, args.mb,
-                                    args.steps)
-        print(json.dumps(row))
+        row = _pipe_bench_row(args.pp, n_micro, args.hidden, args.layers,
+                              args.seq, args.mb, args.steps)
+        print("pipe_bench: " + json.dumps(row))
+        rows.append(row)
+    _name, base_rows = latest_pipe_bench(args.baseline_dir,
+                                         n_devices=len(jax.devices()))
+    msgs = check_pipe_regression(rows, base_rows)
+    for m in msgs:
+        print("pipe_bench REGRESSION: " + m)
+    if msgs and os.environ.get("DSTPU_PIPE_BENCH_GATE") == "1":
+        raise SystemExit("pipe_bench regression gate tripped")
+    if args.record:
+        print("recorded " + _record_sweep(rows, args.baseline_dir))
 
 
 if __name__ == "__main__":

@@ -263,6 +263,12 @@ class PipelineConfig(DeepSpeedConfigModel):
     # "1f1b": hand-scheduled interleave (memory ∝ stages, bf16 boundary) —
     # the reference TrainSchedule's execution regime
     schedule: str = "gpipe"
+    # schedule/placement split (round 13, docs/PIPELINE.md): "spmd" runs
+    # the stacked-stage single-program executors; "mpmd" runs each stage
+    # as its own jit program on its own submesh, connected by the explicit
+    # transfer channel (runtime/pipe/mpmd) — per-stage compiles, per-stage
+    # failure domains. Both placements execute the same clock tables.
+    placement: str = "spmd"
 
 
 class TensorParallelConfig(DeepSpeedConfigModel):

@@ -241,11 +241,12 @@ def parse_args(argv=None):
 
 def health_main(argv) -> int:
     """``dstpu health <heartbeat-dir>`` — the operator's one-glance pod
-    view: per-rank phase, step, record age, host, pid, phase GAUGES
-    (SERVE stamps queue-depth / active-lane load) and integrity
-    FLAGS from the heartbeat channel. Works on a serving fleet's
-    per-replica channel (serving/fleet.py) exactly as on a training
-    world's per-rank one. Exit 0 when every rank is live or
+    view: per-rank phase, step, record age, host, pid, pipeline STAGE
+    (MPMD stage workers stamp it, round 13), phase GAUGES (SERVE stamps
+    queue-depth / active-lane load) and integrity FLAGS from the
+    heartbeat channel. Works on a serving fleet's per-replica channel
+    (serving/fleet.py) and an MPMD pipeline's per-stage channel
+    (runtime/pipe/mpmd) exactly as on a training world's per-rank one. Exit 0 when every rank is live or
     concluded cleanly, 1 when any rank's last word is STALLED, any rank
     carries an integrity flag (e.g. ``SDC`` — its host's numbers cannot
     be trusted), or the channel is empty (nothing attesting = nothing
@@ -262,8 +263,8 @@ def health_main(argv) -> int:
         print(f"no heartbeat records under {a.heartbeat_dir}")
         return 1
     now = _time.time()
-    rows = [("RANK", "HOST", "PHASE", "STEP", "AGE", "PID", "GAUGES",
-             "FLAGS", "")]
+    rows = [("RANK", "STAGE", "HOST", "PHASE", "STEP", "AGE", "PID",
+             "GAUGES", "FLAGS", "")]
     bad = False
     for rank in sorted(records):
         rec = records[rank]
@@ -274,7 +275,14 @@ def health_main(argv) -> int:
         # "alive" — a fleet replica pinned at queue>0 active=0 is wedged
         # admission, visible here before any timeout fires
         gauges = rec.get("gauges") or {}
-        gtxt = ",".join(f"{k}={gauges[k]}" for k in sorted(gauges)) or "-"
+        # pipeline STAGE (MPMD stage workers stamp it, round 13 —
+        # mirrors the round-12 role=PREFILL/DECODE gauge): its own
+        # column, because "which stage died" is the first question a
+        # pipeline operator asks
+        stage = gauges.get("stage")
+        stage_txt = str(stage) if stage is not None else "-"
+        gtxt = ",".join(f"{k}={gauges[k]}" for k in sorted(gauges)
+                        if k != "stage") or "-"
         flags = ",".join(rec.get("flags") or ()) or "-"
         note = ""
         if phase == hb.PHASE_STALLED:
@@ -289,7 +297,7 @@ def health_main(argv) -> int:
         if rec.get("flags"):
             note = (note + "; " if note else "") + "integrity flags (rc 118)"
             bad = True
-        rows.append((str(rank), str(rec.get("host")), phase,
+        rows.append((str(rank), stage_txt, str(rec.get("host")), phase,
                      str(rec.get("step")), f"{age:.1f}s",
                      str(rec.get("pid")), gtxt, flags, note))
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]

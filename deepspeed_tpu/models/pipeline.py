@@ -82,6 +82,10 @@ class PipelinedTransformer:
         self.n_micro = n_micro
         self.mesh = mesh
         self.backward = backward
+        #: MPMD placement: per-(train, schedule, loss) pipeline objects —
+        #: each holds its per-stage jit programs, so a training loop
+        #: compiles each stage exactly once (runtime/pipe/mpmd/executor).
+        self._mpmd_cache: Dict[Any, Any] = {}
         # reference model for param init: identical param structure
         self._ref = Transformer(
             cfg if cfg.scan_layers else
@@ -377,6 +381,135 @@ class PipelinedTransformer:
             grads["lm_head"] = gh["lm_head"]
         if moe:
             # reported loss matches make_moe_loss: task + aux_weight * aux
+            loss = loss + aux_w * aux
+        return loss, grads
+
+    # -- MPMD training path --------------------------------------------------
+
+    def mpmd_value_and_grad(self, params, batch, mesh=None, rng=None,
+                            loss_scale=None, loss_fn=None, train=True,
+                            aux_weight=None, schedule="1f1b", channel=None):
+        """Loss + grads via the MPMD placement (runtime/pipe/mpmd): each
+        stage is its own jit program on its own submesh of ``mesh``'s
+        'pipe' axis, activations/cotangents ride the explicit transfer
+        channel, and the SAME clock tables as the SPMD executors drive
+        the ticks (``schedule`` = 'gpipe' | '1f1b').
+
+        Accepts the 1F1B path's full generality (masks, dropout rng
+        folding — bit-identical per (micro, stage, layer) — MoE aux via
+        its constant cotangent, fp16 loss_scale seeding, custom per-micro
+        last-stage loss). The per-stage pipelines are cached on the
+        model, so a training loop compiles each stage exactly once.
+        ``backward='store'`` is SPMD-only (residual rings are a
+        stacked-scan construct) and is refused loudly.
+        """
+        cfg = self.cfg
+        if self.backward == "store":
+            raise ValueError(
+                "backward='store' is an SPMD-executor mode (vjp residual "
+                "rings inside the stacked scan); the MPMD placement's "
+                "fused per-stage backward is the recompute regime — "
+                "build the model with backward='recompute'")
+        mesh = mesh or self.mesh
+        if mesh is None:
+            from ..parallel.mesh import get_global_mesh
+            mesh = get_global_mesh().mesh
+        from ..runtime.pipe.mpmd.executor import MPMDPipeline
+        input_ids, attention_mask, labels = self._parse_batch(batch)
+        if labels is None:
+            labels = input_ids
+        B, S = input_ids.shape
+        mb = B // self.n_micro
+        ids_micros = input_ids.reshape(self.n_micro, mb, S)
+        lab_micros = labels.reshape(self.n_micro, mb, S)
+
+        micros, embed_vjp = jax.vjp(
+            lambda ep: self._embed_micros(ep, ids_micros, S),
+            self._embed_inputs(params))
+        stage_params = stack_stage_params(params["blocks"], self.pp)
+        extras = self._micro_extras(attention_mask, rng, train, B, S)
+        moe = cfg.moe_experts > 0
+        head = self._head_params(params)
+
+        if loss_fn is None:
+            # same GLOBAL token-mean objective as the 1F1B path — the
+            # batch-dependent valid count rides the per-call ``loss_ctx``
+            # arg so it never bakes into the cached per-stage trace
+            loss_ctx = jnp.maximum(
+                jnp.sum((lab_micros[:, :, 1:] != -100).astype(jnp.float32)),
+                1.0)
+            head_labels = lab_micros
+        else:
+            def to_micros(leaf):
+                leaf = jnp.asarray(leaf)
+                if leaf.ndim >= 1 and leaf.shape[0] == B:
+                    return leaf.reshape((self.n_micro, mb) + leaf.shape[1:])
+                return jnp.broadcast_to(leaf[None],
+                                        (self.n_micro,) + leaf.shape)
+
+            head_labels = (jax.tree.map(to_micros, batch)
+                           if isinstance(batch, dict)
+                           else {"input_ids": ids_micros,
+                                 "labels": lab_micros})
+            loss_ctx = ()
+
+        # keyed on mesh and channel too: a later call with a different
+        # mesh must NOT reuse submesh programs built for the old device
+        # layout, and a caller-supplied channel is honored per call.
+        # (Callers passing a fresh lambda loss_fn per call defeat the
+        # cache — per-stage re-jits every step; pass a stable function.)
+        key = (bool(train), schedule, loss_fn, moe, mesh,
+               None if channel is None else id(channel))
+        pipe = self._mpmd_cache.get(key)
+        if pipe is None:
+            n_micro = self.n_micro
+
+            if loss_fn is None:
+                def head_loss(head_p, y, lab, ctx):
+                    h = self._ln_f.apply({"params": head_p["ln_f"]}, y)
+                    logits = self._head_logits(head_p, h)
+                    logits = logits[:, :-1].astype(jnp.float32)
+                    tgt = lab[:, 1:]
+                    valid = tgt != -100
+                    safe = jnp.where(valid, tgt, 0)
+                    logz = jax.nn.logsumexp(logits, axis=-1)
+                    gold = jnp.take_along_axis(logits, safe[..., None],
+                                               axis=-1)[..., 0]
+                    nll_sum = jnp.sum((logz - gold) * valid)
+                    return nll_sum * (n_micro / ctx)
+            else:
+                def head_loss(head_p, y, lab, ctx):
+                    h = self._ln_f.apply({"params": head_p["ln_f"]}, y)
+                    out = self._head_logits(head_p, h).astype(jnp.float32)
+                    return loss_fn(out, lab).astype(jnp.float32)
+
+            pipe = MPMDPipeline(self._block_stage_fn(train), head_loss,
+                                pp=self.pp, schedule=schedule, mesh=mesh,
+                                with_aux=moe, channel=channel)
+            self._mpmd_cache[key] = pipe
+
+        aux_w = (aux_weight if aux_weight is not None
+                 else cfg.moe_aux_weight)
+        loss, aux, gs, gh, dmicros = pipe.value_and_grad(
+            stage_params, head, micros,
+            lab_micros if loss_fn is None else head_labels,
+            extras=extras, loss_ctx=loss_ctx,
+            aux_cotangent=(aux_w if moe else 0.0),
+            loss_scale=loss_scale)
+        (dembed,) = embed_vjp(dmicros)
+        dwte = dembed["wte"]
+        if cfg.tie_embeddings:
+            dwte = dwte + gh["wte"]
+        grads = {
+            "wte": {"embedding": dwte},
+            "blocks": unstack_stage_params(gs),
+            "ln_f": gh["ln_f"],
+        }
+        if cfg.pos_embed == "learned":
+            grads["wpe"] = {"embedding": dembed["wpe"]}
+        if not cfg.tie_embeddings:
+            grads["lm_head"] = gh["lm_head"]
+        if moe:
             loss = loss + aux_w * aux
         return loss, grads
 

@@ -3,10 +3,17 @@
 Capability parity with the reference's ``runtime/pipe/engine.py``
 (PipelineEngine(DeepSpeedEngine): train_batch/eval_batch as the only public
 step APIs, micro_batches == gradient_accumulation_steps, forward/backward/step
-redirected). The instruction interpreter + P2P layer (reference engine.py:1360,
-p2p.py) is replaced by one jitted train step whose pipeline loop lives inside
-the model's apply (models/pipeline.py + runtime/pipe/spmd.py); XLA overlaps the
-ppermute transfers with stage compute.
+redirected). Two placements of the same schedules (round 13,
+docs/PIPELINE.md):
+
+* ``pipeline.placement="spmd"`` (default): one jitted train step whose
+  pipeline loop lives inside the model's apply (models/pipeline.py +
+  runtime/pipe/spmd.py / one_f_one_b.py); XLA overlaps the ppermute
+  transfers with stage compute.
+* ``pipeline.placement="mpmd"``: the reference's own shape — an
+  instruction-stream interpreter over per-stage programs and an explicit
+  transfer layer (runtime/pipe/mpmd) — as a host-driven step plus one
+  jitted finalize tail.
 """
 
 from __future__ import annotations
@@ -32,7 +39,16 @@ class PipelineEngine(DeepSpeedEngine):
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown pipeline.schedule '{schedule}' "
                              "(gpipe | 1f1b)")
-        use_1f1b = schedule == "1f1b"
+        placement = getattr(self.config.pipeline, "placement", "spmd")
+        if placement not in ("spmd", "mpmd"):
+            raise ValueError(f"unknown pipeline.placement '{placement}' "
+                             "(spmd | mpmd)")
+        mpmd = placement == "mpmd"
+        if mpmd and not hasattr(self.module, "mpmd_value_and_grad"):
+            raise ValueError(
+                "pipeline.placement='mpmd' needs a model exposing "
+                "mpmd_value_and_grad (models.pipeline.PipelinedTransformer)")
+        use_1f1b = schedule == "1f1b" and not mpmd
         if use_1f1b and not hasattr(self.module, "train_value_and_grad"):
             raise ValueError(
                 "pipeline.schedule='1f1b' needs a model exposing "
@@ -40,7 +56,7 @@ class PipelineEngine(DeepSpeedEngine):
                 "this module only supports the gpipe schedule")
         custom_loss = None
         aux_weight = None
-        if use_1f1b:
+        if use_1f1b or mpmd:
             from ..engine import _default_loss_fn
             from ...models.transformer import causal_lm_loss
             lf = self.loss_fn
@@ -63,22 +79,28 @@ class PipelineEngine(DeepSpeedEngine):
                     # dim instead, and one folding aux in itself would
                     # double-count it
                     raise ValueError(
-                        "pipeline.schedule='1f1b' with an MoE model needs "
-                        "the loss built by models.make_moe_loss(aux_weight, "
+                        "the hand-scheduled pipeline executors (1f1b / "
+                        "placement='mpmd') with an MoE model need the loss "
+                        "built by models.make_moe_loss(aux_weight, "
                         "base_loss=...): the executor computes the aux "
                         "term itself and passes the base loss bare logits, "
                         "so a raw loss_fn written against the model's "
                         "(logits, aux) output would misread its input.")
                 from ...utils.logging import warning_once
                 warning_once(
-                    "pipeline.schedule='1f1b' computes a custom loss_fn "
-                    "PER MICROBATCH and averages the results (the "
+                    "the hand-scheduled pipeline executors (1f1b / "
+                    "placement='mpmd') compute a custom loss_fn "
+                    "PER MICROBATCH and average the results (the "
                     "reference's _aggregate_total_loss semantics). For "
                     "per-token-mean losses this equals the full-batch "
                     "value; losses normalized over data-dependent counts "
                     "(e.g. valid -100-masked tokens) will weight micros "
                     "differently than the gpipe schedule's full-batch "
                     "evaluation.")
+
+        if mpmd:
+            return self._make_train_step_mpmd(schedule, custom_loss,
+                                              aux_weight)
 
         def train_step(state, batch, rng, lr_arg):
             if use_1f1b:
@@ -108,6 +130,38 @@ class PipelineEngine(DeepSpeedEngine):
             return new_state, metrics
 
         return jax.jit(train_step, donate_argnums=(0,))
+
+    def _make_train_step_mpmd(self, schedule, custom_loss, aux_weight):
+        """MPMD placement: the step is HOST-driven — the executor walks
+        the per-stage instruction streams calling each stage's own
+        compiled program (runtime/pipe/mpmd/executor), so there is no
+        single whole-pipeline jit to build. Only the shared finalize
+        tail (unscale/clip/optimize/skip — identical math to every other
+        step path) is one compiled program over the global mesh.
+        """
+        finalize = None
+
+        def train_step(state, batch, rng, lr_arg):
+            nonlocal finalize
+            loss, grads = self.module.mpmd_value_and_grad(
+                state.params, batch, mesh=self.mesh, rng=rng,
+                loss_scale=(state.scale.scale
+                            if self.loss_scaler.enabled else None),
+                loss_fn=custom_loss, aux_weight=aux_weight,
+                schedule=schedule)
+            if finalize is None:
+                def _finalize(state, grads, lr_arg):
+                    grads = jax.tree.map(
+                        lambda g, s: jax.lax.with_sharding_constraint(
+                            g.astype(jnp.float32), s),
+                        grads, self.grad_shardings)
+                    return self._finalize_step(state, grads, 1.0, lr_arg)
+                finalize = jax.jit(_finalize, donate_argnums=(0,))
+            new_state, metrics = finalize(state, grads, lr_arg)
+            metrics["loss"] = loss
+            return new_state, metrics
+
+        return train_step
 
     def train_batch(self, data_iter_or_batch) -> Dict[str, Any]:
         batch = (next(data_iter_or_batch)

@@ -1,0 +1,39 @@
+"""MPMD pipeline placement — per-stage programs over explicit transfers.
+
+The SPMD placement (``..spmd`` / ``..one_f_one_b``) compiles the whole
+stacked-stage pipeline as ONE program over the 'pipe' mesh axis: every
+host compiles everything and the stages share a single failure domain.
+This package is the second placement of the same schedules
+(``..schedule.build_tables``): each stage is its own jit program on its
+own submesh (in-process) or its own process (cross-host), connected by
+an explicit activation/grad transfer channel — the shape the reference
+DeepSpeed itself executes (``runtime/pipe/engine.py`` instruction
+schedules + p2p), and the scalable one for pod-of-pods over DCN
+(2412.14374). See docs/PIPELINE.md.
+
+Layers:
+  * channel.py  — the transfer seam: LocalChannel (in-process
+    device-to-device via jax.device_put) and SocketChannel (host-bounce
+    TCP star through the driver — the CPU-testable cross-process
+    reference path). Both declare the ``pipe.xfer`` failpoint.
+  * executor.py — MPMDPipeline: per-stage compiled fwd/bwd programs
+    interpreting :func:`..schedule.stage_instruction_stream`; a
+    drop-in value_and_grad with the SPMD 1F1B executor's contract.
+  * stage_worker.py — one stage as a supervised OS process: heartbeats
+    (STAGE gauge), per-stage checkpoints, park/resync protocol,
+    rc 0/114/117/118 contract.
+  * driver.py — MPMDStageSupervisor: spawns/supervises the per-stage
+    workers, routes transfers, restarts ONLY a dead stage and resyncs
+    the survivors from the last per-stage checkpoint.
+"""
+
+from .channel import (ChannelClosed, ChannelTimeout, LocalChannel,
+                      SocketChannel)
+from .executor import MPMDPipeline, mpmd_value_and_grad
+from .driver import MPMDStageSupervisor, StageWorkerSpec
+
+__all__ = [
+    "ChannelClosed", "ChannelTimeout", "LocalChannel", "SocketChannel",
+    "MPMDPipeline", "mpmd_value_and_grad", "MPMDStageSupervisor",
+    "StageWorkerSpec",
+]

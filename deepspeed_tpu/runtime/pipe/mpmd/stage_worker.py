@@ -1,0 +1,405 @@
+"""One MPMD pipeline stage as a supervised OS process.
+
+``python -m deepspeed_tpu.runtime.pipe.mpmd.stage_worker --stage S ...``
+runs stage S of a pp-stage pipeline: it connects to the driver's
+transfer star (channel.SocketChannel), interprets its own
+``stage_instruction_stream`` per training step with the shared
+per-stage programs (executor.build_stage_programs — byte-identical math
+to the in-process executor), applies its LOCAL optimizer at each step
+boundary, and checkpoints its own state every ``--save-interval`` steps
+through the PR-3 durable-tag machinery (staging dir + digests +
+completion marker + atomic publish), which is also what a RESTARTED
+stage restores from.
+
+Supervision plugs into the existing substrate, not a new one:
+
+* every step stamps a STAGE-tagged heartbeat (phase STEP, gauge
+  ``{"stage": S, ...}``) — ``dstpu health`` shows the STAGE column and
+  RunSupervisor-style silence logic applies unchanged;
+* a StallWatchdog bounds the step cadence: a wedged stage (collective
+  hang, chaos ``pipe.stage_kill:hang``) exits rc 117 with a STALLED
+  terminal record;
+* SIGTERM stamps PREEMPTED and exits rc 114 (the preemption contract);
+* transfer faults (``pipe.xfer``) surface as IOError → rc 1 (a counted
+  crash the driver restarts).
+
+Park/resync (the one-stage-restart protocol, driven by driver.py):
+when a peer dies, the driver parks the survivors — a park control frame
+surfaces mid-recv as ``ParkSignal`` or at the step top — and each
+survivor ABANDONS its in-flight step (partial grad accumulation is
+discarded; no optimizer update was applied, so nothing needs undoing),
+acks, and waits. After the dead stage restarts from its newest durable
+tag, the driver broadcasts ``resync(step=k)``; every survivor restores
+its own stage state at tag k and training replays from step k — each
+microbatch's update is applied exactly once. A survivor parked past
+``--park-timeout`` exits rc 117 (a dead driver must not strand live
+stages).
+
+The built-in ``toy`` spec (tanh-MLP stages + linear head, data
+generated deterministically per (seed, step)) is what the tests and the
+2-proc reference runs use; real models plug in by registering a spec
+callable via ``--spec module:attr`` returning the same dict shape.
+"""
+
+from __future__ import annotations
+
+# graftlint: disable-file=TPU013 (a stage worker is a SINGLE-process jax
+# runtime by construction — its only peers are other OS processes reached
+# through the socket channel, never collectives; the checkpoint helpers'
+# process_allgather arm is unreachable at jax.process_count()==1, so the
+# collective-order-divergence model does not apply to this file)
+
+import argparse
+import importlib
+import os
+import signal
+import sys
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+PREEMPTION_EXIT_CODE = 114
+
+
+def toy_spec(args) -> Dict[str, Any]:
+    """Deterministic toy pipeline: pp tanh-MLP stages + linear head over
+    H-dim activations. Every field derives from (--seed, step), so two
+    runs — or one run crossing a restart — see identical params and
+    data."""
+    import jax.numpy as jnp
+
+    H, mb = args.hidden, args.mb
+    rng = np.random.RandomState(args.seed)
+    stage_inits = []
+    for s in range(args.pp):
+        stage_inits.append({
+            "w": jnp.asarray(rng.randn(H, H) * 0.3, jnp.float32),
+            "b": jnp.asarray(rng.randn(H) * 0.1, jnp.float32)})
+    head_init = {"v": jnp.asarray(rng.randn(H) * 0.5, jnp.float32)}
+
+    def stage_fn(p, x, extra, stage):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def loss_fn(head_p, y, lab, ctx):
+        return jnp.mean((y @ head_p["v"] - lab) ** 2)
+
+    def data(step):
+        drng = np.random.default_rng(args.seed * 100003 + step)
+        micros = jnp.asarray(
+            drng.standard_normal((args.n_micro, mb, H)), jnp.float32)
+        labels = jnp.asarray(
+            drng.standard_normal((args.n_micro, mb)), jnp.float32)
+        return micros, labels
+
+    return {"stage_fn": stage_fn, "loss_fn": loss_fn,
+            "stage_init": stage_inits[args.stage], "head_init": head_init,
+            "data": data}
+
+
+def _load_spec(args):
+    if args.spec == "toy":
+        return toy_spec(args)
+    mod, _, attr = args.spec.partition(":")
+    fn = getattr(importlib.import_module(mod), attr)
+    return fn(args)
+
+
+# ------------------------------------------------------------- checkpointing
+
+_TAG = "global_step"
+
+
+def _save_stage_state(ckpt_dir: str, done: int, state) -> None:
+    """Durable per-stage save through the PR-3 primitives: stage into
+    <tag>.tmp, digest + completion marker, atomic publish, latest."""
+    import json
+    from ...checkpointing import (META_FILE, STAGING_SUFFIX, publish_tag,
+                                  save_tree, write_completion_marker,
+                                  write_latest)
+    tag = f"{_TAG}{done}"
+    stage_dir = os.path.join(ckpt_dir, tag + STAGING_SUFFIX)
+    os.makedirs(stage_dir, exist_ok=True)
+    save_tree(state, os.path.join(stage_dir, "model_states.npz"))
+    with open(os.path.join(stage_dir, META_FILE), "w") as f:
+        json.dump({"step": done, "stage_checkpoint": True}, f)
+    write_completion_marker(stage_dir, num_shards=1)
+    publish_tag(ckpt_dir, tag)
+    write_latest(ckpt_dir, tag)
+
+
+def _load_stage_state(ckpt_dir: str, like, tag: Optional[str] = None):
+    """(state, steps_done) from ``tag`` or the newest intact tag (the
+    PR-3 verified loader path: digests checked, torn tags skipped).
+    Returns (None, 0) when nothing restorable exists."""
+    from ...checkpointing import load_tree, resolve_load_tag, verify_tag
+    if tag is None:
+        try:
+            tag = resolve_load_tag(ckpt_dir)
+        except (FileNotFoundError, OSError, RuntimeError, ValueError):
+            return None, 0
+        if tag is None:
+            return None, 0
+    else:
+        if verify_tag(os.path.join(ckpt_dir, tag)) is not None:
+            raise IOError(f"resync tag {tag} failed verification")
+    state = load_tree(os.path.join(ckpt_dir, tag, "model_states.npz"), like)
+    return state, int(tag[len(_TAG):])
+
+
+# ------------------------------------------------------------------- worker
+
+
+def run_worker(args) -> int:
+    import jax
+    from ....testing import chaos
+    from ...heartbeat import (HEARTBEAT_DIR_ENV, PHASE_EXIT, PHASE_PREEMPTED,
+                              PHASE_STEP, HeartbeatWriter)
+    from ...watchdog import STALL_EXIT_CODE, StallWatchdog
+    from ..schedule import (BackwardPass, ForwardPass, LoadMicroBatch,
+                            RecvActivation, RecvGrad, SendActivation,
+                            SendGrad, build_tables, stage_instruction_stream)
+    from ....ops.optimizers import adam
+    from .channel import (ChannelClosed, ChannelTimeout, ParkSignal,
+                          SocketChannel)
+    from .executor import build_stage_programs
+
+    s, pp = args.stage, args.pp
+    last = s == pp - 1
+    spec = _load_spec(args)
+    opt = adam(lr=args.lr)
+
+    params: Dict[str, Any] = {"stage": spec["stage_init"]}
+    if last:
+        params["head"] = spec["head_init"]
+    opt_state = opt.init(params)
+    # the step rides as shape (1,): the npz flat-dict roundtrip does not
+    # preserve 0-d scalars
+    state_like = {"params": params, "opt": opt_state,
+                  "step": np.zeros((1,), np.int64)}
+
+    restored, done = _load_stage_state(args.ckpt_dir, state_like)
+    if restored is not None:
+        params, opt_state = restored["params"], restored["opt"]
+        done = int(np.asarray(restored["step"]).reshape(-1)[0])
+    else:
+        # durable step-0 state: a resync to step 0 must be restorable
+        _save_stage_state(args.ckpt_dir, 0, {
+            "params": params, "opt": opt_state,
+            "step": np.asarray([0], np.int64)})
+        done = 0
+
+    hb = None
+    if os.environ.get(HEARTBEAT_DIR_ENV):
+        hb = HeartbeatWriter(os.environ[HEARTBEAT_DIR_ENV], rank=s)
+
+    def on_sigterm(signum, frame):
+        if hb is not None:
+            # direct terminal write (not stamp_terminal) so the STAGE
+            # gauge survives onto the final record — `dstpu health`
+            # answers "which stage" even post-mortem
+            hb.write(PHASE_PREEMPTED, 0, force=True, lock_timeout=2.0,
+                     extra={"stage": s})
+        os._exit(PREEMPTION_EXIT_CODE)
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+
+    watchdog = None
+    if args.stall_timeout > 0:
+        watchdog = StallWatchdog(args.stall_timeout, heartbeat=hb).start()
+
+    chan = SocketChannel((args.driver_host, args.driver_port), s,
+                         resume_step=done)
+    progs = build_stage_programs(spec["stage_fn"], spec["loss_fn"], s, pp)
+    tables = build_tables(args.schedule, args.n_micro, pp)
+    stream = stage_instruction_stream(tables, s)
+    jnp = jax.numpy
+    f32 = jnp.float32
+    scale = jnp.asarray(1.0, f32)
+    aux_ct = jnp.asarray(0.0, f32)
+
+    import contextlib
+
+    def _recv(kind, mid):
+        # a wait AT THE TRANSFER BARRIER is not a stall: it is bounded
+        # by barrier_timeout (and interruptible by a park), so the
+        # step-cadence watchdog suspends across it — compute wedges are
+        # the watchdog's jurisdiction, late peers are the channel's
+        ctx = (watchdog.suspended() if watchdog is not None
+               else contextlib.nullcontext())
+        with ctx:
+            return jnp.asarray(chan.recv(kind, s, mid,
+                                         timeout=args.barrier_timeout))
+
+    def run_step(k):
+        """One schedule pass; returns (grads, loss|None). Raises
+        ParkSignal / ChannelTimeout / IOError per the contract above."""
+        micros, labels = spec["data"](k)
+        in_act, in_grad, saved_x = {}, {}, {}
+        out_y, out_dx = {}, {}
+        acc = jax.tree.map(lambda x: jnp.zeros(x.shape, f32),
+                           params["stage"])
+        hacc = (jax.tree.map(lambda x: jnp.zeros(x.shape, f32),
+                             params["head"]) if last else None)
+        lacc = jnp.zeros((), f32)
+        for cmds in stream:
+            for inst in cmds:
+                mid = inst.buffer_id
+                if isinstance(inst, RecvActivation):
+                    in_act[mid] = _recv("act", mid)
+                elif isinstance(inst, RecvGrad):
+                    in_grad[mid] = _recv("grad", mid)
+                elif isinstance(inst, LoadMicroBatch):
+                    in_act[mid] = micros[mid]
+                elif isinstance(inst, ForwardPass):
+                    x = in_act.pop(mid)
+                    saved_x[mid] = x
+                    if last:
+                        # the fused last_bwd recomputes the body; a fwd
+                        # dispatch here would be pure double compute
+                        continue
+                    y, _aux = progs["fwd"](params["stage"], x, {})
+                    out_y[mid] = y
+                elif isinstance(inst, SendActivation):
+                    chan.send("act", s, s + 1, mid,
+                              np.asarray(out_y.pop(mid)))
+                elif isinstance(inst, BackwardPass):
+                    xb = saved_x.pop(mid)
+                    if last:
+                        nonlocal_acc = progs["last_bwd"](
+                            params["stage"], params["head"], xb, {},
+                            labels[mid], (), scale, aux_ct,
+                            acc, hacc, lacc)
+                        acc, hacc, lacc, dx = nonlocal_acc
+                    else:
+                        dy = in_grad.pop(mid)
+                        acc, dx = progs["bwd"](params["stage"], xb, {},
+                                               dy, aux_ct, acc)
+                    if s > 0:
+                        out_dx[mid] = dx
+                elif isinstance(inst, SendGrad):
+                    chan.send("grad", s, s - 1, mid,
+                              np.asarray(out_dx.pop(mid)))
+        grads = {"stage": jax.tree.map(lambda g: g / args.n_micro, acc)}
+        if last:
+            grads["head"] = jax.tree.map(lambda g: g / args.n_micro, hacc)
+        loss = float(jax.device_get(lacc)) / args.n_micro if last else None
+        return grads, loss
+
+    def park_and_resync():
+        """The survivor half of one-stage restart: ack the park, wait
+        (bounded) for resync, restore this stage's state at the resync
+        step. Returns the step to resume from."""
+        chan.send_control({"cmd": "parked", "stage": s})
+        if watchdog is not None:
+            watchdog.suspend()
+        try:
+            ctrl = chan.wait_control("resync", timeout=args.park_timeout)
+        except ChannelTimeout:
+            if hb is not None:
+                from ...heartbeat import PHASE_STALLED
+                hb.write(PHASE_STALLED, 0, force=True, lock_timeout=2.0,
+                         extra={"stage": s})
+            sys.exit(STALL_EXIT_CODE)
+        finally:
+            if watchdog is not None:
+                watchdog.resume()
+        r = int(ctrl["step"])
+        # the new generation: frames from the abandoned step are stale
+        chan.generation = int(ctrl.get("gen", chan.generation + 1))
+        restored, _ = _load_stage_state(args.ckpt_dir, state_like,
+                                        tag=f"{_TAG}{r}")
+        chan.clear_data()
+        return r, restored
+
+    k = done
+    step_arr = jnp.asarray(0, jnp.int32)
+    while k < args.steps:
+        ctrl = chan.poll_control(0.0)
+        if ctrl is not None:
+            if ctrl.get("cmd") == "stop":
+                break
+            if ctrl.get("cmd") == "park":
+                k, restored = park_and_resync()
+                params, opt_state = restored["params"], restored["opt"]
+                continue
+        # the chaos hook the one-stage-restart matrix arms (keyed by
+        # stage, so `match=1` takes out stage 1 only)
+        chaos.failpoint("pipe.stage_kill", key=str(s))
+        if hb is not None:
+            hb.write(PHASE_STEP, k, extra={"stage": s})
+        try:
+            grads, loss = run_step(k)
+        except ParkSignal:
+            k, restored = park_and_resync()
+            params, opt_state = restored["params"], restored["opt"]
+            continue
+        except ChannelTimeout:
+            # parked at the transfer barrier past the deadline with no
+            # park/resync word from the driver: the stall contract
+            if hb is not None:
+                from ...heartbeat import PHASE_STALLED
+                hb.write(PHASE_STALLED, k, force=True, lock_timeout=2.0,
+                         extra={"stage": s})
+            return STALL_EXIT_CODE
+        except ChannelClosed:
+            return 1
+        params, opt_state = opt.update(grads, opt_state, params,
+                                       step_arr + k)
+        if watchdog is not None:
+            watchdog.beat(step=k)
+        k += 1
+        if args.save_interval > 0 and k % args.save_interval == 0:
+            _save_stage_state(args.ckpt_dir, k, {
+                "params": params, "opt": opt_state,
+                "step": np.asarray([k], np.int64)})
+        if last and loss is not None:
+            print(f'mpmd_step: {{"step": {k - 1}, "loss": {loss:.8f}}}',
+                  flush=True)
+
+    chan.send_control({"cmd": "done", "stage": s})
+    if watchdog is not None:
+        watchdog.stop()
+    if hb is not None:
+        hb.write(PHASE_EXIT, k, force=True, lock_timeout=2.0,
+                 extra={"stage": s})
+    chan.close()
+    return 0
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="mpmd.stage_worker")
+    p.add_argument("--stage", type=int, required=True)
+    p.add_argument("--pp", type=int, required=True)
+    p.add_argument("--n-micro", type=int, default=4, dest="n_micro")
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--schedule", default="1f1b", choices=["gpipe", "1f1b"])
+    p.add_argument("--driver-host", default="127.0.0.1", dest="driver_host")
+    p.add_argument("--driver-port", type=int, required=True,
+                   dest="driver_port")
+    p.add_argument("--ckpt-dir", required=True, dest="ckpt_dir")
+    p.add_argument("--save-interval", type=int, default=1,
+                   dest="save_interval")
+    p.add_argument("--spec", default="toy",
+                   help="'toy' or module:attr returning the spec dict")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--hidden", type=int, default=8)
+    p.add_argument("--mb", type=int, default=2)
+    p.add_argument("--park-timeout", type=float, default=60.0,
+                   dest="park_timeout")
+    p.add_argument("--barrier-timeout", type=float, default=60.0,
+                   dest="barrier_timeout")
+    p.add_argument("--stall-timeout", type=float, default=0.0,
+                   dest="stall_timeout",
+                   help="watchdog step deadline; 0 = unbounded")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    os.makedirs(args.ckpt_dir, exist_ok=True)
+    return run_worker(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,59 +50,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+# the schedule/placement split (round 13): the clock tables live in the
+# schedule layer — this module is the SPMD *placement* of that schedule.
+# Re-exported here for backwards compatibility (benchmarks, tests).
+from .schedule import build_1f1b_tables
+
 PyTree = Any
-
-
-def build_1f1b_tables(n_micro: int, pp: int
-                      ) -> Dict[str, np.ndarray]:
-    """Clock-aligned 1F1B tables via event simulation.
-
-    Returns arrays [T, pp]: fwd[t,s] / bwd[t,s] = micro id computed (-1 =
-    bubble), recv_f[t,s] = micro id whose activation ARRIVES at (t,s) from
-    s-1 (sent at t-1), recv_b[t,s] = cotangent arriving from s+1. Every
-    stage obeys: warmup of (pp-1-s) forwards, then backward-priority
-    alternation (the reference TrainSchedule discipline, schedule.py:151).
-    """
-    slots = min(pp, n_micro)
-    fwd_done = -np.ones((pp, n_micro), np.int64)    # tick fwd finished
-    bwd_done = -np.ones((pp, n_micro), np.int64)
-    fwd_next = [0] * pp
-    bwd_next = [0] * pp
-    rows_f, rows_b = [], []
-    t = 0
-    while any(b < n_micro for b in bwd_next):
-        row_f = [-1] * pp
-        row_b = [-1] * pp
-        for s in range(pp):
-            f, b = fwd_next[s], bwd_next[s]
-            # a tick holds one forward AND one backward (the executor's scan
-            # body computes both — that IS the 1F1B steady state); the ring
-            # capacity caps in-flight forwards
-            if f < n_micro and f - b < slots and (
-                    s == 0 or 0 <= fwd_done[s - 1, f] < t):
-                row_f[s] = f
-                fwd_done[s, f] = t
-                fwd_next[s] += 1
-            if b < n_micro and (
-                    (s == pp - 1 and 0 <= fwd_done[s, b] <= t)
-                    or (s < pp - 1 and 0 <= bwd_done[s + 1, b] < t)):
-                row_b[s] = b
-                bwd_done[s, b] = t
-                bwd_next[s] += 1
-        rows_f.append(row_f)
-        rows_b.append(row_b)
-        t += 1
-        if t > 6 * (n_micro + pp) + 8:
-            raise RuntimeError("1F1B schedule failed to converge")
-    fwd = np.asarray(rows_f, np.int32)
-    bwd = np.asarray(rows_b, np.int32)
-    T = fwd.shape[0]
-    recv_f = -np.ones_like(fwd)
-    recv_b = -np.ones_like(bwd)
-    recv_f[1:, 1:] = fwd[:-1, :-1]
-    recv_b[1:, :-1] = bwd[:-1, 1:]
-    return {"fwd": fwd, "bwd": bwd, "recv_f": recv_f, "recv_b": recv_b,
-            "ticks": T}
 
 
 def pipeline_1f1b_value_and_grad(

@@ -2,17 +2,33 @@
 
 Capability parity with the reference's ``runtime/pipe/schedule.py``
 (PipeSchedule ABC, TrainSchedule 1F1B, InferenceSchedule, instruction vocab).
-On TPU the *execution* of pipeline parallelism is a single SPMD program
-(spmd.py: collective-permute microbatch loop compiled by XLA), so these
-schedules are not interpreted per-rank at runtime the way the reference's
-``_exec_schedule`` does — they exist as the analyzable/testable model of the
-pipeline (bubble accounting, buffer counts, schedule visualization) and for
-API parity. The instruction vocabulary matches the reference's names.
+
+This module is the SCHEDULE half of the schedule/placement split (round 13):
+it decides *what ticks happen* — which microbatch each stage forwards,
+backwards, sends and receives at every clock tick — while the placement
+layer decides *where they execute*:
+
+  * SPMD placement (spmd.py GPipe scan, one_f_one_b.py 1F1B interleave):
+    one stacked-stage program over the 'pipe' mesh axis; the clock tables
+    built here drive the masked scan body, transfers are ``lax.ppermute``.
+  * MPMD placement (mpmd/): each stage is its OWN jit program on its own
+    submesh or process, and :func:`stage_instruction_stream` renders the
+    same clock tables as per-stage instruction lists — the reference's
+    ``_exec_schedule`` shape — interpreted tick by tick against an
+    explicit transfer channel.
+
+Both placements execute the SAME tables (``build_1f1b_tables`` /
+``build_gpipe_tables``), which is what makes them loss-parity-testable
+against each other. The legacy generator schedules (TrainSchedule etc.)
+remain as the reference-API view; ``stage_instruction_stream`` is the
+clock-aligned equivalent the executors actually consume.
 """
 
 from __future__ import annotations
 
-from typing import Iterator, List
+from typing import Dict, Iterator, List
+
+import numpy as np
 
 
 # -- instruction vocabulary (reference: schedule.py:336-476) ------------------
@@ -209,3 +225,139 @@ class DataParallelSchedule(PipeSchedule):
 def bubble_fraction(micro_batches: int, stages: int) -> float:
     """Idle fraction of the GPipe/1F1B pipeline."""
     return (stages - 1) / (micro_batches + stages - 1)
+
+
+# -- clock-aligned tick tables (the schedule/placement seam) ------------------
+
+def build_1f1b_tables(n_micro: int, pp: int) -> Dict[str, np.ndarray]:
+    """Clock-aligned 1F1B tables via event simulation.
+
+    Returns arrays [T, pp]: fwd[t,s] / bwd[t,s] = micro id computed (-1 =
+    bubble), recv_f[t,s] = micro id whose activation ARRIVES at (t,s) from
+    s-1 (sent at t-1), recv_b[t,s] = cotangent arriving from s+1. Every
+    stage obeys: warmup of (pp-1-s) forwards, then backward-priority
+    alternation (the reference TrainSchedule discipline, schedule.py:151).
+
+    Consumed by BOTH placements: the SPMD 1F1B executor's masked scan body
+    (one_f_one_b.py) and the MPMD per-stage interpreter (mpmd/executor.py,
+    via :func:`stage_instruction_stream`).
+    """
+    slots = min(pp, n_micro)
+    fwd_done = -np.ones((pp, n_micro), np.int64)    # tick fwd finished
+    bwd_done = -np.ones((pp, n_micro), np.int64)
+    fwd_next = [0] * pp
+    bwd_next = [0] * pp
+    rows_f, rows_b = [], []
+    t = 0
+    while any(b < n_micro for b in bwd_next):
+        row_f = [-1] * pp
+        row_b = [-1] * pp
+        for s in range(pp):
+            f, b = fwd_next[s], bwd_next[s]
+            # a tick holds one forward AND one backward (the executor's scan
+            # body computes both — that IS the 1F1B steady state); the ring
+            # capacity caps in-flight forwards
+            if f < n_micro and f - b < slots and (
+                    s == 0 or 0 <= fwd_done[s - 1, f] < t):
+                row_f[s] = f
+                fwd_done[s, f] = t
+                fwd_next[s] += 1
+            if b < n_micro and (
+                    (s == pp - 1 and 0 <= fwd_done[s, b] <= t)
+                    or (s < pp - 1 and 0 <= bwd_done[s + 1, b] < t)):
+                row_b[s] = b
+                bwd_done[s, b] = t
+                bwd_next[s] += 1
+        rows_f.append(row_f)
+        rows_b.append(row_b)
+        t += 1
+        if t > 6 * (n_micro + pp) + 8:
+            raise RuntimeError("1F1B schedule failed to converge")
+    fwd = np.asarray(rows_f, np.int32)
+    bwd = np.asarray(rows_b, np.int32)
+    T = fwd.shape[0]
+    recv_f = -np.ones_like(fwd)
+    recv_b = -np.ones_like(bwd)
+    recv_f[1:, 1:] = fwd[:-1, :-1]
+    recv_b[1:, :-1] = bwd[:-1, 1:]
+    return {"fwd": fwd, "bwd": bwd, "recv_f": recv_f, "recv_b": recv_b,
+            "ticks": T}
+
+
+def build_gpipe_tables(n_micro: int, pp: int) -> Dict[str, np.ndarray]:
+    """Clock-aligned GPipe tables: full forward fill/drain, then the full
+    backward wave in reverse pipeline direction — same array contract as
+    :func:`build_1f1b_tables`, so the MPMD interpreter runs either
+    schedule through one code path. In-flight forwards reach ``n_micro``
+    (the GPipe memory regime), unlike 1F1B's ``min(pp, n_micro)`` bound.
+    """
+    T_f = n_micro + pp - 1
+    T = T_f + n_micro + pp - 1
+    fwd = -np.ones((T, pp), np.int32)
+    bwd = -np.ones((T, pp), np.int32)
+    for t in range(T_f):
+        for s in range(pp):
+            m = t - s
+            if 0 <= m < n_micro:
+                fwd[t, s] = m
+    # backward: stage pp-1 leads (micro m at T_f+m); stage s waits
+    # (pp-1-s) extra ticks for the cotangent to ripple upstream
+    for m in range(n_micro):
+        for s in range(pp):
+            bwd[T_f + m + (pp - 1 - s), s] = m
+    recv_f = -np.ones_like(fwd)
+    recv_b = -np.ones_like(bwd)
+    recv_f[1:, 1:] = fwd[:-1, :-1]
+    recv_b[1:, :-1] = bwd[:-1, 1:]
+    return {"fwd": fwd, "bwd": bwd, "recv_f": recv_f, "recv_b": recv_b,
+            "ticks": T}
+
+
+def build_tables(schedule: str, n_micro: int, pp: int) -> Dict[str, np.ndarray]:
+    """Tick tables for a named schedule ('gpipe' | '1f1b')."""
+    if schedule == "1f1b":
+        return build_1f1b_tables(n_micro, pp)
+    if schedule == "gpipe":
+        return build_gpipe_tables(n_micro, pp)
+    raise ValueError(f"unknown pipeline schedule {schedule!r} (gpipe | 1f1b)")
+
+
+def stage_instruction_stream(tables: Dict[str, np.ndarray], stage: int,
+                             ) -> List[List[PipeInstruction]]:
+    """Render ONE stage's view of the clock tables as per-tick instruction
+    lists — the reference's ``_exec_schedule`` shape, using the same
+    instruction vocabulary the generator schedules yield. ``buffer_id``
+    carries the MICRO id (the MPMD interpreter keys its buffers by micro;
+    the legacy generators' ``micro % num_pipe_buffers`` ring indexing is a
+    placement concern, not a schedule one).
+
+    Receives are ordered before computes within a tick (the payload was
+    sent one tick earlier and must be consumed before the matching
+    forward/backward fires).
+    """
+    pp = tables["fwd"].shape[1]
+    if not 0 <= stage < pp:
+        raise ValueError(f"stage {stage} out of range for {pp} stages")
+    out: List[List[PipeInstruction]] = []
+    for t in range(int(tables["ticks"])):
+        cmds: List[PipeInstruction] = []
+        rf = int(tables["recv_f"][t, stage])
+        rb = int(tables["recv_b"][t, stage])
+        f = int(tables["fwd"][t, stage])
+        b = int(tables["bwd"][t, stage])
+        if rf >= 0:
+            cmds.append(RecvActivation(buffer_id=rf))
+        if rb >= 0:
+            cmds.append(RecvGrad(buffer_id=rb))
+        if f >= 0:
+            if stage == 0:
+                cmds.append(LoadMicroBatch(buffer_id=f))
+            cmds.append(ForwardPass(buffer_id=f))
+            if stage < pp - 1:
+                cmds.append(SendActivation(buffer_id=f))
+        if b >= 0:
+            cmds.append(BackwardPass(buffer_id=b))
+            if stage > 0:
+                cmds.append(SendGrad(buffer_id=b))
+        out.append(cmds)
+    return out

@@ -43,6 +43,7 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_launcher_elastic.py \
     tests/test_fleet.py \
     tests/test_disagg.py \
+    tests/test_mpmd.py \
     "tests/test_multiprocess.py::test_two_process_sharded_save_with_per_rank_failpoint" \
     "tests/test_multiprocess.py::test_two_process_sdc_bitflip_detected_and_attributed" \
     -q -p no:cacheprovider "$@"

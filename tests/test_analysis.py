@@ -1630,3 +1630,83 @@ def test_package_is_lint_clean_against_baseline():
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
     data = json.loads(proc.stdout)
     assert data["summary"]["gating"] == 0
+
+
+# --------------------------------------------------------------------- TPU014
+
+def test_tpu014_positive_device_put_in_traced_code(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(state, batch, target_sharding):
+            x = jax.device_put(batch, target_sharding)
+            return state + x
+    """)
+    (f,) = [f for f in findings if f.rule == "TPU014"]
+    assert f.severity == Severity.ERROR
+    assert "transfer channel" in f.message
+
+
+def test_tpu014_positive_transitively_traced(tmp_path):
+    """device_put in a helper only ever called from traced code."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+
+        def _bounce(x, sh):
+            return jax.device_put(x, sh)
+
+        @jax.jit
+        def step(state, x, sh):
+            return state + _bounce(x, sh)
+    """)
+    assert "TPU014" in codes(findings)
+
+
+def test_tpu014_positive_host_roundtrip_on_step_path(tmp_path):
+    """device_put of a host pull on the hot step path: a full
+    device->host->device round-trip per step (WARNING tier)."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        import numpy as np
+
+        class Engine:
+            def train_batch(self, batch):
+                acts = self.collect()
+                moved = jax.device_put(np.asarray(acts), self.sharding)
+                return self.step_fn(moved)
+    """)
+    (f,) = [f for f in findings if f.rule == "TPU014"]
+    assert f.severity == Severity.WARNING
+    assert "round-trip" in f.message
+
+
+def test_tpu014_negative_host_side_placement(tmp_path):
+    """Init/restore/channel placement outside traced or hot code is the
+    sanctioned idiom."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def init_state(params, sharding):
+            return jax.tree.map(
+                lambda p: jax.device_put(jnp.zeros_like(p), sharding),
+                params)
+
+        def channel_send(payload, placement):
+            return jax.device_put(payload, placement)
+    """)
+    assert "TPU014" not in codes(findings)
+
+
+def test_tpu014_negative_plain_device_put_on_step_path(tmp_path):
+    """A bare device_put of an already-on-host buffer on the step path
+    (offload staging) is not the round-trip shape and stays clean."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+
+        class Tier:
+            def step(self, j):
+                return jax.device_put(self._staging[j], self.shardings[j])
+    """)
+    assert "TPU014" not in codes(findings)

@@ -354,3 +354,28 @@ def test_dstpu_health_flags_column_and_rc(tmp_path, capsys):
     shutil.copy(w0.path, clean / "rank0.hb")
     assert health_main([str(clean)]) == 0
     capsys.readouterr()
+
+
+def test_dstpu_health_stage_column(tmp_path, capsys):
+    """Round-13 satellite: MPMD stage workers stamp a pipeline-stage
+    gauge; `dstpu health` promotes it to a STAGE column (the round-12
+    role=PREFILL/DECODE pattern) so "which stage is that rank" is one
+    glance. Non-pipeline ranks show '-', and the gauge is promoted OUT
+    of the GAUGES column (no duplicate)."""
+    from deepspeed_tpu.launcher.runner import health_main
+    w0 = hb.HeartbeatWriter(str(tmp_path), 0, host="w0", refresh_interval=0)
+    w0.write(hb.PHASE_STEP, 7, force=True, extra={"stage": 0})
+    w1 = hb.HeartbeatWriter(str(tmp_path), 1, host="w1", refresh_interval=0)
+    w1.write(hb.PHASE_STEP, 7, force=True, extra={"stage": 1, "q": 3})
+    w2 = hb.HeartbeatWriter(str(tmp_path), 2, host="w2", refresh_interval=0)
+    w2.write(hb.PHASE_STEP, 7, force=True)
+    rc = health_main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    header = out.splitlines()[0].split()
+    assert header[:3] == ["RANK", "STAGE", "HOST"]
+    rows = {ln.split()[0]: ln.split() for ln in out.splitlines()[1:]
+            if ln.strip()}
+    assert rows["0"][1] == "0" and rows["1"][1] == "1"
+    assert rows["2"][1] == "-"
+    assert "stage=" not in out and "q=3" in out
