@@ -4,7 +4,9 @@
 Wiring sites call ONE function here instead of picking a wire format
 themselves: the engine's ZeRO-2 grad sync calls
 :func:`planned_grad_sync` with the algorithm its init-time resolution
-chose, and the MoE dispatch asks :func:`moe_exchange_spec` at trace time
+chose, the ZeRO-3 param fetch builds its per-leaf chunked gathers via
+:func:`planned_param_gather`, and the MoE dispatch asks
+:func:`moe_exchange_spec` at trace time
 (reading the engine-installed plan context) whether — and how — the
 queue exchange should leave the implicit-SPMD path. Execution lives in
 ``runtime/comm/quantized.py``; policy lives in ``comm_plan/``; this
@@ -17,15 +19,34 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 from ..comm_plan.runtime import active_context, resolve_algo
+from ..runtime.comm.overlap import (make_overlap_gather, overlap_grad_sync,
+                                    OVERLAP_ALGOS)
 from ..runtime.comm.quantized import grad_sync, make_queue_exchange
 
 
 def planned_grad_sync(x, *, mesh, axis="data", algo: str = "int8",
-                      bits: int = 8, block: int = 256, mean: bool = True):
+                      bits: int = 8, block: int = 256, mean: bool = True,
+                      chunks: int = 4):
     """The ZeRO-2 grad-sync entry point: stacked per-rank grads in,
-    reduced (replicated) grads out, wire format per ``algo``."""
+    reduced (replicated) grads out, wire format (and schedule — the
+    ``overlap`` family chunks the sync so no tail-end whole-tensor
+    collective remains) per ``algo``."""
+    if algo in OVERLAP_ALGOS:
+        return overlap_grad_sync(x, mesh=mesh, axis=axis, chunks=chunks,
+                                 algo=algo, bits=bits, block=block,
+                                 mean=mean)
     return grad_sync(x, mesh=mesh, axis=axis, algo=algo, bits=bits,
                      block=block, mean=mean)
+
+
+def planned_param_gather(mesh, axis, dim: int, *, algo: str,
+                         chunks: int = 4, bits: int = 8, block: int = 256):
+    """Per-leaf ZeRO-3 param-fetch executor for the ``overlap`` family:
+    the chunked explicit all-gather (forward) whose autodiff transpose
+    is the chunked grad reduce-scatter (backward) — see
+    ``runtime.comm.overlap.make_overlap_gather``."""
+    return make_overlap_gather(mesh, axis, dim, chunks=chunks, algo=algo,
+                               bits=bits, block=block)
 
 
 def moe_exchange_spec(mesh, nbytes: int

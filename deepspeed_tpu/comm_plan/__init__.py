@@ -4,8 +4,11 @@ The subsystem that decides, per {collective kind, mesh axis, message-size
 bucket}, which wire format a collective runs with:
 
 * :mod:`plan` — :class:`CommPlan`, the JSON-serializable decision table
-  (and the substrate ROADMAP item 2's hand-overlapped schedules will
-  slot into);
+  (round 14: the hand-overlapped schedules landed as the
+  ``overlap``/``overlap_int8`` algorithm family — chunked
+  allgather->matmul for the ZeRO-3 param fetch, chunked grad
+  reduce-scatter for the ZeRO-2 sync, executors in
+  ``runtime/comm/overlap.py``);
 * :mod:`selector` — builds a plan from ``benchmarks/communication.py``
   sweep records (argmin latency per cell, deterministic tie-break) with
   safe size-threshold heuristics where no sweep exists;

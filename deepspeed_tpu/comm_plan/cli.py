@@ -19,19 +19,27 @@ from typing import Dict, List
 
 
 def _sweep_records(ops: List[str], algos: List[str], sizes_mb: List[float],
-                   dtype_name: str, iters: int) -> List[Dict]:
+                   dtype_name: str, iters: int,
+                   mesh_spec: str = "") -> List[Dict]:
     """The grid, executed as autotuning experiments (GridSearchTuner over
-    the op/algo/size space; failed cells are recorded with their error
-    and skipped by the selector, the autotuner's error-result
-    convention)."""
+    the op/algo/axis/size space; failed cells are recorded with their
+    error and skipped by the selector, the autotuner's error-result
+    convention). With ``mesh_spec`` ('data=2,model=4') the grid gains an
+    AXIS dimension — one row per >1-member mesh axis per cell, so
+    hierarchical ICI/DCN selection (e.g. exact on the fast axis, int8 on
+    the slow one) has per-axis measurements to choose from; the plan's
+    wildcard resolution already preferred exact-axis rows, the sweep
+    just never fed it."""
     import jax
     import jax.numpy as jnp
 
     from ..autotuning.autotuner import Autotuner
-    from ..benchmarks.communication import OP_ALGOS, run_op_sweep
+    from ..benchmarks.communication import (OP_ALGOS, build_mesh,
+                                            run_op_sweep, sweep_axes)
 
     dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
              "float16": jnp.float16}[dtype_name]
+    mesh = build_mesh(mesh_spec)
     rows: List[Dict] = []
 
     def runner(cfg: Dict) -> Dict[str, float]:
@@ -39,7 +47,7 @@ def _sweep_records(ops: List[str], algos: List[str], sizes_mb: List[float],
         if algo not in OP_ALGOS.get(op, ()):
             raise ValueError(f"no {algo} implementation for {op}")
         row = run_op_sweep(op, [mb], dtype, iters, algo=algo,
-                           emit=True)[0]
+                           emit=True, mesh=mesh, axis=cfg["axis"])[0]
         rows.append(row)
         return {"throughput": row["busbw_gbps"],
                 "latency_us": row["latency_us"]}
@@ -47,7 +55,8 @@ def _sweep_records(ops: List[str], algos: List[str], sizes_mb: List[float],
     tuner = Autotuner(
         base_config={},
         runner=runner,
-        tuning_space={"op": ops, "algo": algos, "size_mb": sizes_mb},
+        tuning_space={"op": ops, "algo": algos, "size_mb": sizes_mb,
+                      "axis": sweep_axes(mesh)},
         tuner_type="gridsearch")
     tuner.tune()
     n_fail = sum(1 for e in tuner.experiments if e.error)
@@ -67,11 +76,19 @@ def main(argv=None) -> int:
     sw = sub.add_parser("sweep", help="run the op x algo x size grid on "
                                       "this host's devices and write the "
                                       "selected plan")
-    sw.add_argument("--ops", default="all_reduce,reduce_scatter,all_to_all")
-    sw.add_argument("--algos", default="exact,int8")
+    sw.add_argument("--ops", default="all_reduce,all_gather,"
+                                     "reduce_scatter,all_to_all")
+    sw.add_argument("--algos", default="exact,int8,overlap,overlap_int8",
+                    help="wire formats/schedules per op; unsupported "
+                         "(op, algo) pairs are recorded as failed cells "
+                         "and skipped by the selector")
     sw.add_argument("--sizes-mb", default="1,4,16,64")
     sw.add_argument("--dtype", default="float32")
     sw.add_argument("--iters", type=int, default=10)
+    sw.add_argument("--mesh", default="",
+                    help="named mesh spec 'data=2,model=4': sweep each "
+                         ">1-member axis separately (per-axis plan rows "
+                         "for hierarchical meshes); empty = flat 'all'")
     sw.add_argument("--out", default="comm_plan.json",
                     help="plan JSON path (engine: comm_plan.plan_path)")
     sw.add_argument("--record", default="",
@@ -107,7 +124,8 @@ def main(argv=None) -> int:
     ops = [o.strip() for o in args.ops.split(",") if o.strip()]
     algos = [a.strip() for a in args.algos.split(",") if a.strip()]
     sizes = [float(s) for s in args.sizes_mb.split(",")]
-    rows = _sweep_records(ops, algos, sizes, args.dtype, args.iters)
+    rows = _sweep_records(ops, algos, sizes, args.dtype, args.iters,
+                          mesh_spec=args.mesh)
     if args.record:
         from ..benchmarks.communication import record_sweep
         print(f"comm-plan sweep recorded: "

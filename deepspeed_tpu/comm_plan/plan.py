@@ -5,8 +5,9 @@ the algorithm names in :data:`ALGOS`. Kinds are the WIRE ops the
 benchmark sweeps measure (``all_reduce``/``all_gather``/
 ``reduce_scatter``/``all_to_all``); the engine's wiring sites consult
 them through site aliases (``grad_reduce_scatter`` -> ``reduce_scatter``,
-``moe_all_to_all`` -> ``all_to_all``) so a single sweep steers both
-training seams and any future caller of the same wire op.
+``moe_all_to_all`` -> ``all_to_all``, ``param_all_gather`` ->
+``all_gather``) so a single sweep steers every training seam and any
+future caller of the same wire op.
 
 Buckets are ceil(log2(message bytes)) — one decision per octave of
 message size, matching how collective latency curves actually bend (a
@@ -23,22 +24,35 @@ import os
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-#: every algorithm name a plan may mention
-ALGOS = ("exact", "int8", "hierarchical", "onebit")
+#: every algorithm name a plan may mention, ordered safest-first (the
+#: selector's tie-break): exact moves exact whole tensors, overlap moves
+#: exact CHUNKS (same math, hand-pipelined wire schedule — T3-style
+#: chunked allgather->matmul / chunked grad reduce-scatter), int8 and
+#: overlap_int8 put the blockwise-quantized format on the wire
+ALGOS = ("exact", "overlap", "int8", "overlap_int8", "hierarchical",
+         "onebit")
+
+#: algorithms whose wire format is LOSSY — the accuracy guard's exact
+#: latch applies to these only (overlap moves exact values; forcing it
+#: back to a whole-tensor schedule would change nothing numerically)
+QUANTIZED_ALGOS = frozenset(("int8", "overlap_int8", "hierarchical",
+                             "onebit"))
 
 #: algorithms each engine wiring SITE can actually execute. The plan/
 #: selector may know more (the benchmark measures onebit/hierarchical
 #: allreduce too); a site falls back to its own ladder when the chosen
 #: algo is not executable at that seam.
 SITE_ALGOS = {
-    "grad_reduce_scatter": ("exact", "int8"),
+    "grad_reduce_scatter": ("exact", "int8", "overlap", "overlap_int8"),
     "moe_all_to_all": ("exact", "int8"),
+    "param_all_gather": ("exact", "overlap", "overlap_int8"),
 }
 
 #: site alias -> wire kind the sweeps record
 SITE_KIND = {
     "grad_reduce_scatter": "reduce_scatter",
     "moe_all_to_all": "all_to_all",
+    "param_all_gather": "all_gather",
 }
 
 PLAN_VERSION = 1

@@ -46,6 +46,7 @@ class PlanContext:
     bits: int = 8
     block: int = 256
     size_threshold: int = 4 * 2 ** 20
+    overlap_chunks: int = 4            # pieces per overlap-family collective
     resolved: dict = field(default_factory=dict)   # site -> algo (audit)
 
 
@@ -130,17 +131,37 @@ class AccuracyGuard:
 # ---------------------------------------------------------------------------
 
 @contextlib.contextmanager
-def local_region():
-    """Mark the dynamic extent as a shard-local model trace: `
-    ``models.transformer._spec_constraint`` (and everything routed
-    through it) becomes a no-op inside."""
+def local_region(manual_axes=None):
+    """Mark the dynamic extent as a shard-local model trace.
+
+    With ``manual_axes=None`` (the legacy mode — pure-DP stacked step,
+    MPMD stage programs) ``models.transformer._spec_constraint`` (and
+    everything routed through it) becomes a no-op inside: every mesh
+    constraint is meaningless in a fully shard-local trace.
+
+    With ``manual_axes`` a set of axis names (the TP-composed stacked
+    step, round 14), constraints are FILTERED instead: entries naming a
+    manual axis are stripped (naming one inside the region is an error),
+    entries naming auto axes — the model/TP layouts the partial-auto
+    region still honors — survive and apply against the context mesh."""
     prev = getattr(_tls, "local_region", 0)
+    prev_axes = getattr(_tls, "local_region_axes", None)
     _tls.local_region = prev + 1
+    _tls.local_region_axes = (None if manual_axes is None
+                              else frozenset(manual_axes))
     try:
         yield
     finally:
         _tls.local_region = prev
+        _tls.local_region_axes = prev_axes
 
 
 def in_local_region() -> bool:
     return bool(getattr(_tls, "local_region", 0))
+
+
+def local_region_manual_axes():
+    """The active region's manual-axes set, or None for the legacy
+    suppress-everything mode (only meaningful under
+    :func:`in_local_region`)."""
+    return getattr(_tls, "local_region_axes", None)

@@ -11,7 +11,12 @@ Where no sweep covers a query, :func:`heuristic_algo` applies the safe
 size-threshold policy: exact below the threshold (latency-bound regime —
 quantize/dequant overhead and scale traffic buy nothing), int8 above it
 (bandwidth-bound — the 4x payload cut is the win ZeRO++/EQuARX measure),
-and always exact on a single-member axis (nothing to exchange).
+and always exact on a single-member axis (nothing to exchange). The
+``overlap`` family is deliberately NEVER a heuristic verdict: whether a
+hand-pipelined chunk schedule beats the scheduler is a property of the
+host's wire, so overlap is only ever selected from recorded sweep rows
+(whose latency_us is the overlap cell's EXPOSED comm time) or forced by
+an override — never hard-coded.
 """
 
 from __future__ import annotations

@@ -497,9 +497,17 @@ class CommPlanConfig(DeepSpeedConfigModel):
     ``guard_min_grad_norm`` is the accuracy guard: once the observed
     global grad norm drops below it, subsequent steps run the exact
     program (quantization error is no longer small relative to the
-    signal); it costs the per-step metrics pull. ``quant_block`` is the
-    elements-per-scale granularity of the int8 wire format (error is
-    bounded by block absmax / 127 per element)."""
+    signal; the latch applies to the LOSSY algorithms — ``overlap``
+    moves exact values and is exempt); it costs the per-step metrics
+    pull. ``quant_block`` is the elements-per-scale granularity of the
+    int8 wire format (error is bounded by block absmax / 127 per
+    element). Round 14: the ``overlap`` algorithm family (docs/COMM.md)
+    — ``overlap_chunks`` is the pieces each overlapped collective is
+    split into (chunk k+1's wire time hides under chunk k's compute; a
+    static trace constant, so changing it recompiles once, never
+    per-step), and ``overlap_min_leaf_elems`` keeps tiny param leaves
+    on the implicit gather (chunking a bias buys nothing and costs a
+    collective's latency floor per chunk)."""
     enabled: bool = False
     plan_path: Optional[str] = None
     overrides: Dict[str, str] = Field(default_factory=dict)
@@ -507,6 +515,8 @@ class CommPlanConfig(DeepSpeedConfigModel):
     quant_block: int = 256
     size_threshold_mb: float = 4.0     # heuristic regime boundary
     guard_min_grad_norm: float = 0.0   # 0 = guard off
+    overlap_chunks: int = 4            # pieces per overlapped collective
+    overlap_min_leaf_elems: int = 4096  # smaller leaves: implicit gather
 
 
 class ProgressiveLayerDropConfig(DeepSpeedConfigModel):

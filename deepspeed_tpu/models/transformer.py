@@ -497,10 +497,28 @@ def _spec_constraint(x, spec: P):
     # (manual over the DP axes): every mesh constraint is meaningless
     # there — and naming a manual axis in one is an error on jax lines
     # without the abstract-mesh probe below — so the local-region flag
-    # turns them all off for that trace
-    from ..comm_plan.runtime import in_local_region
+    # turns them all off for that trace. The TP-composed stacked step
+    # (round 14) instead passes its manual-axes set: entries naming a
+    # manual axis are stripped, the surviving TP entries resolve against
+    # the partial-auto region's context mesh.
+    from ..comm_plan.runtime import in_local_region, local_region_manual_axes
     if in_local_region():
-        return x
+        manual = local_region_manual_axes()
+        if manual is None:
+            return x
+        filtered = []
+        for entry in spec:
+            if entry is None:
+                filtered.append(None)
+                continue
+            names = tuple(n for n in
+                          ((entry,) if isinstance(entry, str) else entry)
+                          if n not in manual)
+            filtered.append(None if not names
+                            else names[0] if len(names) == 1 else names)
+        if not any(e is not None for e in filtered):
+            return x
+        return jax.lax.with_sharding_constraint(x, P(*filtered))
     # jax-version compat: get_abstract_mesh moved under jax.sharding only in
     # newer releases; older trees keep it in jax._src.mesh (and lack
     # sharding-in-types entirely — see the typeof probe below)

@@ -1,7 +1,13 @@
-"""Specialized runtime communication: compressed (1-bit/int8) collectives
-and the blockwise-int8 reduce-scatter / all-to-all family."""
+"""Specialized runtime communication: compressed (1-bit/int8)
+collectives, the blockwise-int8 reduce-scatter / all-to-all family, and
+the chunked ``overlap`` schedules (hand-pipelined allgather->matmul and
+grad reduce-scatter)."""
 
 from .compressed import compressed_allreduce, quantized_allreduce
+from .overlap import (
+    make_overlap_gather,
+    overlap_grad_sync,
+)
 from .quantized import (
     grad_sync,
     make_queue_exchange,
@@ -10,5 +16,6 @@ from .quantized import (
 )
 
 __all__ = ["compressed_allreduce", "quantized_allreduce", "grad_sync",
-           "make_queue_exchange", "quantized_all_to_all",
+           "make_queue_exchange", "make_overlap_gather",
+           "overlap_grad_sync", "quantized_all_to_all",
            "quantized_reduce_scatter"]

@@ -114,6 +114,22 @@ def rs_quantized_local(x_flat: jnp.ndarray, axis, n: int, *,
     return served, pad
 
 
+def rs_exact_local(x_flat: jnp.ndarray, axis, n: int, *,
+                   mean: bool = False) -> Tuple[jnp.ndarray, int]:
+    """:func:`rs_quantized_local`'s contract with an EXACT f32 wire —
+    one reduce-scatter hop via the same dim-0 all-to-all + local
+    reduce. Shared by ``grad_sync(algo="exact")`` and every per-segment
+    hop of the overlap executors (``overlap.py``), so the pad/reduce
+    semantics live in exactly one place."""
+    c = -(-x_flat.size // n)
+    pad = n * c - x_flat.size
+    chunks = jnp.pad(x_flat.astype(jnp.float32), (0, pad)).reshape(n, c)
+    recv = jax.lax.all_to_all(chunks, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+    served = jnp.mean(recv, axis=0) if mean else jnp.sum(recv, axis=0)
+    return served, pad
+
+
 def ag_quantized_local(x_flat: jnp.ndarray, axis, *, bits: int = 8,
                        block: int = DEFAULT_BLOCK) -> jnp.ndarray:
     """Quantized all-gather hop: each rank contributes its flat chunk,
@@ -208,13 +224,7 @@ def grad_sync(x: jnp.ndarray, *, mesh, axis="data", algo: str = "int8",
                                              block=block, mean=mean)
             full = ag_quantized_local(served, axes, bits=bits, block=block)
         else:
-            c = -(-flat.size // n)
-            pad = n * c - flat.size
-            chunks = jnp.pad(flat, (0, pad)).reshape(n, c)
-            recv = jax.lax.all_to_all(chunks, axes, split_axis=0,
-                                      concat_axis=0, tiled=True)
-            served = (jnp.mean(recv, axis=0) if mean
-                      else jnp.sum(recv, axis=0))
+            served, pad = rs_exact_local(flat, axes, n, mean=mean)
             full = jax.lax.all_gather(served, axes).reshape(-1)
         out = full[:flat.size].reshape(x0.shape).astype(x0.dtype)
         return out

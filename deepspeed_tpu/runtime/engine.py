@@ -450,6 +450,7 @@ class DeepSpeedEngine:
         self.comm_plan_ctx = None
         self._cp_guard = None
         self._train_step_q = None
+        self._overlap_gathers = None
         cp = self.config.comm_plan
         if cp.enabled:
             from ..comm_plan import CommPlan
@@ -458,15 +459,19 @@ class DeepSpeedEngine:
             self.comm_plan_ctx = PlanContext(
                 plan=plan, overrides=dict(cp.overrides or {}),
                 bits=cp.quant_bits, block=cp.quant_block,
-                size_threshold=int(cp.size_threshold_mb * 2 ** 20))
+                size_threshold=int(cp.size_threshold_mb * 2 ** 20),
+                overlap_chunks=cp.overlap_chunks)
             self.apply_fn = self._wrap_apply_comm_plan(self.apply_fn)
             self._resolve_grad_sync_algo(params_f32)
+            self._resolve_param_gather(params_f32)
             if cp.guard_min_grad_norm > 0:
                 self._cp_guard = AccuracyGuard(cp.guard_min_grad_norm)
             log_dist(
                 "comm plan: "
                 f"plan={'recorded:' + cp.plan_path if cp.plan_path else 'heuristic'} "
                 f"grad_sync={self.comm_plan_ctx.resolved.get('grad_reduce_scatter')} "
+                f"param_gather={self.comm_plan_ctx.resolved.get('param_all_gather')} "
+                f"overlap_chunks={cp.overlap_chunks} "
                 f"overrides={dict(cp.overrides or {})} "
                 f"guard={cp.guard_min_grad_norm}", ranks=[0])
 
@@ -787,8 +792,11 @@ class DeepSpeedEngine:
 
         def scaled_loss(p):
             # qwZ: int8 gather inside the differentiated closure so the
-            # custom-vjp slice maps grads back to the shards
+            # custom-vjp slice maps grads back to the shards; overlap:
+            # chunked gather inside it so its transpose reduce-scatters
+            # the grads in the same chunks
             p = self._qw_gather_params(p)
+            p = self._overlap_gather_params(p)
             if self.compression_spec is not None:
                 from ..compression import apply_compression
                 p = apply_compression(
@@ -949,8 +957,12 @@ class DeepSpeedEngine:
     def _grad_sync_envelope(self) -> Tuple[bool, str]:
         """Can the explicit stacked-grads sync replace the implicit XLA
         grad reduction here? Mirrors the 1-bit runner's envelope: the
-        stacked per-rank layout needs pure data parallelism and a fused
-        step the engine owns."""
+        stacked per-rank layout needs a fused step the engine owns, and
+        data parallelism optionally COMPOSED with TP (round 14): the
+        model axis stays auto in the partial-auto stacked region, each
+        leaf syncing over its own stacked layout — but only where native
+        ``jax.shard_map`` exists (the 0.4.x legacy adapter aborts inside
+        XLA on auto-TP operands; see utils/jax_compat)."""
         if self.onebit is not None:
             return False, "the 1-bit runner owns the train step"
         if self.offload is not None:
@@ -961,11 +973,20 @@ class DeepSpeedEngine:
         ok, why = self.zero_policy.grad_sync_viable()
         if not ok:
             return False, why
-        for ax in ("model", "seq", "pipe"):
+        for ax in ("seq", "pipe"):
             if self.mesh_mgr.shape[ax] != 1:
                 return False, (f"mesh axis '{ax}' has size "
-                               f"{self.mesh_mgr.shape[ax]} (pure data "
-                               "parallelism required)")
+                               f"{self.mesh_mgr.shape[ax]} (data "
+                               "parallelism, optionally with TP, "
+                               "required)")
+        if self.mesh_mgr.shape["model"] != 1 and \
+                not hasattr(jax, "shard_map"):
+            return False, (f"mesh axis 'model' has size "
+                           f"{self.mesh_mgr.shape['model']}: the "
+                           "TP-composed explicit sync needs native "
+                           "jax.shard_map (this jaxlib's legacy "
+                           "shard_map aborts inside XLA on auto-TP "
+                           "operands)")
         if self.mesh_mgr.shape["data"] <= 1:
             return False, "a single DP rank has nothing to sync"
         return True, ""
@@ -973,7 +994,10 @@ class DeepSpeedEngine:
     def _resolve_grad_sync_algo(self, params_f32) -> None:
         """Init-time resolution of the ZeRO-2 grad-sync wire format
         (programs are static, so the verdict is per-engine, modulo the
-        accuracy guard's host-side exact fallback)."""
+        accuracy guard's host-side exact fallback). A verdict outside
+        the envelope — forced or selected — DEGRADES to exact with a
+        warning (round 14: selection and overrides must never brick a
+        launch; the envelope test pins which configs degrade)."""
         from ..comm_plan.runtime import resolve_algo
         ctx = self.comm_plan_ctx
         itemsize = jnp.dtype(self.grad_accum_dtype).itemsize
@@ -989,30 +1013,111 @@ class DeepSpeedEngine:
                 forced = any((ctx.overrides or {}).get(k)
                              for k in ("grad_reduce_scatter",
                                        "reduce_scatter"))
-                if forced:
-                    raise ValueError(
-                        f"comm_plan forces a quantized grad sync but "
-                        f"{why}")
                 logger.warning(
-                    "comm_plan: grad sync selected %r but %s — running "
-                    "exact", algo, why)
+                    "comm_plan: grad sync %s %r but %s — running exact",
+                    "forced" if forced else "selected", algo, why)
                 algo = "exact"
                 ctx.resolved["grad_reduce_scatter"] = "exact"
         self._grad_sync_algo = algo
 
+    # --------------------------------------------- comm-plan param gather
+
+    def _param_gather_viable(self) -> Tuple[bool, str]:
+        """Engine-side envelope for the explicit chunked ZeRO-3 param
+        fetch (per-leaf checks live in ``_resolve_param_gather``)."""
+        if self.zero_policy.stage < 3:
+            return False, ("ZeRO stage < 3 keeps compute params whole — "
+                           "there is no param gather to overlap")
+        if self._qw_gathers is not None:
+            return False, ("zero_quantized_weights already owns the "
+                           "explicit param gather (qwZ)")
+        if self.offload is not None:
+            return False, "offload mode splits the step across host/device"
+        if self.onebit is not None:
+            return False, "the 1-bit runner owns the train step"
+        return True, ""
+
+    def _resolve_param_gather(self, params_f32) -> None:
+        """Per-LEAF init-time resolution of the ZeRO-3 param-fetch wire
+        schedule: each ZeRO-sharded leaf queries the plan in its own
+        size bucket (site ``param_all_gather`` -> kind ``all_gather``),
+        and leaves the overlap family covers get an explicit chunked
+        gather replacing the implicit whole-tensor stage-3 allgather.
+        Leaves outside the per-leaf envelope (TP-composed specs, tiny
+        leaves under ``overlap_min_leaf_elems``) stay implicit —
+        downgrade, never raise."""
+        from ..comm.planned import planned_param_gather
+        from ..comm_plan.runtime import resolve_algo
+        ctx = self.comm_plan_ctx
+        cp = self.config.comm_plan
+        ctx.resolved.setdefault("param_all_gather", "exact")
+        ok, why = self._param_gather_viable()
+        if not ok:
+            forced = any((ctx.overrides or {}).get(k)
+                         for k in ("param_all_gather", "all_gather"))
+            if forced and self.zero_policy.stage >= 3:
+                logger.warning(
+                    "comm_plan: param gather forced but %s — running the "
+                    "implicit gather", why)
+            return
+        itemsize = jnp.dtype(self.compute_dtype).itemsize
+        n_overlap = 0
+
+        def per_leaf(sharding, leaf):
+            nonlocal n_overlap
+            site = self.zero_policy.zero_gather_site(sharding.spec)
+            numel = int(np.prod(np.shape(leaf)) if np.shape(leaf) else 1)
+            if site is None or numel < cp.overlap_min_leaf_elems:
+                return None
+            zero_dim, zero_names = site
+            algo = resolve_algo(ctx, "param_all_gather", "data",
+                                numel * itemsize,
+                                axis_size=int(np.prod(
+                                    [self.mesh_mgr.shape[a]
+                                     for a in zero_names])))
+            if algo not in ("overlap", "overlap_int8"):
+                return None
+            n_overlap += 1
+            return planned_param_gather(
+                self.mesh, zero_names, zero_dim, algo=algo,
+                chunks=cp.overlap_chunks, bits=cp.quant_bits,
+                block=cp.quant_block)
+
+        gathers = jax.tree.map(per_leaf, self.param_shardings, params_f32)
+        if n_overlap:
+            self._overlap_gathers = gathers
+        # the aggregate audit tag: overlap iff ANY leaf left the
+        # implicit path (per-leaf verdicts differ across size buckets)
+        ctx.resolved["param_all_gather"] = (
+            "overlap" if n_overlap else "exact")
+
+    def _overlap_gather_params(self, params):
+        if self._overlap_gathers is None:
+            return params
+        return jax.tree.map(
+            lambda fn, p: p if fn is None else fn(p),
+            self._overlap_gathers, params,
+            is_leaf=lambda x: x is None or callable(x))
+
     def _make_train_step_quantized(self):
         """The comm-plan train step: per-rank grads come out of a
         shard_map UNREDUCED (the 1-bit runner's stacked layout), the sync
-        is the explicit blockwise-int8 reduce-scatter + all-gather
-        (``comm.planned_grad_sync``), and everything from the synced
+        is the explicit reduce-scatter + all-gather in the resolved wire
+        format — blockwise-int8, or the chunked ``overlap`` schedule
+        (``comm.planned_grad_sync``) — and everything from the synced
         grads on — clip, optimizer, skip arms, sentinel — is the shared
         ``_finalize_step`` tail, so the two programs differ ONLY in how
-        grad bytes cross the wire."""
+        grad bytes cross the wire. With TP composed (round 14, native
+        jax.shard_map only) the model axis stays AUTO: params ride in
+        TP-sharded, the model trace keeps its TP constraints (the
+        local region strips only the manual DP axes), and each grad
+        leaf syncs over its own stacked layout."""
         gas = self.config.gradient_accumulation_steps
         axes = self.zero_policy.grad_sync_axes()
         cp = self.config.comm_plan
         algo = self._grad_sync_algo
         mesh = self.mesh
+        tp_composed = self.mesh_mgr.shape["model"] > 1
         from ..comm.planned import planned_grad_sync
         from ..comm_plan.runtime import local_region
         from ..utils.jax_compat import shard_map
@@ -1025,10 +1130,12 @@ class DeepSpeedEngine:
                 micro, rr = xs
 
                 def scaled_loss(p):
-                    # shard-local model trace: mesh constraints inside
-                    # the model don't apply here (local_region makes
-                    # _spec_constraint a no-op)
-                    with local_region():
+                    # shard-local model trace: manual-axis mesh
+                    # constraints don't apply here (local_region makes
+                    # _spec_constraint a no-op / strips the manual axes
+                    # when TP rides along as an auto axis)
+                    with local_region(manual_axes=set(axes)
+                                      if tp_composed else None):
                         out = self.apply_fn(p, micro, rr, True)
                         loss = self.loss_fn(out, micro)
                     return (loss * scale).astype(jnp.float32), loss
@@ -1055,7 +1162,8 @@ class DeepSpeedEngine:
             synced = jax.tree.map(
                 lambda g: planned_grad_sync(
                     g, mesh=mesh, axis=axes, algo=algo,
-                    bits=cp.quant_bits, block=cp.quant_block, mean=True),
+                    bits=cp.quant_bits, block=cp.quant_block, mean=True,
+                    chunks=cp.overlap_chunks),
                 grads_st)
             grads_sum = jax.tree.map(
                 lambda g, s: lax.with_sharding_constraint(
@@ -1070,16 +1178,22 @@ class DeepSpeedEngine:
         return jax.jit(train_step, donate_argnums=(0,))
 
     def _active_train_step(self):
-        """Pick the per-step program: the quantized-sync step when the
+        """Pick the per-step program: the explicit-sync step when the
         plan routed it, unless the accuracy guard latched exact (both
-        stay compiled — switching is free after the first use of each)."""
-        if (self.comm_plan_ctx is not None
-                and getattr(self, "_grad_sync_algo", "exact") != "exact"
-                and not (self._cp_guard is not None
-                         and self._cp_guard.use_exact)):
+        stay compiled — switching is free after the first use of each).
+        The guard applies to LOSSY wire formats only: ``overlap`` moves
+        exact values, so forcing it back to the whole-tensor schedule
+        would change nothing numerically."""
+        from ..comm_plan.plan import QUANTIZED_ALGOS
+        algo = getattr(self, "_grad_sync_algo", "exact")
+        guard_latched = (self._cp_guard is not None
+                         and self._cp_guard.use_exact
+                         and algo in QUANTIZED_ALGOS)
+        if (self.comm_plan_ctx is not None and algo != "exact"
+                and not guard_latched):
             if self._train_step_q is None:
                 self._train_step_q = self._make_train_step_quantized()
-            return self._train_step_q, self._grad_sync_algo
+            return self._train_step_q, algo
         return self._train_step, "exact"
 
     def _make_grads_step(self):
@@ -1174,6 +1288,7 @@ class DeepSpeedEngine:
         forward."""
         def fwd_loss(params, batch, rng, step):
             params = self._qw_gather_params(params)
+            params = self._overlap_gather_params(params)
             if self.compression_spec is not None:
                 from ..compression import apply_compression
                 params = apply_compression(params, self.compression_spec, step)
@@ -1192,6 +1307,7 @@ class DeepSpeedEngine:
     def _make_eval_step(self):
         def eval_step(params, batch, rng, step):
             params = self._qw_gather_params(params)
+            params = self._overlap_gather_params(params)
             if self.compression_spec is not None:
                 from ..compression import apply_compression
                 params = apply_compression(params, self.compression_spec, step)
@@ -1355,9 +1471,13 @@ class DeepSpeedEngine:
                     self.state, micros, self.next_rng(), self._current_lr(),
                     limit)
             if self.comm_plan_ctx is not None:
-                # host-side audit tag: which wire format this step's grad
-                # sync actually ran (tests + the guard's visibility)
+                # host-side audit tags: which wire format this step's grad
+                # sync actually ran (tests + the guard's visibility), and
+                # whether the ZeRO-3 param fetch left the implicit path
                 metrics["grad_sync_algo"] = sync_algo
+                metrics["param_gather_algo"] = \
+                    self.comm_plan_ctx.resolved.get("param_all_gather",
+                                                    "exact")
         self.tput_timer.stop(sync=metrics["loss"])
         if self.config.wall_clock_breakdown:
             # the jitted step is one program: the breakdown the reference

@@ -228,10 +228,13 @@ class ZeroShardingPolicy:
 
     def grad_sync_viable(self) -> Tuple[bool, str]:
         """Sharding-side envelope for the explicit sync: the stacked
-        per-rank layout needs whole compute params (stage <= 2) and a
-        single-member expert axis (expert params' grads must not be
-        averaged over 'expert'). The engine adds its runtime-side checks
-        (offload/1-bit/compression) on top."""
+        per-rank layout needs whole-per-DP-rank compute params (stage
+        <= 2; TP sharding composes — the model axis stays auto in the
+        stacked region and each leaf syncs over its own stacked layout,
+        round 14) and a single-member expert axis (expert params' grads
+        must not be averaged over 'expert'). The engine adds its
+        runtime-side checks (offload/1-bit/compression, the
+        native-shard_map gate for the TP composition) on top."""
         if self.stage > 2:
             return False, ("ZeRO-3 shards compute params; the stacked "
                            "local-grad layout needs them whole per rank")
@@ -240,6 +243,27 @@ class ZeroShardingPolicy:
                            f"{self.mm.shape['expert']}: expert-param "
                            "grads must not be mean-reduced over it")
         return True, ""
+
+    def zero_gather_site(self, spec: P):
+        """(dim, zero_axes) of the single ZeRO-sharded dim of a
+        compute-param spec, or None — the per-leaf envelope of the
+        EXPLICIT stage-3 param gather (comm-plan ``overlap`` family,
+        docs/COMM.md): a leaf qualifies only when exactly one dim is
+        sharded and only over ZeRO axes. TP-composed leaves stay on the
+        implicit gather (the manual region would have to name an auto
+        axis), replicated leaves (persistence threshold) have nothing
+        to gather."""
+        site = None
+        for dim, entry in enumerate(spec):
+            if entry is None:
+                continue
+            names = (entry,) if isinstance(entry, str) else tuple(entry)
+            if any(a not in ZERO_AXES for a in names):
+                return None           # TP-composed: implicit path
+            if site is not None:
+                return None           # sharded on two dims: implicit path
+            site = (dim, names)
+        return site
 
     # -- pytree-level helpers -------------------------------------------------
 

@@ -79,8 +79,14 @@ def test_hf_opt_parity():
     np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_hf_bloom_parity():
-    """ALiBi attention + embedding LayerNorm + head-major fused qkv."""
+    """ALiBi attention + embedding LayerNorm + head-major fused qkv.
+
+    slow (round-14 budget sweep, 11s): the cheaper tier-1 cousins are
+    the other arch parities in this file (gpt2/llama/...) and the ALiBi
+    kernel parity in test_flash_attention.py / routing in
+    test_attention_routing.py."""
     hf_cfg = transformers.BloomConfig(
         vocab_size=96, hidden_size=32, n_layer=2, n_head=4)
     hf = transformers.BloomForCausalLM(hf_cfg).eval()

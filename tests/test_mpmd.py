@@ -308,11 +308,16 @@ def test_mpmd_model_matches_plain_autodiff():
                                    atol=2e-4, err_msg=str(pa))
 
 
+@pytest.mark.slow
 def test_mpmd_engine_trains_and_8step_losses_match_plain_engine():
     require_devices(2)
     """Engine-level acceptance on shard_map-less hosts: 8 training steps
     under placement='mpmd' descend and track a NON-pipelined engine fed
-    identical batches (same init, same gas) step for step."""
+    identical batches (same init, same gas) step for step.
+
+    slow (round-14 budget sweep, 25s): the cheaper tier-1 cousins are
+    test_mpmd_model_matches_plain_autodiff (single-step value+grad
+    parity) and test_two_process_mpmd_two_stage_run (engine e2e)."""
     kw = _tiny_kw()
     piped, cfg = build_pipelined_model("gpt2-tiny", pp=2, n_micro=4, **kw)
     engine = _mpmd_engine(piped)
@@ -376,11 +381,15 @@ def test_mpmd_engine_loss_parity_vs_spmd_pipeline_engine():
         assert abs(ls - lm) < 2e-4, (i, ls, lm)
 
 
+@pytest.mark.slow
 def test_mpmd_model_remat_matches_plain_autodiff():
     require_devices(2)
     """remat=True models run the MPMD placement unchanged (the fused
     per-stage backward IS the recompute regime) — values still match
-    plain autodiff."""
+    plain autodiff.
+
+    slow (round-14 budget sweep, 13s): the cheaper tier-1 cousin is
+    test_mpmd_model_matches_plain_autodiff (same parity, remat off)."""
     kw = _tiny_kw(remat=True)
     plain, _ = build_model("gpt2-tiny", scan_layers=True, **kw)
     piped, cfg = build_pipelined_model("gpt2-tiny", pp=2, n_micro=4, **kw)
