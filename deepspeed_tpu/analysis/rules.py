@@ -1,4 +1,4 @@
-"""graftlint rules TPU001–TPU010 and TPU014 (TPU011–013 live in
+"""graftlint rules TPU001–TPU010, TPU014 and TPU015 (TPU011–013 live in
 rules_collective.py).
 
 Each rule targets one class of bug that regresses the gas-amortized train
@@ -917,6 +917,127 @@ class DevicePutInStepRule(Rule):
                         "(device_put of a host pull): route the transfer "
                         "through the MPMD channel or keep the value on "
                         "device", severity=Severity.WARNING)
+
+
+@register
+class UnboundedBlockingRule(Rule):
+    """TPU015 — unbounded blocking call in a supervision module.
+
+    Supervisors, watchdogs, fleets and elastic agents exist to convert
+    hangs into diagnosable exits — so THEIR OWN code must never block
+    without a deadline. A ``lock.acquire()`` with no timeout, a
+    ``queue.get()`` that can wait forever, an ``Event.wait()`` with no
+    bound or a ``thread.join()`` without ``timeout=`` turns the
+    component that detects wedges into one: the PR-6 review passes fixed
+    exactly this class by hand three times (the heartbeat writer's exit
+    paths, the watchdog's terminal stamp, the preemption handler's
+    self-deadlocking re-acquire). The rule fires only in the supervision
+    modules (``supervisor.py`` / ``watchdog.py`` / ``fleet.py`` /
+    ``elastic_agent.py`` / ``straggler.py`` / the MPMD ``driver.py``) —
+    ordinary code is allowed to wait.
+
+    Receiver-name vocabulary keeps the check precise: ``.acquire()`` on
+    lock-ish names, ``.wait()`` on event/condition-ish names (a
+    ``proc.wait()`` on a Popen is the monitor thread's whole job and is
+    NOT flagged), ``.get()`` on queue-ish names, and ANY zero-argument
+    ``.join()`` (string/path joins always carry an argument; a bare
+    thread join is exactly the target). Calls carrying a ``timeout``
+    (kwarg, or a positional in the method's timeout SLOT) or
+    ``blocking=False`` are bounded and clean — but ``acquire(True)``,
+    ``get(1)`` and ``wait(None)`` are explicit spellings of "block
+    forever" and stay flagged.
+    """
+
+    code = "TPU015"
+    name = "unbounded-blocking"
+    severity = Severity.WARNING
+    summary = "unbounded blocking call in a supervision module"
+
+    #: files whose job is supervision — the only place the rule fires
+    _MODULES = ("supervisor.py", "watchdog.py", "fleet.py",
+                "elastic_agent.py", "straggler.py", "driver.py")
+    _LOCKISH = re.compile(r"lock|mutex|sem", re.I)
+    _EVENTISH = re.compile(r"evt|event|done|stop|ready|cond|barrier|sig",
+                           re.I)
+    _QUEUEISH = re.compile(r"queue|fifo|inbox|mailbox|chan|^q$|_q$", re.I)
+
+    @staticmethod
+    def _receiver(func: ast.Attribute) -> str:
+        v = func.value
+        if isinstance(v, ast.Name):
+            return v.id
+        if isinstance(v, ast.Attribute):
+            return v.attr
+        return ""
+
+    @staticmethod
+    def _bounded(node: ast.Call) -> bool:
+        """A timeout (kwarg, or a positional in the TIMEOUT slot) or
+        blocking=False makes the call bounded/non-blocking. Positional
+        slots are method-shaped: ``acquire``/``get`` take
+        ``(blocking, timeout)`` — a lone positional is just an explicit
+        blocking flag, so ``acquire(True)`` / ``get(1)`` stay flagged —
+        while ``wait`` takes ``(timeout)``, where an explicit ``None``
+        (``wait(None)``) spells unbounded."""
+        for kw in node.keywords:
+            if kw.arg == "timeout":
+                return True
+            if kw.arg in ("blocking", "block") and isinstance(
+                    kw.value, ast.Constant) and kw.value.value is False:
+                return True
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and arg.value is False:
+                return True           # acquire(False) / get(False)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("acquire", "get"):
+            return len(node.args) >= 2    # acquire(True, 5) / get(1, 2)
+        if not node.args:
+            return False
+        first = node.args[0]
+        return not (isinstance(first, ast.Constant)
+                    and first.value is None)  # wait(None) blocks forever
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        base = module.rel_path.rsplit("/", 1)[-1]
+        if base not in self._MODULES:
+            return
+        for node in module.all_calls:
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            recv = self._receiver(f)
+            if f.attr == "join":
+                if node.args or node.keywords:
+                    continue          # bounded join, or a str/path join
+                yield self.finding(
+                    module, node,
+                    f"{recv or 'thread'}.join() without timeout= in a "
+                    "supervision module: a wedged thread blocks the "
+                    "supervisor that exists to catch wedges — bound it "
+                    "and handle the still-alive case")
+                continue
+            if self._bounded(node):
+                continue
+            if f.attr == "acquire" and self._LOCKISH.search(recv):
+                yield self.finding(
+                    module, node,
+                    f"{recv}.acquire() without timeout= in a supervision "
+                    "module: a holder wedged in I/O (or the same thread "
+                    "re-entering from a signal handler) deadlocks the "
+                    "exit path — acquire(timeout=...) and degrade")
+            elif f.attr == "wait" and self._EVENTISH.search(recv):
+                yield self.finding(
+                    module, node,
+                    f"{recv}.wait() without a timeout in a supervision "
+                    "module: an event that never fires parks this thread "
+                    "forever — wait(timeout) in a loop keeps the "
+                    "monitor's own liveness")
+            elif f.attr == "get" and self._QUEUEISH.search(recv):
+                yield self.finding(
+                    module, node,
+                    f"{recv}.get() without timeout= in a supervision "
+                    "module: an empty queue blocks forever — "
+                    "get(timeout=...) and re-check the stop flag")
 
 
 @register

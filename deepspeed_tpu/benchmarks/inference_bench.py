@@ -27,7 +27,8 @@ prefill for the serving rows (mode column records it).
         [--preset gpt2-125m] [--batches 1,8] [--seqs 128,1024] [--new 64]
     python -m deepspeed_tpu.benchmarks.inference_bench --poisson \
         [--rates 2,8] [--requests 64] [--prompt 128] [--new 64] \
-        [--fleet 3] [--no-fail-replica] [--chunk 0] [--record PATH]
+        [--fleet 3] [--no-fail-replica] [--slow-replica [--slow-ms 250]] \
+        [--chunk 0] [--record PATH]
 """
 
 from __future__ import annotations
@@ -236,6 +237,7 @@ def run_poisson_fleet(preset: str, rate: float, num_requests: int,
                       prompt_len: int, new_tokens: int, replicas: int = 2,
                       serving: Optional[dict] = None,
                       fail_replica: bool = True, seed: int = 0,
+                      slow_replica: bool = False, slow_ms: int = 250,
                       model_kwargs: Optional[dict] = None) -> dict:
     """Poisson load against the supervised multi-replica fleet
     (serving/fleet.py), with an optional failure-injection leg: once a
@@ -249,7 +251,16 @@ def run_poisson_fleet(preset: str, rate: float, num_requests: int,
         inference_bench poisson_fleet: {"rate": ..., "replicas": ...,
             "tps_before": ..., "tps_during": ..., "tps_after": ...,
             "requeues": ..., "deaths": ..., ...}
-    """
+
+    ``slow_replica`` (round 15, the straggler defense) injects a
+    DEGRADED replica instead of a dead one: the keyed
+    ``serve.replica_slow`` failpoint sleeps ``slow_ms`` per worker
+    iteration (times=0, forever) so the victim keeps serving — slowly —
+    until the FleetSupervisor's relative-slowness detector DRAINS it
+    (requeue + warmed restart). The row's mode is
+    ``poisson_fleet_slow``; ``drained_at_s`` is the detection instant
+    and ``recovered_at_s`` the warmed restart, so the degraded window
+    tokens/s is directly readable."""
     from ..models import build_model
     from ..serving.fleet import ServingFleet
     from ..testing import chaos
@@ -267,6 +278,26 @@ def run_poisson_fleet(preset: str, rate: float, num_requests: int,
     # snappy recovery for the bench window (production defaults are lazier)
     fleet_cfg.setdefault("poll_interval", 0.05)
     fleet_cfg.setdefault("heartbeat_interval", 0.05)
+    if slow_replica:
+        # the drain needs the detector on. Windows run at poll cadence,
+        # so consecutive windows are CORRELATED samples of the same
+        # rolling gauge — strike_window must be wide enough to span a
+        # gauge turnover, and rel_threshold generous: in-process
+        # replicas on a shared host are anti-correlated by construction
+        # (one replica's step starves the other), which is noise a
+        # chip-per-replica deployment doesn't have
+        fleet_cfg.setdefault("straggler", {
+            "enabled": True, "warmup": 3, "strike_window": 4,
+            "cooldown": 20, "rel_threshold": 2.5})
+        # the SILENCE detector must not race the straggler drain: a
+        # degraded replica still stamps (slowly), and on a starved bench
+        # host the default 10s would flap healthy replicas long before
+        # the relative detector earns its verdict
+        fleet_cfg.setdefault("heartbeat_timeout", 300.0)
+        # both replicas must actually CARRY work for relative detection
+        # to mean anything: with the default 8 lanes one replica can
+        # swallow a whole small bench run at admission
+        scfg.setdefault("max_batch", 2)
     scfg["fleet"] = fleet_cfg
     flt = ServingFleet(cfg, params, serving=scfg)
     flt.start()
@@ -295,10 +326,27 @@ def run_poisson_fleet(preset: str, rate: float, num_requests: int,
             next_i += 1
         done = sum(1 for r in reqs if r.done)
         timeline.append((now, flt.stats["tokens_emitted"]))
-        if (fail_replica and killed_at is None
+        # the slow leg additionally waits for the victim to HOLD lanes:
+        # slowing an idle replica degrades nothing and detects nothing
+        victim_busy = (not slow_replica
+                       or bool(flt._replicas[int(kill_target)].inflight))
+        if ((fail_replica or slow_replica) and killed_at is None
+                and victim_busy
                 and done >= max(num_requests // 3, 1)):
-            chaos.arm("serve.replica_kill", "raise", match=kill_target)
+            if slow_replica:
+                # degraded, not dead: the victim keeps serving at
+                # sleep-inflated step times until the straggler drain
+                chaos.arm("serve.replica_slow", "sleep", ms=int(slow_ms),
+                          times=0, match=kill_target)
+            else:
+                chaos.arm("serve.replica_kill", "raise", match=kill_target)
             killed_at = now
+        if (slow_replica and killed_at is not None
+                and flt.stats["deaths"] > base["deaths"]
+                and chaos.armed()):
+            # drained: lift the injection so the warmed replacement
+            # rejoins at full speed (the recovery the row measures)
+            chaos.disarm("serve.replica_slow")
         if next_i >= num_requests and done >= num_requests:
             break
         time.sleep(0.005)
@@ -312,6 +360,7 @@ def run_poisson_fleet(preset: str, rate: float, num_requests: int,
                and time.perf_counter() - t_wait < 10.0):
             time.sleep(0.01)
     chaos.disarm("serve.replica_kill")
+    chaos.disarm("serve.replica_slow")
 
     def _tps(t_lo, t_hi):
         if t_hi - t_lo <= 0:
@@ -325,15 +374,19 @@ def run_poisson_fleet(preset: str, rate: float, num_requests: int,
         return round((hi[1] - lo[1]) / (hi[0] - lo[0]), 1)
 
     # recovery instant: the death ledger's restart stamp, in bench time
-    t_rec = None
+    # (for the slow leg also the DRAIN instant — detection, before the
+    # warmed restart — so the degraded window is directly readable)
+    t_rec = t_drain = None
     if flt.deaths:
         rts = flt.deaths[-1]["restarted_ts"] or flt.deaths[-1]["detected_ts"]
         t_rec = rts - t0_mono
+        t_drain = flt.deaths[-1]["detected_ts"] - t0_mono
     lat = sorted(r.finish_ts - (t0_mono + arr)
                  for r, arr in zip(reqs, arrivals) if r.finish_ts)
     n_chips = jax.device_count()
+    mode = "poisson_fleet_slow" if slow_replica else "poisson_fleet"
     row = {
-        "mode": "poisson_fleet",
+        "mode": mode,
         "preset": preset, "rate": float(rate), "replicas":
             int(fleet_cfg["replicas"]), "requests": num_requests,
         "prompt": prompt_len, "new_tokens": new_tokens,
@@ -348,7 +401,12 @@ def run_poisson_fleet(preset: str, rate: float, num_requests: int,
         "tps_during": (_tps(killed_at, t_rec)
                        if killed_at and t_rec else None),
         "tps_after": _tps(t_rec, wall) if t_rec else None,
-        "kill_at_s": round(killed_at, 3) if killed_at else None,
+        "kill_at_s": (round(killed_at, 3)
+                      if killed_at and not slow_replica else None),
+        "slow_at_s": (round(killed_at, 3)
+                      if killed_at and slow_replica else None),
+        "drained_at_s": (round(t_drain, 3)
+                         if slow_replica and t_drain else None),
         "recovered_at_s": round(t_rec, 3) if t_rec else None,
         "deaths": flt.stats["deaths"] - base["deaths"],
         "requeues": flt.stats["requeues"] - base["requeues"],
@@ -358,7 +416,7 @@ def run_poisson_fleet(preset: str, rate: float, num_requests: int,
         "n_chips": n_chips,
     }
     flt.close()
-    print("inference_bench poisson_fleet: " + json.dumps(row))
+    print(f"inference_bench {mode}: " + json.dumps(row))
     return row
 
 
@@ -469,6 +527,14 @@ def main(argv=None):
     p.add_argument("--no-fail-replica", action="store_true",
                    help="fleet leg: skip the replica-kill injection "
                         "(steady-state fleet throughput only)")
+    p.add_argument("--slow-replica", action="store_true",
+                   help="fleet leg: inject a DEGRADED (not dead) replica "
+                        "via the keyed serve.replica_slow sleep failpoint "
+                        "at 1/3 completion; the straggler detector drains "
+                        "it and the poisson_fleet_slow row records "
+                        "tps_before/during/after + drain/recovery stamps")
+    p.add_argument("--slow-ms", type=int, default=250,
+                   help="--slow-replica: injected per-iteration delay")
     p.add_argument("--chunk", type=int, default=0,
                    help="serving.prefill_chunk_tokens for the poisson "
                         "legs (0 = whole prefill)")
@@ -496,7 +562,9 @@ def main(argv=None):
                 rows.append(run_poisson_fleet(
                     args.preset, rate, args.requests, args.prompt,
                     args.new, replicas=args.fleet, serving=serving,
-                    fail_replica=not args.no_fail_replica))
+                    fail_replica=(not args.no_fail_replica
+                                  and not args.slow_replica),
+                    slow_replica=args.slow_replica, slow_ms=args.slow_ms))
             else:
                 rows.append(run_poisson(args.preset, rate, args.requests,
                                         args.prompt, args.new,

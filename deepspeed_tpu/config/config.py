@@ -370,6 +370,40 @@ class IntegrityConfig(DeepSpeedConfigModel):
     nonfinite_abort_after: int = 0
 
 
+class StragglerConfig(DeepSpeedConfigModel):
+    """TPU-native (round 15): straggler defense (runtime/straggler.py,
+    docs/RESILIENCE.md). The *slow* leg of the threat model — a
+    slow-but-alive host (thermal throttling, degraded NIC, noisy
+    neighbor) passes every dead/wrong check while the synchronous step
+    drags the whole world to its pace. Every worker stamps a rolling
+    per-step wall-time gauge (``step_ms``) into its heartbeat records
+    regardless of this section (``dstpu health`` RATE column); with
+    ``enabled`` a cross-rank detector (the sentinel's median/MAD
+    machinery applied across ranks, leave-one-out: the judged rank
+    never sits in its own baseline) issues warmup-gated,
+    cooldown-debounced verdicts when a rank's step time sits ``zmax``
+    robust sigmas above the OTHER ranks' median AND above
+    ``rel_threshold`` x that median for ``strike_window`` consecutive
+    windows — the relative floor means a UNIFORMLY slow world (everyone
+    throttled alike) produces zero verdicts. A verdicted rank stamps a sticky
+    ``STRAGGLER`` heartbeat flag (blacklist evidence, the SDC-flag
+    pattern); with ``abort_after > 0`` a rank still slow that many
+    windows past its verdict exits rc 117 so the elastic agent
+    relaunches the world without the slow host. ``abort_after = 0``
+    (default) is evidence-only: nothing is ever torn down. The same
+    section under ``serving.fleet.straggler`` drives the fleet-side
+    slow-replica DRAIN (serving/fleet.py)."""
+    enabled: bool = False
+    window: int = 8            # worker-side rolling step_ms gauge window
+    check_interval: float = 5.0  # engine-side seconds between detection windows
+    zmax: float = 6.0          # robust sigmas above the world median
+    rel_threshold: float = 1.5  # AND this multiple of the world median
+    warmup: int = 3            # complete windows before any verdict
+    strike_window: int = 3     # consecutive slow windows -> verdict
+    cooldown: int = 10         # windows one verdict debounces
+    abort_after: int = 0       # post-verdict windows -> rc 117; 0 = never
+
+
 class WatchdogConfig(DeepSpeedConfigModel):
     """TPU-native (rounds 4+6): in-worker PHASE-AWARE watchdog. A wedged
     rank in a multi-controller job silently deadlocks every collective in
@@ -438,6 +472,16 @@ class FleetConfig(DeepSpeedConfigModel):
     max_queue: int = 4096              # shared admission queue bound
     default_deadline_s: float = 0.0    # queue-wait TTL; 0 = none
     heartbeat_dir: Optional[str] = None  # None = private tempdir
+    # straggler drain (round 15, runtime/straggler.py): with
+    # straggler.enabled the FleetSupervisor runs the cross-rank
+    # relative-slowness detector over the replicas' step_ms SERVE gauges
+    # and DRAINS a verdicted replica through the existing death path —
+    # admission stops, its lanes requeue exactly-once token-exact, the
+    # replica restarts warmed, the strike counts toward blacklist_after
+    # — instead of letting one throttled replica hold the shared
+    # queue's p99 hostage. (abort_after is ignored fleet-side: the
+    # drain IS the remediation.)
+    straggler: StragglerConfig = Field(default_factory=StragglerConfig)
 
 
 class ServingConfig(DeepSpeedConfigModel):
@@ -642,6 +686,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
         default_factory=NonFiniteGuardConfig)
     integrity: IntegrityConfig = Field(default_factory=IntegrityConfig)
     watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
+    straggler: StragglerConfig = Field(default_factory=StragglerConfig)
     dataloader_drop_last: bool = False
     nebula: NebulaConfig = Field(default_factory=NebulaConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)

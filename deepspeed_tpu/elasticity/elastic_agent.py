@@ -169,6 +169,7 @@ class DSElasticAgent:
         attribution first, then the heartbeat channel (ranks whose last
         word is STALLED, or whose record went stale)."""
         from ..runtime import heartbeat as hb
+        from ..runtime.straggler import HOST_NAMING_FLAGS
         implicated: List[str] = []
         # the world ranks were ACTUALLY assigned over: launch_fn may narrow
         # the agent's confirmed membership further (--include/--exclude/
@@ -198,15 +199,18 @@ class DSElasticAgent:
                     host = _rec_host(rec)
                     if host and host not in implicated:
                         implicated.append(host)
-            # SDC flags from the cross-replica audit: the audit aborts
-            # EVERY rank with the same rc (and launch.py marks them all
-            # INTEGRITY for health), but only the implicated rank's
-            # record carries SDC — strike that host, not the whole world
-            for rec in hb.flagged_ranks(self.heartbeat_dir,
-                                        flag="SDC").values():
-                host = _rec_host(rec)
-                if host and host not in implicated:
-                    implicated.append(host)
+            # host-naming flags: SDC (the cross-replica audit aborts
+            # EVERY rank with the same rc, but only the implicated
+            # rank's record carries the flag) and STRAGGLER (the
+            # relative-slowness detector's self-verdict — the rank's
+            # rc-117 exit names nobody, the flag names the slow host).
+            # Strike that host, not the whole world
+            for flag in HOST_NAMING_FLAGS:
+                for rec in hb.flagged_ranks(self.heartbeat_dir,
+                                            flag=flag).values():
+                    host = _rec_host(rec)
+                    if host and host not in implicated:
+                        implicated.append(host)
             if self.heartbeat_timeout > 0:
                 # post-mortem staleness: the world is DOWN by the time the
                 # agent reads the channel, so every record is frozen and

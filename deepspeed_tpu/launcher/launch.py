@@ -125,14 +125,21 @@ def main(argv=None):
             import traceback
             traceback.print_exc()
             if writer is not None:
-                # mark + conclude the record: the INTEGRITY flag keeps
-                # the abort visible in `dstpu health` (a bare EXIT reads
-                # as a clean run) without striking anyone — blacklist
-                # consumers filter to SDC, the only flag naming a host —
-                # and the terminal stamp keeps a slow scheduler teardown
+                from ..runtime.sentinel import INTEGRITY_EXIT_CODE
+                if code == INTEGRITY_EXIT_CODE:
+                    # mark + conclude the record: the INTEGRITY flag
+                    # keeps an rc-118 abort visible in `dstpu health` (a
+                    # bare EXIT reads as a clean run) without striking
+                    # anyone — blacklist consumers filter to the
+                    # host-naming flags (SDC, STRAGGLER). Other coded
+                    # exits (a StragglerAbort's rc 117) stamped their
+                    # own evidence before raising
+                    writer.add_flag("INTEGRITY", lock_timeout=5.0)
+                # the terminal stamp keeps a slow scheduler teardown
                 # past heartbeat_timeout from reading EVERY frozen STEP
-                # record as silence (rc 117 against all innocent hosts)
-                writer.add_flag("INTEGRITY", lock_timeout=5.0)
+                # record as silence (rc 117 against all innocent hosts);
+                # a no-op when a terminal verdict (STALLED) already
+                # stands
                 writer.stamp_terminal(hb.PHASE_EXIT, lock_timeout=5.0)
             sys.exit(code)
         raise

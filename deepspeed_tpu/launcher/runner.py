@@ -241,9 +241,11 @@ def parse_args(argv=None):
 
 def health_main(argv) -> int:
     """``dstpu health <heartbeat-dir>`` — the operator's one-glance pod
-    view: per-rank phase, step, record age, host, pid, pipeline STAGE
-    (MPMD stage workers stamp it, round 13), phase GAUGES (SERVE stamps
-    queue-depth / active-lane load) and integrity FLAGS from the
+    view: per-rank phase, step, RATE (the rolling step_ms wall-time
+    gauge, round 15 — '-' for records predating it), record age, host,
+    pid, pipeline STAGE (MPMD stage workers stamp it, round 13), phase
+    GAUGES (SERVE stamps queue-depth / active-lane load) and
+    integrity/straggler FLAGS from the
     heartbeat channel. Works on a serving fleet's per-replica channel
     (serving/fleet.py) and an MPMD pipeline's per-stage channel
     (runtime/pipe/mpmd) exactly as on a training world's per-rank one. Exit 0 when every rank is live or
@@ -253,6 +255,7 @@ def health_main(argv) -> int:
     provably alive)."""
     import time as _time
     from ..runtime import heartbeat as hb
+    from ..runtime.straggler import STRAGGLER_FLAG
     p = argparse.ArgumentParser(prog="dstpu health")
     p.add_argument("heartbeat_dir")
     p.add_argument("--stale-after", type=float, default=60.0,
@@ -263,7 +266,7 @@ def health_main(argv) -> int:
         print(f"no heartbeat records under {a.heartbeat_dir}")
         return 1
     now = _time.time()
-    rows = [("RANK", "STAGE", "HOST", "PHASE", "STEP", "AGE", "PID",
+    rows = [("RANK", "STAGE", "HOST", "PHASE", "STEP", "RATE", "AGE", "PID",
              "GAUGES", "FLAGS", "")]
     bad = False
     for rank in sorted(records):
@@ -281,8 +284,16 @@ def health_main(argv) -> int:
         # pipeline operator asks
         stage = gauges.get("stage")
         stage_txt = str(stage) if stage is not None else "-"
+        # RATE: the rolling per-step wall-time gauge (round 15,
+        # runtime/straggler.py) — the one-glance answer to "is this rank
+        # DRAGGING the synchronous world" that liveness alone can never
+        # give. '-' for records predating the gauge; rc semantics
+        # unchanged (a slow rank is the straggler detector's verdict to
+        # make, not this view's)
+        step_ms = gauges.get("step_ms")
+        rate_txt = f"{float(step_ms):.0f}ms" if step_ms is not None else "-"
         gtxt = ",".join(f"{k}={gauges[k]}" for k in sorted(gauges)
-                        if k != "stage") or "-"
+                        if k not in ("stage", "step_ms")) or "-"
         flags = ",".join(rec.get("flags") or ()) or "-"
         note = ""
         if phase == hb.PHASE_STALLED:
@@ -294,11 +305,16 @@ def health_main(argv) -> int:
             note = "" if rec.get("flags") else "clean exit"
         elif age > a.stale_after:
             note, bad = f"SILENT > {a.stale_after:.0f}s", True
-        if rec.get("flags"):
+        rec_flags = rec.get("flags") or []
+        if STRAGGLER_FLAG in rec_flags:
+            # a slow host is operator news even while alive and stepping
+            note = (note + "; " if note else "") + "straggler (slow host)"
+            bad = True
+        if any(f != STRAGGLER_FLAG for f in rec_flags):
             note = (note + "; " if note else "") + "integrity flags (rc 118)"
             bad = True
         rows.append((str(rank), stage_txt, str(rec.get("host")), phase,
-                     str(rec.get("step")), f"{age:.1f}s",
+                     str(rec.get("step")), rate_txt, f"{age:.1f}s",
                      str(rec.get("pid")), gtxt, flags, note))
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
     for r in rows:

@@ -53,7 +53,8 @@ from typing import Callable, List, Optional, Sequence
 
 from ..elasticity.elastic_agent import PREEMPTION_EXIT_CODE
 from ..runtime import heartbeat as hb
-from ..runtime.sentinel import INTEGRITY_EXIT_CODE, SDC_FLAG
+from ..runtime.sentinel import INTEGRITY_EXIT_CODE
+from ..runtime.straggler import HOST_NAMING_FLAGS
 from ..runtime.watchdog import STALL_EXIT_CODE
 from ..testing import chaos
 from ..utils.logging import logger
@@ -609,14 +610,17 @@ class RunSupervisor:
                 if host and host not in out:
                     out.append(host)
         if self.heartbeat_dir:
-            # SDC only: the generic INTEGRITY mark (launch.py stamps it on
-            # every rank of an rc-118 abort for health visibility) names
-            # no host
-            for rec in hb.flagged_ranks(self.heartbeat_dir,
-                                        flag=SDC_FLAG).values():
-                host = hb.rec_host(rec, self.rank_hosts)
-                if host and host not in out:
-                    out.append(host)
+            # host-NAMING flags only — SDC (a chip computing garbage) and
+            # STRAGGLER (a host dragging the synchronous step): each is
+            # stamped by exactly the implicated rank. The generic
+            # INTEGRITY mark (launch.py stamps it on every rank of an
+            # rc-118 abort for health visibility) names no host
+            for flag in HOST_NAMING_FLAGS:
+                for rec in hb.flagged_ranks(self.heartbeat_dir,
+                                            flag=flag).values():
+                    host = hb.rec_host(rec, self.rank_hosts)
+                    if host and host not in out:
+                        out.append(host)
         return out
 
 
@@ -761,9 +765,11 @@ class BackendSupervisor:
 
     def failed_hosts(self) -> List[str]:
         """Blacklist feed: hosts whose ranks went heartbeat-silent,
-        stamped a STALLED terminal record, or carry an integrity flag
-        (the SDC audit's per-host attribution — the scheduler's flattened
-        rc cannot name the bad chip, the flagged record can)."""
+        stamped a STALLED terminal record, or carry a host-naming flag —
+        SDC (the audit's per-host attribution) or STRAGGLER (the
+        relative-slowness detector's): the scheduler's flattened rc can
+        name neither the bad chip nor the slow host; the flagged record
+        can."""
         out = list(self._silent_hosts)
         if self._heartbeat_dir:
             for rec in hb.terminal_records(self._heartbeat_dir).values():
@@ -771,11 +777,12 @@ class BackendSupervisor:
                     host = self._rank_host(rec)
                     if host and host not in out:
                         out.append(host)
-            for rec in hb.flagged_ranks(self._heartbeat_dir,
-                                        flag=SDC_FLAG).values():
-                host = self._rank_host(rec)
-                if host and host not in out:
-                    out.append(host)
+            for flag in HOST_NAMING_FLAGS:
+                for rec in hb.flagged_ranks(self._heartbeat_dir,
+                                            flag=flag).values():
+                    host = self._rank_host(rec)
+                    if host and host not in out:
+                        out.append(host)
         return out
 
     # -------------------------------------------------------------- internals

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import inspect
 import os
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -48,6 +49,7 @@ from ..testing import chaos
 from . import checkpointing as ckpt_lib
 from . import heartbeat as hb
 from . import sentinel as sentinel_lib
+from . import straggler as straggler_lib
 from .loss_scaler import LossScaler
 from .lr_schedules import LRScheduler, build_schedule
 # NonFiniteError moved into the sentinel ladder (round 7) — re-exported
@@ -624,6 +626,32 @@ class DeepSpeedEngine:
                      f"save={wd.save_timeout}s", ranks=[0])
         if self.heartbeat is not None:
             self.heartbeat.write(hb.PHASE_INIT, 0, force=True)
+
+        # straggler defense (round 15; runtime/straggler.py,
+        # docs/RESILIENCE.md): the rolling step_ms gauge is stamped into
+        # every STEP heartbeat unconditionally (it is just timekeeping —
+        # `dstpu health` renders it as RATE); the cross-rank detector is
+        # opt-in. Each rank runs the SAME detector over the SAME shared
+        # channel snapshot and acts only on verdicts against ITSELF (the
+        # SDC self-flagging pattern): rung 1 stamps the sticky STRAGGLER
+        # flag, rung 3 (straggler.abort_after > 0) exits rc 117 so the
+        # elastic agent relaunches the world without this host.
+        self._step_clock = straggler_lib.StepClock(
+            window=self.config.straggler.window)
+        self.straggler: Optional[straggler_lib.StragglerDetector] = None
+        self._straggler_next_check = 0.0
+        self._straggler_flagged = False
+        if self.config.straggler.enabled and self.heartbeat is not None:
+            self.straggler = straggler_lib.StragglerDetector(
+                self.config.straggler)
+            log_dist(
+                f"straggler detector: zmax={self.config.straggler.zmax} "
+                f"rel_threshold={self.config.straggler.rel_threshold} "
+                f"strike_window={self.config.straggler.strike_window} "
+                f"abort_after={self.config.straggler.abort_after}"
+                + (" (evidence-only)"
+                   if self.config.straggler.abort_after <= 0 else ""),
+                ranks=[0])
 
         # progressive layer drop + eigenvalue (reference: engine hooks for
         # runtime/progressive_layer_drop.py + runtime/eigenvalue.py) ---------
@@ -1352,6 +1380,10 @@ class DeepSpeedEngine:
             self.watchdog.start().enter_phase(phase, step=self.global_steps)
         if self.heartbeat is not None:
             self.heartbeat.write(phase, self.global_steps, force=True)
+        if phase != hb.PHASE_STEP:
+            # the gap spanning a non-step phase (COMPILE, RESTORE) must
+            # not be charged to the step_ms gauge as a step
+            self._step_clock.reset()
 
     def _phase_scope(self, phase: str):
         """Bracket a bounded lifecycle section (RESTORE/SAVE): the phase's
@@ -1360,6 +1392,10 @@ class DeepSpeedEngine:
         import contextlib
         if self.heartbeat is not None:
             self.heartbeat.write(phase, self.global_steps, force=True)
+        # the section's duration must not pollute the step_ms gauge (a
+        # checkpoint save is not a slow step); the next step boundary
+        # re-baselines the clock
+        self._step_clock.reset()
         if self.watchdog is not None:
             self.watchdog.start()
             return self.watchdog.phase_scope(phase)
@@ -1382,6 +1418,11 @@ class DeepSpeedEngine:
         chaos.failpoint("run.kill")
         chaos.failpoint("run.preempt")
         chaos.failpoint("run.hang")
+        # degraded-not-dead: sleep mode (with every=/p= jitter) makes THIS
+        # rank slow while it keeps stepping — the straggler-defense shape
+        # no dead/wrong check can see (spec e.g.
+        # "run.slow:sleep:ms=300:times=0")
+        chaos.failpoint("run.slow")
         # sentinel chaos: a poisoned batch — float features scaled by
         # `factor`, producing the finite-but-huge grad spike the integrity
         # ladder exists to remediate (spec e.g.
@@ -1675,8 +1716,15 @@ class DeepSpeedEngine:
             self.watchdog.start().enter_phase(hb.PHASE_STEP,
                                               step=self.global_steps)
         if self.heartbeat is not None:
-            # throttled: same-phase records within min_interval are dropped
-            self.heartbeat.write(hb.PHASE_STEP, self.global_steps)
+            # throttled: same-phase records within min_interval are dropped.
+            # The rolling step_ms gauge rides along (None before the first
+            # completed step gap — `dstpu health` shows '-' until then)
+            gauge = self._step_clock.mark()
+            self.heartbeat.write(
+                hb.PHASE_STEP, self.global_steps,
+                extra=({straggler_lib.STEP_MS_GAUGE: gauge}
+                       if gauge is not None else None))
+            self._maybe_check_straggler()
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self._last_metrics = metrics
@@ -1756,6 +1804,49 @@ class DeepSpeedEngine:
         if thr is None:
             return None
         return jnp.asarray(thr, jnp.float32)
+
+    def _maybe_check_straggler(self):
+        """Worker-side straggler ladder (runtime/straggler.py), run at
+        ``straggler.check_interval`` cadence off the step path: read the
+        shared heartbeat channel, run the cross-rank detector, and act on
+        verdicts against THIS rank — rung 1 stamps the sticky STRAGGLER
+        flag (blacklist evidence, health-visible), rung 3 exits rc 117
+        so the degraded world relaunches without this host. Every rank
+        sees the same snapshot, so self-verdicts need no coordination."""
+        det = self.straggler
+        if det is None:
+            return
+        now = time.monotonic()
+        if now < self._straggler_next_check:
+            return
+        self._straggler_next_check = now + \
+            self.config.straggler.check_interval
+        records = hb.read_heartbeats(self.heartbeat.directory)
+        mine = det.observe(records).get(self.heartbeat.rank)
+        if mine is None:
+            return
+        if not self._straggler_flagged:
+            self._straggler_flagged = True
+            logger.error(
+                "straggler: this rank's step time is %s MADs above the "
+                "world median for %d consecutive windows — stamping the "
+                "STRAGGLER heartbeat flag (host %s)",
+                self.config.straggler.zmax,
+                self.config.straggler.strike_window, self.heartbeat.host)
+            self.heartbeat.add_flag(straggler_lib.STRAGGLER_FLAG,
+                                    lock_timeout=5.0)
+        if mine == straggler_lib.ABORT:
+            # the rc-117 path: the terminal STALLED record lets
+            # scheduler-flattening backends reconstruct the rc, and the
+            # voluntary 117 exit + the flag are the agent's strike
+            self.heartbeat.stamp_terminal(hb.PHASE_STALLED,
+                                          lock_timeout=5.0)
+            raise straggler_lib.StragglerAbort(
+                f"rank {self.heartbeat.rank} ({self.heartbeat.host}) "
+                f"persistently slow past straggler.abort_after="
+                f"{self.config.straggler.abort_after} windows — exiting "
+                f"rc {straggler_lib.STALL_EXIT_CODE} so the elastic agent "
+                "relaunches the world without this host")
 
     def _sentinel_rollback(self):
         """Remediation rung 2: restore the newest intact checkpoint via

@@ -92,6 +92,14 @@ HEARTBEAT_DIR_ENV = "DSTPU_HEARTBEAT_DIR"
 #: never uses) would silently never match.
 HEARTBEAT_HOST_ENV = "DSTPU_HEARTBEAT_HOST"
 
+#: env var overriding THIS worker's channel rank. Normally the rank is
+#: the caller's jax.process_index(), but a worker running OUTSIDE a
+#: jax.distributed world (a chaos child sharing a channel with siblings,
+#: a single-process twin in a multi-worker test rig) reads process index
+#: 0 — every sibling would fight over rank0.hb. The launcher-side
+#: consumers only care that records land in distinct per-rank files.
+HEARTBEAT_RANK_ENV = "DSTPU_HEARTBEAT_RANK"
+
 _SUFFIX = ".hb"
 
 
@@ -145,7 +153,18 @@ class HeartbeatWriter:
         (:func:`set_process_writer`), that writer is ADOPTED instead of
         creating a second one: two live refreshers would fight over the
         rank file, and closing the first would leave the record
-        unrefreshed through the user script's import/setup window."""
+        unrefreshed through the user script's import/setup window.
+
+        ``DSTPU_HEARTBEAT_RANK`` overrides ``rank`` (see
+        :data:`HEARTBEAT_RANK_ENV`: workers outside a jax.distributed
+        world all read process index 0)."""
+        env_rank = os.environ.get(HEARTBEAT_RANK_ENV, "")
+        if env_rank:
+            try:
+                rank = int(env_rank)
+            except ValueError:
+                logger.warning("heartbeat: ignoring non-integer %s=%r",
+                               HEARTBEAT_RANK_ENV, env_rank)
         existing = _process_writer
         if existing is not None and existing.rank == int(rank):
             return existing
@@ -226,7 +245,12 @@ class HeartbeatWriter:
             phase = self._last_phase or PHASE_INIT
             last = self._records[-1] if self._records else None
             step = int(last.get("step", step)) if last is not None else step
-        return self.write(phase, step, force=True, lock_timeout=lock_timeout)
+            # carry the newest record's gauges: a STRAGGLER flag whose
+            # re-write dropped the step_ms gauge would erase the very
+            # evidence it marks
+            gauges = dict(last.get("gauges") or {}) if last else None
+        return self.write(phase, step, force=True, lock_timeout=lock_timeout,
+                          extra=gauges or None)
 
     def stamp_terminal(self, phase: str,
                        lock_timeout: Optional[float] = None) -> bool:

@@ -191,6 +191,13 @@ def run_worker(args) -> int:
     hb = None
     if os.environ.get(HEARTBEAT_DIR_ENV):
         hb = HeartbeatWriter(os.environ[HEARTBEAT_DIR_ENV], rank=s)
+    # rolling per-step wall-time gauge (round 15, runtime/straggler.py):
+    # rides the STAGE-tagged records so `dstpu health` shows RATE and the
+    # cross-stage straggler detector can compare clock ticks — at MPMD
+    # scale one slow stage stalls every downstream stage, and only the
+    # RELATIVE view (this stage vs the world) can name the culprit
+    from ...straggler import STEP_MS_GAUGE, StepClock
+    step_clock = StepClock()
 
     def on_sigterm(signum, frame):
         if hb is not None:
@@ -309,6 +316,9 @@ def run_worker(args) -> int:
         restored, _ = _load_stage_state(args.ckpt_dir, state_like,
                                         tag=f"{_TAG}{r}")
         chan.clear_data()
+        # the parked window must not read as a (giant) step in the
+        # step_ms gauge — re-baseline at the next step boundary
+        step_clock.reset()
         return r, restored
 
     k = done
@@ -325,8 +335,12 @@ def run_worker(args) -> int:
         # the chaos hook the one-stage-restart matrix arms (keyed by
         # stage, so `match=1` takes out stage 1 only)
         chaos.failpoint("pipe.stage_kill", key=str(s))
+        gauge = step_clock.mark()
         if hb is not None:
-            hb.write(PHASE_STEP, k, extra={"stage": s})
+            extra = {"stage": s}
+            if gauge is not None:
+                extra[STEP_MS_GAUGE] = gauge
+            hb.write(PHASE_STEP, k, extra=extra)
         try:
             grads, loss = run_step(k)
         except ParkSignal:

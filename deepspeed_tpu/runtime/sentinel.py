@@ -105,6 +105,15 @@ class NonFiniteError(TrainingIntegrityError):
     from."""
 
 
+def _median(vals) -> float:
+    """Median of a non-empty sequence — shared by the rolling baselines
+    here and the cross-rank straggler detector (runtime/straggler.py)."""
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
 class RollingRobust:
     """Rolling median/MAD over the last ``window`` accepted samples.
 
@@ -124,12 +133,6 @@ class RollingRobust:
     def push(self, x: float) -> None:
         self.buf.append(float(x))
 
-    def _median(self, vals: List[float]) -> float:
-        s = sorted(vals)
-        n = len(s)
-        mid = n // 2
-        return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
-
     def stats(self) -> Optional[Tuple[float, float]]:
         """(median, robust sigma), or None with < 4 samples. The sigma is
         floored so a perfectly-flat warmup (MAD 0) cannot turn the first
@@ -137,8 +140,8 @@ class RollingRobust:
         if len(self.buf) < 4:
             return None
         vals = list(self.buf)
-        med = self._median(vals)
-        mad = self._median([abs(v - med) for v in vals])
+        med = _median(vals)
+        mad = _median([abs(v - med) for v in vals])
         sigma = self._K * mad
         floor = max(abs(med), 1.0) * 1e-3
         return med, max(sigma, floor)

@@ -33,6 +33,9 @@ Spec syntax (env var or ``arm()``)::
     DSTPU_CHAOS="run.preempt:sigterm"         # SIGTERM self (preemption)
     DSTPU_CHAOS="host.blackhole:raise:match=w2"  # keyed: only host w2
     DSTPU_CHAOS="sentinel.spike:flag:factor=1000"  # query-style injection
+    DSTPU_CHAOS="run.slow:sleep:ms=300:times=0"   # every hit, forever
+    DSTPU_CHAOS="run.slow:sleep:ms=300:every=3:times=0"  # every 3rd hit
+    DSTPU_CHAOS="run.slow:sleep:ms=300:p=40:times=0"     # ~40% of hits
 
 Run-supervision modes (round-4): ``hang`` blocks the calling thread
 forever — the userspace approximation of a wedged collective, what the
@@ -42,6 +45,19 @@ need an IO operation to still be in flight when something else happens.
 ``sigterm`` sends SIGTERM to the calling process (the installed
 preemption handler fires, exactly like a real TPU preemption notice).
 ``kill`` takes ``code=N`` to emulate any exit-code contract.
+
+Intermittent-slowness semantics (round-15, the straggler defense —
+*degraded, not dead*): ``times=0`` means UNLIMITED fires (the default
+stays 1), and two deterministic jitter filters shape WHICH eligible
+traversals fire: ``every=N`` fires the first post-``skip`` traversal
+and every Nth after it (periodic throttling — a host that hiccups on a
+cadence), while ``p=P`` (percent, 0-100) fires P% of eligible
+traversals on an evenly-spaced accumulator pattern (acc += P, fire and
+subtract at 100) — probabilistic-LOOKING degradation with zero
+randomness, so the straggler matrices stay exactly reproducible. The
+``run.slow`` failpoint at the train-batch boundary and the keyed
+``serve.replica_slow`` in the fleet worker loop combine these with
+``sleep`` to make one rank/replica slow-but-alive.
 
 Serving failpoints (round-8, the continuous-batching loop): on the
 serving hot path production code declares ``serve.enqueue``
@@ -119,24 +135,52 @@ _MODES = ("raise", "kill", "hang", "sleep", "sigterm", "flag")
 
 class _FailPoint:
     __slots__ = ("name", "mode", "skip", "times", "hits", "fired", "code",
-                 "ms", "match", "factor")
+                 "ms", "match", "factor", "every", "p", "acc")
 
     def __init__(self, name: str, mode: str, skip: int = 0, times: int = 1,
                  code: Optional[int] = None, ms: int = 0,
-                 match: Optional[str] = None, factor: int = 1):
+                 match: Optional[str] = None, factor: int = 1,
+                 every: int = 0, p: int = 0):
         if mode not in _MODES:
             raise ValueError(f"chaos mode must be one of {_MODES}, "
                              f"got {mode!r}")
+        if not 0 <= p <= 100:
+            raise ValueError(f"chaos p= must be a percentage 0-100, got {p}")
         self.name = name
         self.mode = mode
         self.skip = skip
-        self.times = times
+        self.times = times  # fire budget; 0 = unlimited (round 15)
         self.code = KILL_EXIT_CODE if code is None else code
         self.ms = ms        # sleep mode: delay in milliseconds
         self.match = match  # keyed failpoints: fire only when key == match
         self.factor = factor  # flag mode: perturbation magnitude
+        self.every = every  # jitter: fire 1st eligible hit + every Nth after
+        self.p = p          # jitter: fire P% of eligible hits (accumulator)
+        self.acc = 0        # the p= accumulator — deterministic, no PRNG
         self.hits = 0       # total traversals of this failpoint
         self.fired = 0      # times it actually failed
+
+    def advance(self) -> bool:
+        """One traversal's fire decision (caller holds the module lock):
+        skip first, then the fire budget (``times=0`` = unlimited), then
+        the deterministic jitter filters — ``every=N`` passes the first
+        post-skip traversal and every Nth after it; ``p=P`` passes P% of
+        what remains via an evenly-spaced accumulator."""
+        self.hits += 1
+        if self.hits <= self.skip:
+            return False
+        if 0 < self.times <= self.fired:
+            return False
+        if self.every > 1 and (self.hits - self.skip - 1) % self.every != 0:
+            return False
+        if self.p > 0:
+            self.acc += self.p
+            if self.acc < 100:
+                return False
+            self.acc -= 100
+        self.fired += 1
+        _history.append(self.name)
+        return True
 
 
 def parse_spec(spec: str) -> Dict[str, _FailPoint]:
@@ -157,7 +201,8 @@ def parse_spec(spec: str) -> Dict[str, _FailPoint]:
             if k == "match":            # keyed failpoints take a STRING
                 kwargs[k] = v           # (e.g. match=worker-2 on
                 continue                # host.blackhole)
-            if k not in ("skip", "times", "code", "ms", "factor"):
+            if k not in ("skip", "times", "code", "ms", "factor", "every",
+                         "p"):
                 raise ValueError(f"bad chaos spec option {f!r} in {part!r}")
             kwargs[k] = int(v)
         out[name] = _FailPoint(name, mode, **kwargs)
@@ -177,14 +222,17 @@ def _load_env_once() -> None:
 
 def arm(name: str, mode: str = "raise", skip: int = 0, times: int = 1,
         code: Optional[int] = None, ms: int = 0,
-        match: Optional[str] = None, factor: int = 1) -> None:
+        match: Optional[str] = None, factor: int = 1,
+        every: int = 0, p: int = 0) -> None:
     """Programmatically arm a failpoint (in-process tests). ``match``
     restricts a KEYED failpoint to one key — e.g. ``host.blackhole``
-    with ``match="worker-2"`` only fires for that host's dispatch."""
+    with ``match="worker-2"`` only fires for that host's dispatch.
+    ``times=0`` = unlimited fires; ``every=``/``p=`` are the
+    deterministic jitter filters (module docstring)."""
     with _lock:
         _armed[name] = _FailPoint(name, mode, skip=skip, times=times,
                                   code=code, ms=ms, match=match,
-                                  factor=factor)
+                                  factor=factor, every=every, p=p)
 
 
 def disarm(name: Optional[str] = None) -> None:
@@ -248,11 +296,8 @@ def failpoint(name: str, key: Optional[str] = None) -> None:
             return
         if fp.match is not None and key != fp.match:
             return
-        fp.hits += 1
-        if fp.hits <= fp.skip or fp.fired >= fp.times:
+        if not fp.advance():
             return
-        fp.fired += 1
-        _history.append(name)
         mode, code, ms = fp.mode, fp.code, fp.ms
     if mode == "kill":
         os._exit(code)
@@ -289,9 +334,6 @@ def flag(name: str, key: Optional[str] = None) -> Optional[int]:
             return None
         if fp.match is not None and key != fp.match:
             return None
-        fp.hits += 1
-        if fp.hits <= fp.skip or fp.fired >= fp.times:
+        if not fp.advance():
             return None
-        fp.fired += 1
-        _history.append(name)
         return fp.factor

@@ -17,6 +17,12 @@
 # replica hang -> heartbeat-silence detection + blacklist/parole,
 # retry-budget exhaustion -> FAILED, requeue-crash -> orphan retry, and
 # serve.oom under the fleet.
+# Round 15 adds the straggler-defense matrices (tests/test_straggler.py +
+# the test_fleet straggler legs): a run.slow-degraded rank self-flags over
+# the shared heartbeat channel, aborts rc 117, is struck and blacklisted
+# by DSElasticAgent with the degraded world resuming training; a
+# serve.replica_slow-degraded replica is drained exactly-once token-exact
+# and blacklisted on repeat, with the poisson_fleet_slow bench row.
 # Round 12 adds the disaggregated-serving matrices (tests/test_disagg.py):
 # replica kill at serve.chunk / serve.handoff / serve.handoff_drop ->
 # every request completes token-exact or FAILED-within-retry-budget with
@@ -42,6 +48,7 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_multinode_runner.py \
     tests/test_launcher_elastic.py \
     tests/test_fleet.py \
+    tests/test_straggler.py \
     tests/test_disagg.py \
     tests/test_mpmd.py \
     "tests/test_multiprocess.py::test_two_process_sharded_save_with_per_rank_failpoint" \

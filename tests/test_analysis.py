@@ -1710,3 +1710,107 @@ def test_tpu014_negative_plain_device_put_on_step_path(tmp_path):
                 return jax.device_put(self._staging[j], self.shardings[j])
     """)
     assert "TPU014" not in codes(findings)
+
+
+# --------------------------------------------------------------------- TPU015
+
+def lint_named(tmp_path, name, source):
+    """TPU015 fires by MODULE, so the fixture file needs the real name."""
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return lint_paths([str(f)], select={"TPU015"}, root=str(tmp_path))
+
+
+_BLOCKING_SRC = """
+    import threading
+
+    class FleetSupervisor:
+        def poll(self):
+            self._lock.acquire()
+            item = self.queue.get()
+            self._done_evt.wait()
+            self._thread.join()
+            return item
+"""
+
+
+def test_tpu015_positive_unbounded_blocking_in_supervision_module(tmp_path):
+    """All four shapes of the bug class the PR-6 review passes fixed by
+    hand: lock.acquire() / queue.get() / Event.wait() / thread.join()
+    without a timeout, in a supervision module."""
+    findings = lint_named(tmp_path, "fleet.py", _BLOCKING_SRC)
+    assert codes(findings) == ["TPU015"] * 4
+    assert all(f.severity == Severity.WARNING for f in findings)
+    msgs = " ".join(f.message for f in findings)
+    assert "acquire" in msgs and "get" in msgs
+
+
+def test_tpu015_negative_same_code_outside_supervision_modules(tmp_path):
+    """Ordinary code is allowed to wait — the rule is scoped to the
+    modules whose JOB is converting hangs into exits."""
+    findings = lint_named(tmp_path, "engine.py", _BLOCKING_SRC)
+    assert "TPU015" not in codes(findings)
+
+
+def test_tpu015_negative_bounded_and_nonblocking_calls(tmp_path):
+    findings = lint_named(tmp_path, "supervisor.py", """
+        import threading
+
+        class RunSupervisor:
+            def monitor(self, proc, reader):
+                self._lock.acquire(timeout=5.0)
+                self._lock.acquire(False)          # non-blocking probe
+                self.queue.get(timeout=0.5)
+                self._done_evt.wait(0.05)
+                reader.join(timeout=5)
+                rc = proc.wait()                   # Popen: the monitor's job
+                desc = ", ".join(str(r) for r in self.ranks)
+                phase = rec.get("phase")           # dict get, not queue
+                return rc, desc, phase
+    """)
+    assert "TPU015" not in codes(findings)
+
+
+def test_tpu015_positive_watchdog_and_elastic_agent_scoped(tmp_path):
+    """The module set covers every supervision component, not just the
+    launcher supervisor."""
+    for name in ("watchdog.py", "elastic_agent.py", "straggler.py"):
+        findings = lint_named(tmp_path, name, """
+            def run(self):
+                self._stop_event.wait()
+        """)
+        assert "TPU015" in codes(findings), name
+
+
+def test_tpu015_positive_explicit_blocking_positionals(tmp_path):
+    """The positional escape hatch is closed: acquire(True) / get(1) are
+    just an explicit "block forever" (the timeout slot is SECOND), and
+    wait(None) is the spelled-out unbounded wait — all the same bug as
+    the bare calls, review-pass finding round 15."""
+    findings = lint_named(tmp_path, "supervisor.py", """
+        def monitor(self):
+            self._lock.acquire(True)
+            self.queue.get(1)
+            self._done_evt.wait(None)
+    """)
+    assert codes(findings) == ["TPU015"] * 3
+
+
+def test_tpu015_negative_positional_timeouts(tmp_path):
+    """acquire/get with BOTH positionals carry a timeout; wait's first
+    positional IS the timeout."""
+    findings = lint_named(tmp_path, "supervisor.py", """
+        def monitor(self):
+            self._lock.acquire(True, 5.0)
+            self.queue.get(True, 0.5)
+            self._done_evt.wait(0.05)
+    """)
+    assert "TPU015" not in codes(findings)
+
+
+def test_tpu015_suppression_respected(tmp_path):
+    findings = lint_named(tmp_path, "fleet.py", """
+        def drain(self):
+            self._lock.acquire()   # graftlint: disable=TPU015
+    """)
+    assert all(f.suppressed for f in findings if f.rule == "TPU015")

@@ -122,6 +122,54 @@ def test_chaos_run_supervision_modes():
     assert chaos.fired("s") == ["s"]
 
 
+def test_chaos_intermittent_slowness_jitter_semantics():
+    """Round-15 spec surface (the straggler failpoints): ``times=0`` =
+    unlimited fires; ``every=N`` fires the first post-skip traversal and
+    every Nth after it; ``p=P`` fires P% of eligible traversals on the
+    deterministic accumulator pattern — degraded, not dead, and exactly
+    reproducible."""
+    fps = chaos.parse_spec("a:sleep:ms=5:every=3:times=0;b:sleep:p=40")
+    assert fps["a"].every == 3 and fps["a"].times == 0
+    assert fps["b"].p == 40
+    with pytest.raises(ValueError):
+        chaos.parse_spec("a:sleep:p=150")          # not a percentage
+    with pytest.raises(ValueError):
+        chaos.parse_spec("a:sleep:every=x")        # options stay ints
+
+    # every=3, times=0: hits 1, 4, 7 fire over 7 traversals — forever
+    chaos.arm("e", "sleep", ms=0, every=3, times=0)
+    for _ in range(7):
+        chaos.failpoint("e")
+    assert len(chaos.fired("e")) == 3
+
+    # p=50: evenly spaced half of the traversals (2, 4, 6, 8, 10)
+    chaos.reset_for_tests()
+    chaos.arm("p", "sleep", ms=0, p=50, times=0)
+    for _ in range(10):
+        chaos.failpoint("p")
+    assert len(chaos.fired("p")) == 5
+
+    # skip shifts the eligible window; the pattern stays deterministic
+    chaos.reset_for_tests()
+    chaos.arm("sk", "sleep", ms=0, every=2, skip=1, times=0)
+    for _ in range(5):
+        chaos.failpoint("sk")                      # eligible hits 2, 4
+    assert len(chaos.fired("sk")) == 2
+
+    # a positive times= still caps the budget under jitter
+    chaos.reset_for_tests()
+    chaos.arm("t", "sleep", ms=0, every=2, times=1)
+    for _ in range(6):
+        chaos.failpoint("t")
+    assert len(chaos.fired("t")) == 1
+
+    # flag mode rides the same accounting (query-style slowness knobs)
+    chaos.reset_for_tests()
+    chaos.arm("f", "flag", factor=7, every=2, times=0)
+    got = [chaos.flag("f") for _ in range(4)]
+    assert got == [7, None, 7, None]
+
+
 # ------------------------------------------------- crash-at-every-stage matrix
 
 #: every named failpoint a save traverses, in execution order

@@ -58,6 +58,20 @@ def _serving(replicas, **fleet_kw):
             "max_blocks_per_seq": 8, "fleet": fleet}
 
 
+def _wait_inflight(flt, idx, timeout=30.0):
+    """Block until replica ``idx`` holds in-flight work — the straggler
+    legs arm slowness only once the victim PROVABLY has lanes (the tiny
+    model serves whole requests in milliseconds; armed too early, the
+    pre-dispatch sleep lets the fast replica drain the queue and the
+    victim never works at all)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if flt._replicas[idx].inflight:
+            return
+        time.sleep(0.001)
+    raise AssertionError(f"replica {idx} never got in-flight work")
+
+
 # ---------------------------------------------------------------------------
 # tier-1: kill -> requeue (with a requeue crash folded in), exactly-once
 # ---------------------------------------------------------------------------
@@ -229,6 +243,67 @@ def test_inference_bench_poisson_fleet_line(capsys):
     assert row["failed"] == 0 and row["replicas"] == 2
 
 
+@pytest.mark.slow
+def test_fleet_straggler_drain_requeues_token_exact(tiny):
+    """Acceptance (round 15): a serve.replica_slow-DEGRADED replica —
+    alive, stepping, just slow — is detected by the FleetSupervisor's
+    relative-slowness detector and DRAINED through the death path:
+    admission stops, its lanes requeue exactly-once, the replacement
+    restarts warmed, and greedy outputs stay token-identical to an
+    uninjected twin. No dead/wrong check could have fired: the replica
+    never crashes and never goes silent.
+
+    slow-marked per the tier-1 budget guardrail (~8s of serving);
+    cheaper tier-1 cousins: the detector/FP-guard + flag-consumption
+    units in test_straggler.py, test_fleet_straggler_detection_off_by_
+    default, and the chaos jitter semantics in test_chaos.py —
+    scripts/chaos.sh and scripts/tier2.sh run this leg."""
+    cfg, params = tiny
+    rng = np.random.default_rng(23)
+    prompts = [list(rng.integers(1, 64, size=n)) for n in (5, 11, 9, 13)]
+    emitted = {}
+    serving = _serving(2, straggler={"enabled": True, "warmup": 2,
+                                     "strike_window": 2, "cooldown": 5})
+    flt = ServingFleet(cfg, params, serving=serving)
+    try:
+        flt.start()
+        flt.warmup()       # compile off-path: a compile is not a straggle
+        reqs = [flt.submit(
+            p, 48, on_token=lambda r, t: emitted.setdefault(r.rid, [])
+            .append(t)) for p in prompts]
+        _wait_inflight(flt, 1)
+        chaos.arm("serve.replica_slow", "sleep", ms=150, times=0,
+                  match="1")
+        deadline = time.monotonic() + 60
+        while flt.stats["deaths"] == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        chaos.disarm("serve.replica_slow")
+        assert flt.drain(timeout=180)
+        assert flt.stats["deaths"] == 1 and flt.stats["restarts"] == 1
+        death = flt.deaths[0]
+        assert death["replica"] == 1 and death["reason"] == "straggler"
+        assert death["action"] == "restart"
+        # the verdict's evidence carries the inflated gauge
+        assert death["evidence"]["gauges"]["step_ms"] > 100.0
+        # the healthy replica was never touched
+        assert flt._replicas[0].generation == 0
+        for p, r in zip(prompts, reqs):
+            oracle = _oracle_tokens(cfg, params, p, 48)
+            assert r.state == FINISHED and r.output_tokens == oracle
+            assert emitted[r.rid] == oracle       # exactly-once emission
+    finally:
+        flt.close()
+
+
+def test_fleet_straggler_detection_off_by_default(tiny):
+    """Without fleet.straggler.enabled the supervisor builds no
+    detector — slowness is never a death verdict (evidence-only is the
+    package default posture)."""
+    cfg, params = tiny
+    flt = ServingFleet(cfg, params, serving=_serving(2))
+    assert flt.supervisor._straggler is None
+
+
 def test_init_inference_serve_returns_started_fleet(tiny):
     """init_inference(...).serve() with fleet.replicas > 1 returns a
     STARTED ServingFleet; generate_batch round-trips token-exact."""
@@ -365,6 +440,79 @@ def test_fleet_parole_restores_min_replicas(tiny):
         assert all(r.state == FINISHED for r in reqs)
     finally:
         flt.close()
+
+
+@pytest.mark.slow
+def test_fleet_straggler_blacklist_flag_health_visible(tiny):
+    """Repeated drains blacklist the chronically-slow replica, and its
+    final record — STALLED, STRAGGLER-flagged — stays health-visible
+    (the restart path overwrites the rank file; the blacklist path is
+    the durable verdict)."""
+    cfg, params = tiny
+    rng = np.random.default_rng(29)
+    serving = _serving(2, blacklist_after=1,
+                       straggler={"enabled": True, "warmup": 2,
+                                  "strike_window": 2, "cooldown": 5})
+    flt = ServingFleet(cfg, params, serving=serving)
+    try:
+        flt.start()
+        flt.warmup()
+        # submit BEFORE arming: the victim dispatches at full speed and
+        # provably holds in-flight lanes when the slowness lands
+        reqs = [flt.submit(list(rng.integers(1, 64, size=9)), 48)
+                for _ in range(6)]
+        _wait_inflight(flt, 1)
+        chaos.arm("serve.replica_slow", "sleep", ms=150, times=0,
+                  match="1")
+        deadline = time.monotonic() + 60
+        while flt.stats["deaths"] == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        chaos.disarm("serve.replica_slow")
+        assert flt.drain(timeout=180)
+        assert flt.stats["deaths"] == 1 and flt.stats["blacklisted"] == 1
+        assert flt.deaths[0]["reason"] == "straggler"
+        assert flt.deaths[0]["action"] == "blacklist"
+        assert flt._replicas[1].state == BLACKLISTED
+        assert flt._replicas[0].state == LIVE     # reduced, still serving
+        for r in reqs:
+            assert r.state == FINISHED
+        rec = hb.read_heartbeats(flt.heartbeat_dir)[1]
+        assert rec["phase"] == hb.PHASE_STALLED
+        assert "STRAGGLER" in rec["flags"]
+    finally:
+        flt.close()
+
+
+@pytest.mark.slow
+def test_inference_bench_poisson_fleet_slow_replica_row(capsys):
+    """--poisson --fleet N --slow-replica: the degraded-throughput row
+    (tps before/during/after + drain/recovery stamps) in the SERVEBENCH
+    newest-recorded-sweep convention."""
+    import json
+    from deepspeed_tpu.benchmarks.inference_bench import run_poisson_fleet
+    # enough queued work that the victim provably holds lanes when the
+    # slowness lands AND while detection converges (a too-small run
+    # finishes before a 150ms-degraded replica ever shows in the gauges)
+    row = run_poisson_fleet(
+        "gpt2-tiny", rate=200.0, num_requests=48, prompt_len=24,
+        new_tokens=6, replicas=2, slow_replica=True, slow_ms=150,
+        serving={"block_size": 16, "pool_blocks": 32, "max_batch": 2,
+                 "max_blocks_per_seq": 8,
+                 "fleet": {"heartbeat_timeout": 60.0}},
+        model_kwargs=dict(hidden_size=32, num_layers=2, num_heads=2,
+                          vocab_size=64, attention_impl="reference"))
+    line = [ln for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("inference_bench poisson_fleet_slow: ")]
+    assert line, "machine-readable poisson_fleet_slow line missing"
+    parsed = json.loads(
+        line[0].split("inference_bench poisson_fleet_slow: ", 1)[1])
+    for key in ("tps_before", "tps_during", "tps_after", "slow_at_s",
+                "drained_at_s", "recovered_at_s", "deaths", "requeues"):
+        assert key in parsed and parsed[key] == row[key]
+    assert row["mode"] == "poisson_fleet_slow"
+    assert row["deaths"] == 1 and row["completed"] == 48
+    assert row["failed"] == 0 and row["kill_at_s"] is None
+    assert row["drained_at_s"] >= row["slow_at_s"]
 
 
 @pytest.mark.slow

@@ -356,6 +356,37 @@ def test_dstpu_health_flags_column_and_rc(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_dstpu_health_rate_column(tmp_path, capsys):
+    """Round-15 satellite: the rolling step_ms gauge renders as a RATE
+    column ('-' for records predating the gauge), promoted OUT of the
+    GAUGES column; rc semantics unchanged — a slow rank is the straggler
+    DETECTOR's verdict to make, but a STRAGGLER flag (its verdict) is
+    operator news and flips the rc like any flag."""
+    from deepspeed_tpu.launcher.runner import health_main
+    w0 = hb.HeartbeatWriter(str(tmp_path), 0, host="w0", refresh_interval=0)
+    w0.write(hb.PHASE_STEP, 50, force=True, extra={"step_ms": 800.0})
+    w1 = hb.HeartbeatWriter(str(tmp_path), 1, host="w1", refresh_interval=0)
+    w1.write(hb.PHASE_STEP, 50, force=True)        # predates the gauge
+    rc = health_main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0                                 # slow is not wedged
+    header = out.splitlines()[0].split()
+    assert header[:7] == ["RANK", "STAGE", "HOST", "PHASE", "STEP", "RATE",
+                          "AGE"]
+    rows = {ln.split()[0]: ln.split() for ln in out.splitlines()[1:]
+            if ln.strip()}
+    assert rows["0"][5] == "800ms"
+    assert rows["1"][5] == "-"
+    assert "step_ms=" not in out                   # promoted, not duplicated
+    # the STRAGGLER flag (the detector's verdict) is news: rc 1, named
+    w0.add_flag("STRAGGLER")
+    rc = health_main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "STRAGGLER" in out and "straggler (slow host)" in out
+    assert "rc 118" not in out                     # not an integrity abort
+
+
 def test_dstpu_health_stage_column(tmp_path, capsys):
     """Round-13 satellite: MPMD stage workers stamp a pipeline-stage
     gauge; `dstpu health` promotes it to a STAGE column (the round-12

@@ -1,0 +1,357 @@
+"""Straggler defense (round 15, runtime/straggler.py): relative-slowness
+detection over step_ms heartbeat gauges, the flag -> blacklist -> rc-117
+escalation ladder, and the false-positive guards the acceptance criteria
+pin — a UNIFORMLY slow world and compile/restore phases must produce
+ZERO verdicts, and detection is evidence-only unless
+``straggler.abort_after`` is set.
+
+The plain-python halves (StepClock, StragglerDetector, record gating,
+supervisor/agent flag consumption) are tier-1 sub-second. The
+engine-in-anger end-to-end leg — a ``run.slow``-injected rank
+STRAGGLER-flagged, struck and blacklisted by DSElasticAgent, with the
+degraded world resuming training and the flag visible in ``dstpu
+health`` — builds real engines in child processes and is ``slow``-marked
+(``scripts/chaos.sh`` runs it). The fleet-side drain legs live in
+tests/test_fleet.py next to the kill/hang matrix they extend.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from deepspeed_tpu.config.config import StragglerConfig
+from deepspeed_tpu.runtime import heartbeat as hb
+from deepspeed_tpu.runtime.straggler import (ABORT, SLOW, STEP_MS_GAUGE,
+                                             STRAGGLER_FLAG, StepClock,
+                                             StragglerAbort,
+                                             StragglerDetector,
+                                             record_step_ms)
+from deepspeed_tpu.runtime.watchdog import STALL_EXIT_CODE
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(**kw):
+    base = dict(enabled=True, warmup=2, strike_window=2, cooldown=5,
+                zmax=6.0, rel_threshold=1.5, abort_after=0)
+    base.update(kw)
+    return StragglerConfig(**base)
+
+
+def _rec(ms, phase="STEP", **extra):
+    rec = {"phase": phase, "ts": time.time()}
+    if ms is not None:
+        rec["gauges"] = {STEP_MS_GAUGE: ms}
+    rec.update(extra)
+    return rec
+
+
+# ------------------------------------------------------------------ StepClock
+
+def test_step_clock_rolling_median_and_reset():
+    now = [0.0]
+    c = StepClock(window=4, clock=lambda: now[0])
+    assert c.gauge() is None                      # predates the gauge
+    assert c.mark() is None                       # first mark = baseline only
+    for dt in (0.1, 0.1, 0.1, 5.0):               # one save-sized outlier
+        now[0] += dt
+        c.mark()
+    # median over (100, 100, 100, 5000)ms windows of 4 -> robust to the
+    # outlier (the rolling MEDIAN is the whole point of the gauge)
+    assert c.gauge() == pytest.approx(100.0, abs=1.0)
+    # reset drops the pending boundary: the next mark re-baselines and
+    # the spanning gap is never recorded as a step
+    c.reset()
+    now[0] += 60.0
+    n_before = len(c.buf)
+    c.mark()
+    assert len(c.buf) == n_before
+
+
+def test_step_clock_push_ms():
+    c = StepClock(window=3)
+    assert c.push_ms(10) == 10.0
+    c.push_ms(30)
+    assert c.push_ms(20) == 20.0                  # median of 10/20/30
+
+
+# ---------------------------------------------------------- record gating
+
+def test_record_step_ms_phase_guards():
+    """Compile/restore/save/init and terminal records never participate
+    in a window — a rank mid-compile must not read as a straggler (the
+    acceptance false-positive guard)."""
+    assert record_step_ms(_rec(500.0)) == 500.0
+    assert record_step_ms(_rec(500.0, phase="SERVE")) == 500.0
+    for phase in ("COMPILE", "RESTORE", "SAVE", "INIT",
+                  "STALLED", "PREEMPTED", "EXIT"):
+        assert record_step_ms(_rec(500.0, phase=phase)) is None
+    # records predating the gauge (no step_ms) are skipped, not zeroed
+    assert record_step_ms(_rec(None)) is None
+    assert record_step_ms({"phase": "STEP", "gauges": {"queue": 3}}) is None
+
+
+# ------------------------------------------------------------------ detector
+
+def test_detector_flags_one_slow_rank_after_warmup_and_strikes():
+    det = StragglerDetector(_cfg())
+    world = {0: _rec(100), 1: _rec(101), 2: _rec(99), 3: _rec(800)}
+    assert det.observe(world) == {}               # warmup window 1
+    assert det.slow_now == {3}                    # measured, not verdicted
+    assert det.observe(world) == {}               # warmup window 2
+    assert det.observe(world) == {3: SLOW}        # strikes crossed
+    # debounced: the standing verdict is not re-issued every window
+    assert det.observe(world) == {}
+
+
+def test_detector_uniformly_slow_world_produces_zero_verdicts():
+    """Everyone throttled alike: the world median scales with the world,
+    so the relative criterion never fires — the acceptance guard."""
+    det = StragglerDetector(_cfg())
+    for _ in range(10):
+        assert det.observe({r: _rec(100) for r in range(4)}) == {}
+    for _ in range(10):                           # the whole rack slows 5x
+        assert det.observe({r: _rec(500) for r in range(4)}) == {}
+    assert det.slow_now == set()
+    assert det.verdicts == {}
+
+
+def test_detector_compile_phase_world_produces_zero_windows():
+    det = StragglerDetector(_cfg())
+    for _ in range(6):
+        assert det.observe({0: _rec(100, phase="COMPILE"),
+                            1: _rec(9000, phase="COMPILE")}) == {}
+    assert det.windows == 0                       # nothing comparable seen
+
+
+def test_detector_small_world_ratio_fallback():
+    """Below 4 gauges a MAD is meaningless; the relative floor alone
+    decides — a 2-replica fleet can still catch a 3x straggler."""
+    det = StragglerDetector(_cfg())
+    world = {0: _rec(10), 1: _rec(300)}
+    out = [det.observe(world) for _ in range(4)]
+    assert out[2] == {1: SLOW}
+    # mild (sub-threshold) skew in a 2-rank world: never a verdict
+    det2 = StragglerDetector(_cfg())
+    for _ in range(6):
+        assert det2.observe({0: _rec(100), 1: _rec(120)}) == {}
+
+
+def test_detector_clean_window_retires_strikes_and_persistence():
+    det = StragglerDetector(_cfg(abort_after=3))
+    world_slow = {0: _rec(100), 1: _rec(100), 2: _rec(100), 3: _rec(900)}
+    world_ok = {r: _rec(100) for r in range(4)}
+    for _ in range(2):
+        det.observe(world_slow)
+    assert det.observe(world_slow) == {3: SLOW}
+    det.observe(world_slow)                       # persist 1 of 3
+    assert det.observe(world_ok) == {}            # recovered
+    assert det.strikes[3] == 0 and 3 not in det.persist
+    # a later relapse must re-earn strike_window (=2) windows: the first
+    # slow window is a strike, not a verdict (after the cooldown lapsed)
+    for _ in range(6):
+        det.observe(world_ok)                     # cooldown lapses
+    assert det.observe(world_slow) == {}          # strike 1 of 2
+    assert det.observe(world_slow) == {3: SLOW}
+
+
+def test_detector_evidence_only_by_default_and_abort_escalation():
+    """abort_after=0 (default): SLOW is the ceiling — nothing ever asks
+    for a teardown. abort_after=N: a rank still slow N windows past its
+    verdict escalates to ABORT."""
+    det0 = StragglerDetector(_cfg(abort_after=0))
+    world = {0: _rec(100), 1: _rec(100), 2: _rec(100), 3: _rec(900)}
+    seen = [det0.observe(world) for _ in range(20)]
+    assert ABORT not in {v for out in seen for v in out.values()}
+
+    det = StragglerDetector(_cfg(abort_after=2))
+    out = [det.observe(world) for _ in range(6)]
+    assert out[2] == {3: SLOW}
+    assert out[4] == {3: ABORT}
+
+
+def test_detector_single_gauge_is_not_a_window():
+    det = StragglerDetector(_cfg())
+    for _ in range(6):
+        assert det.observe({0: _rec(900)}) == {}
+    assert det.windows == 0
+
+
+def test_straggler_abort_carries_stall_exit_code():
+    assert StragglerAbort("x").exit_code == STALL_EXIT_CODE
+
+
+# --------------------------------------------- blacklist-evidence consumption
+
+def _flagged_channel(tmp_path, host="w1", rank=1):
+    w = hb.HeartbeatWriter(str(tmp_path), rank, host=host,
+                           refresh_interval=0)
+    w.write(hb.PHASE_STEP, 40, force=True,
+            extra={STEP_MS_GAUGE: 900.0})
+    w.add_flag(STRAGGLER_FLAG)
+    return w
+
+
+def test_run_supervisor_failed_hosts_consumes_straggler_flag(tmp_path):
+    """The flag names a HOST (the rc names nobody): it must feed the
+    blacklist exactly like the SDC flag."""
+    from deepspeed_tpu.launcher.supervisor import RankSpec, RunSupervisor
+    _flagged_channel(tmp_path)
+    sup = RunSupervisor([RankSpec("w0", ["true"]), RankSpec("w1", ["true"])],
+                        heartbeat_dir=str(tmp_path))
+    assert sup.failed_hosts() == ["w1"]
+
+
+def test_backend_supervisor_failed_hosts_consumes_straggler_flag(tmp_path):
+    from deepspeed_tpu.launcher.supervisor import BackendSupervisor
+    _flagged_channel(tmp_path)
+    sup = BackendSupervisor(["true"], heartbeat_dir=str(tmp_path),
+                            rank_hosts=["w0", "w1"])
+    assert sup.failed_hosts() == ["w1"]
+
+
+def test_elastic_agent_failure_evidence_consumes_straggler_flag(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    _flagged_channel(tmp_path)
+    agent = DSElasticAgent(lambda m: None, str(tmp_path / "hostfile"),
+                           heartbeat_dir=str(tmp_path))
+    assert agent._failure_evidence(object(), ["w0", "w1"]) == ["w1"]
+
+
+# --------------------------------------------------------------- end to end
+
+_CHILD = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, batch, train=False):
+            h = nn.Dense(16)(batch["x"])
+            return jnp.mean((h.sum(-1) - batch["y"]) ** 2)
+
+    def batch(i):
+        r = np.random.RandomState(i)
+        return {"x": r.randn(8, 4).astype(np.float32),
+                "y": r.randn(8).astype(np.float32)}
+
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "straggler": {"enabled": True, "check_interval": 0.2,
+                         "window": 4, "warmup": 2, "strike_window": 2,
+                         "cooldown": 3, "abort_after": 2}}
+    eng, *_ = ds.initialize(model=M(), config=cfg, example_batch=batch(0))
+    if eng.heartbeat is not None:
+        eng.heartbeat.min_interval = 0.02
+    marker = os.environ.get("DSTPU_TEST_MARKER", "")
+    steps = int(os.environ.get("DSTPU_TEST_STEPS", "2000"))
+    try:
+        for i in range(steps):
+            eng.train_batch(batch(i))
+            if marker and i == 0:
+                open(marker, "w").write("trained")
+            time.sleep(0.02)       # a fast-but-real step cadence
+    except Exception as e:         # StragglerAbort carries exit_code=117
+        code = getattr(e, "exit_code", None)
+        sys.exit(code if isinstance(code, int) else 1)
+    sys.exit(0)
+""")
+
+
+@pytest.mark.slow
+def test_run_slow_rank_flagged_struck_blacklisted_and_world_resumes(
+        tmp_path):
+    """Acceptance, end to end: a ``run.slow``-injected rank's step time
+    sits MADs above the world median -> it STRAGGLER-flags itself on the
+    shared heartbeat channel, aborts rc 117 after
+    ``straggler.abort_after`` persistent windows, RunSupervisor tears the
+    world down, DSElasticAgent counts the stall, strikes and blacklists
+    the host, and the DEGRADED world resumes training — with the flag
+    still visible in ``dstpu health`` afterwards."""
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    from deepspeed_tpu.launcher.runner import health_main
+    from deepspeed_tpu.launcher.supervisor import RankSpec, RunSupervisor
+    hb_dir = str(tmp_path / "hb")
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("w0 slots=1\nw1 slots=1\n")
+    marker = str(tmp_path / "progress")
+    worlds = []
+
+    def _env(rank, host, **extra):
+        env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+               "DSTPU_HEARTBEAT_DIR": hb_dir,
+               "DSTPU_HEARTBEAT_RANK": str(rank),
+               "DSTPU_HEARTBEAT_HOST": host}
+        env.update(extra)
+        return env
+
+    def launch(members):
+        worlds.append(list(members))
+        cmd = [sys.executable, str(script)]
+        if len(worlds) == 1:
+            specs = [
+                RankSpec("w0", cmd,
+                         env=_env(0, "w0", DSTPU_TEST_MARKER=marker)),
+                # w1 is degraded, not dead: every step sleeps 300ms on
+                # top of the real step — the shape no dead/wrong check
+                # can see
+                RankSpec("w1", cmd,
+                         env=_env(1, "w1",
+                                  DSTPU_CHAOS="run.slow:sleep:ms=300"
+                                              ":times=0")),
+            ]
+            # supervisor #1 carries the channel (flag evidence for
+            # failed_hosts); grace is small — the survivor has no
+            # preemption handler and dies on SIGTERM
+            return RunSupervisor(specs, grace_secs=2.0,
+                                 heartbeat_dir=hb_dir).start()
+        # the degraded relaunch: w0 alone proves training RESUMES (a
+        # real 3-step engine run over the prior run's marker). No
+        # heartbeat env: run-1's channel evidence must survive for the
+        # post-run health assertions.
+        code = (f"import os, sys\n"
+                f"assert os.path.exists({marker!r}), 'no prior progress'\n")
+        specs = [RankSpec("w0", [sys.executable, "-c", code +
+                                 "sys.exit(0)\n"]),
+                 RankSpec("w0", cmd, env={
+                     "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+                     "DSTPU_TEST_STEPS": "3"})]
+        return RunSupervisor(specs, grace_secs=2.0).start()
+
+    # agent WITHOUT heartbeat_dir: the supervisor's failed_hosts() is
+    # the evidence feed, and the agent must not clear the channel
+    # between launches (the test reads it afterwards)
+    agent = DSElasticAgent(launch, str(hostfile), max_restarts=3,
+                           check_interval=0.1, blacklist_after=1)
+    rc = agent.run()
+    assert rc == 0
+    assert worlds == [["w0", "w1"], ["w0"]]
+    assert agent.stalls == 1                      # rc 117, counted
+    assert agent.blacklisted == {"w1"}
+    assert agent.strikes["w1"] >= 1
+    # the slow rank's final word on the channel: STALLED, STRAGGLER-flagged
+    recs = hb.read_heartbeats(hb_dir)
+    assert recs[1]["phase"] == hb.PHASE_STALLED
+    assert STRAGGLER_FLAG in recs[1].get("flags", ())
+    assert recs[1]["host"] == "w1"
+    # the healthy rank never flagged itself (no false positive)
+    assert not recs[0].get("flags")
+    # operator view: rc 1, the flag and the RATE gauge visible
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        health_rc = health_main([hb_dir])
+    out = buf.getvalue()
+    assert health_rc == 1
+    assert "STRAGGLER" in out and "straggler (slow host)" in out
+    assert "RATE" in out.splitlines()[0]
