@@ -9,10 +9,15 @@ unscoped kernels. TPU011–TPU013 are INTERPROCEDURAL: a project-wide
 call graph (callgraph.py) + collective catalog (collectives.py) make
 rank-divergent collectives, invalid mesh axes and collective-order
 divergence visible across function and module boundaries — the
-distributed-hang class PRs 3–4 fixed at runtime. ``--fix`` autofixes
-the mechanical rules; ``--sarif`` emits SARIF 2.1.0 for CI PR
-annotation. See docs/LINT.md for the catalog, architecture and
-workflows.
+distributed-hang class PRs 3–4 fixed at runtime. TPU016–TPU019 ride a
+lock-and-thread model (locks.py) over the same call graph to catch the
+supervision-stack deadlock shapes (lock-order inversion, blocking under
+a lock, unsynchronized shared state, unbounded blocking on exit paths);
+TPU020/TPU021 keep the chaos-failpoint catalog and the exit-code
+contract in sync with their single sources. ``--fix`` autofixes the
+mechanical rules; ``--sarif`` emits SARIF 2.1.0 for CI PR annotation;
+``--timing`` prints the per-rule runtime budget. See docs/LINT.md for
+the catalog, architecture and workflows.
 
 Programmatic use::
 
@@ -22,6 +27,7 @@ Programmatic use::
 
 from . import rules as _rules  # noqa: F401  (registers TPU001–TPU010)
 from . import rules_collective as _rules2  # noqa: F401  (TPU011–TPU013)
+from . import rules_concurrency as _rules3  # noqa: F401  (TPU016–TPU021)
 from .baseline import Baseline, DEFAULT_BASELINE
 from .callgraph import ProjectIndex
 from .cli import main

@@ -16,6 +16,7 @@ from typing import List, Optional
 
 from . import rules as _rules  # noqa: F401  (imports register TPU001–010)
 from . import rules_collective as _rules2  # noqa: F401  (TPU011–013)
+from . import rules_concurrency as _rules3  # noqa: F401  (TPU016–021)
 from .baseline import Baseline, DEFAULT_BASELINE
 from .core import RULES, Severity, lint_paths
 from .reporters import (report_json, report_rules, report_sarif,
@@ -74,6 +75,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also print suppressed/baselined findings")
     p.add_argument("--strict", action="store_true",
                    help="INFO findings gate too")
+    p.add_argument("--timing", action="store_true",
+                   help="print per-rule wall time to stderr (slowest "
+                        "first) — the analyzer-runtime budget gate")
     p.add_argument("--list-rules", action="store_true")
     return p
 
@@ -111,8 +115,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"  fixed {os.path.relpath(fpath, root)}",
                   file=sys.stderr)
 
+    timings: Optional[dict] = {} if args.timing else None
     findings = lint_paths(paths, select=args.select, ignore=args.ignore,
-                          root=root)
+                          root=root, timings=timings)
+    if timings is not None:
+        total = sum(timings.values())
+        print(f"graftlint: timing ({total:.2f}s total)", file=sys.stderr)
+        for name, secs in sorted(timings.items(),
+                                 key=lambda kv: -kv[1]):
+            print(f"  {name:<16} {secs * 1000.0:9.1f} ms",
+                  file=sys.stderr)
 
     if args.write_baseline:
         target = args.baseline or baseline_path or DEFAULT_BASELINE

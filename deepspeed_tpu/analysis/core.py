@@ -270,24 +270,33 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
 def lint_paths(paths: Iterable[str],
                select: Optional[Set[str]] = None,
                ignore: Optional[Set[str]] = None,
-               root: Optional[str] = None) -> List[Finding]:
+               root: Optional[str] = None,
+               timings: Optional[Dict[str, float]] = None) -> List[Finding]:
     """Lint every .py under ``paths``. Returns ALL findings — including
     suppressed ones (marked) so reporters can count them; baseline matching
     happens in the CLI layer."""
-    return lint_modules(paths, select=select, ignore=ignore, root=root)[0]
+    return lint_modules(paths, select=select, ignore=ignore, root=root,
+                        timings=timings)[0]
 
 
 def lint_modules(paths: Iterable[str],
                  select: Optional[Set[str]] = None,
                  ignore: Optional[Set[str]] = None,
-                 root: Optional[str] = None
+                 root: Optional[str] = None,
+                 timings: Optional[Dict[str, float]] = None
                  ) -> Tuple[List[Finding], List["ModuleInfo"]]:
     """Two-phase lint. Phase 1 parses EVERY module in the run and builds
     the project-wide call graph / symbol index (callgraph.ProjectIndex) —
     the interprocedural rules (TPU011+) see all of it through
     ``module.project``. Phase 2 runs the rules per module as before.
-    Also returns the parsed modules so ``--fix`` can edit them."""
+    Also returns the parsed modules so ``--fix`` can edit them.
+
+    When ``timings`` is given, wall seconds accumulate into it per rule
+    code (plus ``<parse+index>`` for phase 1) — the ``--timing`` budget
+    gate that keeps the interprocedural passes honest."""
+    import time as _time
     root = root or os.getcwd()
+    t0 = _time.perf_counter()
     rules = [r for code, r in sorted(RULES.items())
              if (select is None or code in select)
              and (ignore is None or code not in ignore)]
@@ -307,11 +316,18 @@ def lint_modules(paths: Iterable[str],
                 message=f"could not parse: {e.__class__.__name__}: {e}"))
     from .callgraph import ProjectIndex
     index = ProjectIndex(modules)
+    if timings is not None:
+        timings["<parse+index>"] = timings.get("<parse+index>", 0.0) \
+            + (_time.perf_counter() - t0)
     for module in modules:
         module.project = index
         for rule in rules:
+            t1 = _time.perf_counter() if timings is not None else 0.0
             for finding in rule.check(module):
                 finding.suppressed = module.is_suppressed(finding)
                 findings.append(finding)
+            if timings is not None:
+                timings[rule.code] = timings.get(rule.code, 0.0) \
+                    + (_time.perf_counter() - t1)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, modules

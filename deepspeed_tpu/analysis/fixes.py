@@ -16,6 +16,16 @@ TPU009  scan-carry cast-back: wrap the widened carry expression in
 TPU010  wrap the statement launching ``pl.pallas_call`` in
         ``with jax.named_scope("<enclosing-fn>"):`` (adding ``import
         jax`` when the module lacks it).
+TPU019  thread ``lock_timeout=5.0`` through a bounded-lock API call on
+        an exit path — the API already defines the parameter with the
+        right semantics (None = block forever), so passing it is the
+        one right answer; 5.0 matches the watchdog's
+        ``_STAMP_LOCK_TIMEOUT`` convention. Only the
+        missing-``lock_timeout`` findings are fixable; raw
+        ``with``/``acquire`` sites change control flow and stay manual.
+TPU021  swap a hardcoded exit-code literal for its named constant and
+        import it from ``deepspeed_tpu.exit_codes`` when the module
+        doesn't already bind the name.
 
 Fixes are applied as source-span edits computed from the parsed AST.
 Within one round, overlapping edits are dropped (outermost wins) and the
@@ -32,7 +42,7 @@ from typing import Dict, List, Optional, Tuple
 from .core import Finding, ModuleInfo
 
 #: rules --fix knows how to rewrite
-FIXABLE = ("TPU008", "TPU009", "TPU010")
+FIXABLE = ("TPU008", "TPU009", "TPU010", "TPU019", "TPU021")
 
 
 class Edit:
@@ -223,6 +233,61 @@ def _import_jax_edit(module: ModuleInfo, offs: List[int]) -> Edit:
     return Edit(pos, pos, "import jax\n")
 
 
+# ------------------------------------------------------------------ TPU019
+
+def _fix_lock_timeout(module: ModuleInfo, call: ast.Call,
+                      offs: List[int]) -> Optional[Edit]:
+    """Append ``lock_timeout=5.0`` to a bounded-lock API call. The rule
+    only anchors on calls whose resolved target defines the parameter
+    and that don't already pass it, so appending is always valid."""
+    if any(kw.arg == "lock_timeout" for kw in call.keywords):
+        return None                 # already fixed (stale finding)
+    src = module.source
+    start, end = _span(src, offs, call)
+    seg = src[start:end]
+    if not seg.endswith(")"):
+        return None                 # parenthesized oddity: leave it
+    inner = seg[len(_seg(src, call.func)):].strip()
+    empty = inner in ("()", "( )")
+    text = "lock_timeout=5.0)" if empty else ", lock_timeout=5.0)"
+    return Edit(end - 1, end, text)
+
+
+# ------------------------------------------------------------------ TPU021
+
+def _fix_exit_code(module: ModuleInfo, node: ast.Constant,
+                   offs: List[int]) -> Optional[Tuple[Edit, Optional[str]]]:
+    """Replace the literal with its constant name; also report the name
+    to import when the module doesn't already bind it."""
+    from .rules_concurrency import ExitCodeLiteralRule
+    name = ExitCodeLiteralRule.BY_VALUE.get(node.value)
+    if name is None:
+        return None
+    start, end = _span(module.source, offs, node)
+    bound = name in module.scope.imports.aliases or any(
+        isinstance(n, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in n.targets)
+        for n in module.nodes_by_fn.get(None, ()))
+    return Edit(start, end, name), (None if bound else name)
+
+
+def _import_names_edit(module: ModuleInfo, offs: List[int],
+                       names: List[str]) -> Edit:
+    """Insert a ``from deepspeed_tpu.exit_codes import ...`` after the
+    last top-level import (same placement logic as the jax import)."""
+    line = 0
+    for n in module.tree.body:
+        if isinstance(n, (ast.Import, ast.ImportFrom)):
+            line = max(line, n.end_lineno)
+        elif line == 0 and isinstance(n, ast.Expr) and isinstance(
+                n.value, ast.Constant) and isinstance(n.value.value, str):
+            line = n.end_lineno          # docstring
+    pos = offs[line] if line < len(offs) else len(module.source)
+    stmt = f"from deepspeed_tpu.exit_codes import " \
+           f"{', '.join(sorted(set(names)))}\n"
+    return Edit(pos, pos, stmt)
+
+
 # ------------------------------------------------------------------ driver
 
 def compute_edits(module: ModuleInfo,
@@ -235,6 +300,7 @@ def compute_edits(module: ModuleInfo,
     edits: List[Edit] = []
     wrapped_stmts = set()
     want_jax_import = False
+    want_exit_names: List[str] = []
     tpu009_ctx: Optional[Dict[int, Tuple[ast.AST, str]]] = None
     for f in findings:
         if f.node is None:
@@ -265,8 +331,24 @@ def compute_edits(module: ModuleInfo,
                 wrapped_stmts.add(id(stmt))
                 edits.append(e)
                 want_jax_import = _needs_jax_import(module) or want_jax_import
+        elif f.rule == "TPU019":
+            # only the missing-lock_timeout findings anchor on a Call
+            # (with/acquire sites are report-only by design)
+            if isinstance(f.node, ast.Call):
+                e = _fix_lock_timeout(module, f.node, offs)
+                if e:
+                    edits.append(e)
+        elif f.rule == "TPU021":
+            if isinstance(f.node, ast.Constant):
+                res = _fix_exit_code(module, f.node, offs)
+                if res:
+                    edits.append(res[0])
+                    if res[1]:
+                        want_exit_names.append(res[1])
     if want_jax_import:
         edits.append(_import_jax_edit(module, offs))
+    if want_exit_names:
+        edits.append(_import_names_edit(module, offs, want_exit_names))
     # outermost-first on overlap: sort by (start, -end) and drop any edit
     # that overlaps one already kept
     edits.sort(key=lambda e: (e.start, -e.end))

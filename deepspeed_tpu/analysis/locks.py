@@ -1,0 +1,715 @@
+"""Lock-and-thread model for graftlint's concurrency rules (TPU016–TPU019).
+
+Per-module analysis answers "is this node under a trace?"; the call graph
+(callgraph.py) answers "what does this call land on?". This pass answers
+the questions the supervision-stack review passes kept re-deriving by
+hand since PR 11:
+
+lock identity
+    Every statically-visible lock gets a dotted id. Module-level
+    ``_lock = threading.Lock()`` assignments are collected the way
+    ``spec_constants`` collects ``P(...)`` (single-Name target, poisoned
+    on reassignment, resolvable through import/re-export chains);
+    ``self._mu = threading.Lock()`` in any method gives a class-scoped id
+    (``pkg.mod.Class._mu``) shared by subclasses through base-class
+    resolution; an attr that is a lock on exactly ONE project class and
+    an attr of no other resolves even through an opaque receiver
+    (``rep.lock`` → ``fleet._Replica.lock``). ``_mu``-style attrs owned
+    by several classes stay precise through ``self`` and are ambiguous
+    (None) through other receivers — the model never guesses.
+
+acquisition facts
+    Per function: ``with lock:`` items (region = the statement body plus
+    later items' context managers, which run while earlier locks are
+    held) and ``lock.acquire()`` calls (region = acquire → the matching
+    textual ``.release()`` on the same receiver, else end of function).
+    Boundedness reuses TPU015's timeout-slot logic: a bounded acquire
+    cannot participate in an unrecoverable deadlock, so bounded
+    acquisitions never create order edges or TPU017 regions — but they
+    DO count as protection for TPU018 (a successful bounded acquire
+    holds the lock).
+
+thread entries / exit roots
+    ``threading.Thread(target=...)``, executor ``.submit(fn, ...)``,
+    ``signal.signal(sig, handler)`` and ``atexit.register(fn)`` sites,
+    resolved to project defs. Signal/atexit handlers — plus watchdog
+    ``_fire`` and any ``stamp_terminal`` — are additionally *exit
+    roots*: everything reachable from them must obey the bounded
+    blocking discipline (TPU019).
+
+propagation
+    ``acquired_below`` / ``blocking_below`` walk call edges with the
+    same top-level-only memoization as ``reachable_collectives``;
+    ``context_held`` runs the classic intersection-meet fixpoint so "this
+    helper is only ever called with the replica lock held" is a fact
+    rules can use.
+
+Known blind spots (kept deliberately — see docs/LINT.md): calls through
+stored objects (``self._handoff.pop()``) do not resolve to defs, so
+propagation stops there; lock identity conflates instances of the same
+class (sound for ordering, approximate for TPU018); chaos failpoints in
+``testing/`` are injection points, not blocking calls, and are excluded
+from the blocking walk.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .callgraph import FunctionNode, ProjectIndex, _locally_bound
+from .rules import UnboundedBlockingRule as _UB
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+#: constructors whose result is a mutual-exclusion object
+LOCK_CTORS = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+    "multiprocessing.Lock", "multiprocessing.RLock",
+})
+
+#: constructors whose result is a synchronization primitive or a
+#: GIL-atomic container — attrs holding these are never "unsynchronized
+#: shared state" (TPU018 skips them)
+SYNC_CTORS = LOCK_CTORS | frozenset({
+    "threading.Event", "threading.Barrier", "queue.Queue",
+    "queue.SimpleQueue", "queue.LifoQueue", "queue.PriorityQueue",
+    "collections.deque",
+})
+
+#: dotted calls that block without a timeout convention
+_BLOCK_QUALS = {
+    "jax.device_get": "jax.device_get (device sync)",
+    "jax.device_put": "jax.device_put (device transfer)",
+    "jax.block_until_ready": "jax.block_until_ready (device sync)",
+    "jax.effects_barrier": "jax.effects_barrier (device sync)",
+    "time.sleep": "time.sleep",
+    "subprocess.run": "subprocess.run",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+}
+
+#: attribute spellings of a device sync (any receiver)
+_SYNC_ATTRS = {"block_until_ready", "device_get"}
+
+#: socket/process operations with no bounded variant in this codebase
+_IO_ATTRS = {"sendall", "recv", "recv_into", "accept", "connect",
+             "communicate"}
+
+#: engine-step attrs: unbounded device work when the receiver is opaque
+_ENGINE_ATTRS = {"step", "warm", "run_until_idle"}
+
+#: parameter names that denote an opaque caller-supplied callable
+_CB_PARAM = re.compile(r"^(fn|func|callback|exit_fn|on_[a-z_]+|[a-z_]*_fn)$")
+
+
+class LockAcq:
+    """One lock acquisition inside a function body."""
+
+    __slots__ = ("lock", "node", "kind", "item_idx", "bounded", "end_line")
+
+    def __init__(self, lock: str, node: ast.AST, kind: str,
+                 item_idx: int = 0, bounded: bool = False,
+                 end_line: int = 0):
+        self.lock = lock
+        self.node = node          # the With statement or the acquire Call
+        self.kind = kind          # "with" | "acquire"
+        self.item_idx = item_idx
+        self.bounded = bounded
+        self.end_line = end_line  # acquire-kind only
+
+    def __repr__(self):
+        return f"<acq {self.lock} {self.kind}@{self.node.lineno}>"
+
+
+class LockModel:
+    """Project-wide lock/thread facts, built once per lint run and cached
+    on the ProjectIndex (see :func:`get_model`)."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        #: dotted lock id -> ctor name, or None when poisoned (reassigned)
+        self.module_locks: Dict[str, Optional[str]] = {}
+        #: class id -> {attr: lock id}
+        self.class_locks: Dict[str, Dict[str, str]] = {}
+        #: class id -> every attr assigned through ``self.``
+        self.class_attrs: Dict[str, Set[str]] = {}
+        #: class id -> attrs assigned a SYNC_CTORS value (TPU018-exempt)
+        self.sync_attrs: Dict[str, Set[str]] = {}
+        #: class id -> base-class ids resolvable inside the project
+        self.class_bases: Dict[str, List[str]] = {}
+        #: ast fn -> enclosing class id
+        self.fn_class: Dict[ast.AST, str] = {}
+        #: attr -> lock id when exactly one project class owns the attr
+        #: AND it is a lock there; None marks an ambiguous attr
+        self.attr_unique_lock: Dict[str, Optional[str]] = {}
+        #: attr -> class id when exactly one project class owns the attr
+        self.attr_unique_class: Dict[str, Optional[str]] = {}
+        #: ast fn -> [LockAcq] (module-level code holds no locks we model)
+        self.fn_acqs: Dict[ast.AST, List[LockAcq]] = {}
+        #: ast fn -> how it becomes a thread entry
+        self.entries: Dict[ast.AST, str] = {}
+        #: ast fn -> why it is an exit root
+        self.exit_roots: Dict[ast.AST, str] = {}
+        #: ast fn -> set of entry fns whose threads reach it
+        self.entries_reaching: Dict[ast.AST, Set[ast.AST]] = {}
+        #: ast fn -> qualname of the exit root that reaches it
+        self.exit_reach: Dict[ast.AST, str] = {}
+
+        self._below: Dict[ast.AST, Dict[str, Tuple[str, int, str]]] = {}
+        self._blocking: Dict[ast.AST, Optional[Tuple[str, int, str, str]]] = {}
+        self._edges: Optional[Dict[Tuple[str, str], tuple]] = None
+        self._held: Optional[Dict[ast.AST, Optional[FrozenSet[str]]]] = None
+
+        for m in index.modules:
+            self._collect_module_locks(m)
+        for m in index.modules:
+            self._collect_classes(m)
+        self._finish_attr_tables()
+        for m in index.modules:
+            self._collect_acquisitions(m)
+        for m in index.modules:
+            self._collect_entries(m)
+        self._collect_named_roots()
+        self._compute_reachability()
+
+    # ------------------------------------------------------------ building
+
+    def _collect_module_locks(self, module) -> None:
+        """``_lock = threading.Lock()`` at module level, spec_constants
+        style: single Name target, poisoned on reassignment."""
+        dotted = self.index.mod_dotted[id(module)]
+        for node in module.nodes_by_fn.get(None, ()):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                target, value = node.target.id, node.value
+            else:
+                continue
+            key = f"{dotted}.{target}"
+            is_lock = (isinstance(value, ast.Call) and
+                       self.index.qualify(module, value.func) in LOCK_CTORS)
+            if not is_lock:
+                if key in self.module_locks:
+                    self.module_locks[key] = None   # poisoned
+                continue
+            if key in self.module_locks:
+                self.module_locks[key] = None       # reassigned: poisoned
+            else:
+                self.module_locks[key] = \
+                    self.index.qualify(module, value.func)
+
+    def _collect_classes(self, module) -> None:
+        dotted = self.index.mod_dotted[id(module)]
+        aliases = self.index._aliases.get(id(module), {})
+        for node in module.all_nodes:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cid = f"{dotted}.{module.enclosing_qualname(node)}"
+            attrs = self.class_attrs.setdefault(cid, set())
+            locks = self.class_locks.setdefault(cid, {})
+            syncs = self.sync_attrs.setdefault(cid, set())
+            bases: List[str] = []
+            for b in node.bases:
+                q = self.index.qualify(module, b)
+                if q is None:
+                    continue
+                if isinstance(b, ast.Name) and q == b.id \
+                        and b.id not in aliases:
+                    q = f"{dotted}.{b.id}"
+                bases.append(q)
+            self.class_bases[cid] = bases
+            for sub in ast.walk(node):
+                if isinstance(sub, _FN):
+                    self.fn_class.setdefault(sub, cid)
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(sub, ast.Assign):
+                    targets, value = sub.targets, sub.value
+                elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+                    targets, value = [sub.target], sub.value
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        attrs.add(t.attr)
+                        ctor = self.index.qualify(module, value.func) \
+                            if isinstance(value, ast.Call) else None
+                        if ctor in LOCK_CTORS:
+                            locks[t.attr] = f"{cid}.{t.attr}"
+                        if ctor in SYNC_CTORS:
+                            syncs.add(t.attr)
+
+    def _finish_attr_tables(self) -> None:
+        owners: Dict[str, Set[str]] = {}
+        lock_owners: Dict[str, Set[str]] = {}
+        for cid, attrs in self.class_attrs.items():
+            for a in attrs:
+                owners.setdefault(a, set()).add(cid)
+        for cid, locks in self.class_locks.items():
+            for a in locks:
+                lock_owners.setdefault(a, set()).add(cid)
+        for a, cids in owners.items():
+            self.attr_unique_class[a] = next(iter(cids)) \
+                if len(cids) == 1 else None
+        for a, cids in lock_owners.items():
+            if len(cids) == 1 and len(owners.get(a, cids)) == 1:
+                cid = next(iter(cids))
+                self.attr_unique_lock[a] = self.class_locks[cid][a]
+            else:
+                self.attr_unique_lock[a] = None
+
+    def class_lock_attr(self, cid: Optional[str], attr: str
+                        ) -> Optional[str]:
+        """Lock id for ``self.<attr>`` in class ``cid``, walking bases so
+        subclasses share the defining class's lock identity."""
+        seen: Set[str] = set()
+        while cid is not None and cid not in seen:
+            seen.add(cid)
+            lk = self.class_locks.get(cid, {}).get(attr)
+            if lk is not None:
+                return lk
+            nxt = None
+            for b in self.class_bases.get(cid, ()):
+                if b in self.class_attrs:
+                    nxt = b
+                    break
+            cid = nxt
+        return None
+
+    def resolve_lock_expr(self, module, expr: ast.AST,
+                          fn: Optional[ast.AST]) -> Optional[str]:
+        """Dotted lock id a Name/Attribute denotes, or None (not a lock
+        we know, or ambiguous — the model never guesses)."""
+        if isinstance(expr, ast.Name):
+            if _locally_bound(module, expr):
+                # a function-local ``lock = threading.Lock()``?
+                cur = module.enclosing_function(expr)
+                while cur is not None:
+                    for node in module.nodes_by_fn.get(cur, ()):
+                        if isinstance(node, ast.Assign) \
+                                and len(node.targets) == 1 \
+                                and isinstance(node.targets[0], ast.Name) \
+                                and node.targets[0].id == expr.id \
+                                and isinstance(node.value, ast.Call) \
+                                and self.index.qualify(
+                                    module, node.value.func) in LOCK_CTORS:
+                            fnode = self.index.node_of.get(cur)
+                            qual = fnode.dotted if fnode else "<fn>"
+                            return f"{qual}.<local>.{expr.id}"
+                    cur = module.enclosing_function(cur)
+                return None
+            q = self.index.qualify(module, expr)
+            if q is None:
+                return None
+            if q == expr.id:
+                q = f"{self.index.mod_dotted[id(module)]}.{expr.id}"
+            return self._module_lock(q)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                return self.class_lock_attr(self.fn_class.get(fn),
+                                            expr.attr)
+            q = self.index.qualify(module, expr)
+            if q is not None:
+                lk = self._module_lock(q)
+                if lk is not None:
+                    return lk
+            return self.attr_unique_lock.get(expr.attr)
+        return None
+
+    def _module_lock(self, dotted: str) -> Optional[str]:
+        seen: Set[str] = set()
+        while dotted not in self.module_locks \
+                and dotted in self.index._reexports and dotted not in seen:
+            seen.add(dotted)
+            dotted = self.index._reexports[dotted]
+        return dotted if self.module_locks.get(dotted) else None
+
+    def _collect_acquisitions(self, module) -> None:
+        for fn in module.nodes_by_fn:
+            if fn is None:
+                continue
+            acqs: List[LockAcq] = []
+            for node in module.nodes_by_fn[fn]:
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for idx, item in enumerate(node.items):
+                        lk = self.resolve_lock_expr(
+                            module, item.context_expr, fn)
+                        if lk:
+                            acqs.append(LockAcq(lk, node, "with", idx))
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "acquire":
+                    lk = self.resolve_lock_expr(module, node.func.value, fn)
+                    if not lk:
+                        continue
+                    recv = ast.unparse(node.func.value)
+                    end = getattr(fn, "end_lineno", 0) or 10 ** 9
+                    for other in module.nodes_by_fn[fn]:
+                        if isinstance(other, ast.Call) \
+                                and isinstance(other.func, ast.Attribute) \
+                                and other.func.attr == "release" \
+                                and other.lineno >= node.lineno \
+                                and ast.unparse(other.func.value) == recv:
+                            end = min(end, other.lineno)
+                    acqs.append(LockAcq(lk, node, "acquire",
+                                        bounded=_UB._bounded(node),
+                                        end_line=end))
+            if acqs:
+                self.fn_acqs[fn] = acqs
+
+    def _resolve_callable(self, module, expr: ast.AST) -> Optional[ast.AST]:
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Name):
+            t = module.scope.resolve_local_def(expr)
+            if t is not None:
+                return t
+            dotted = self.index._aliases.get(id(module), {}).get(expr.id)
+            fnode = self.index.resolve_dotted(dotted) if dotted else None
+            return fnode.fn if fnode else None
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                defs = module.scope._by_name.get(expr.attr)
+                return defs[-1] if defs else None
+            dotted = self.index.qualify(module, expr)
+            fnode = self.index.resolve_dotted(dotted) if dotted else None
+            return fnode.fn if fnode else None
+        return None
+
+    def _collect_entries(self, module) -> None:
+        for call in module.all_calls:
+            q = self.index.qualify(module, call.func)
+            if q == "threading.Thread":
+                target = next((kw.value for kw in call.keywords
+                               if kw.arg == "target"), None)
+                fn = self._resolve_callable(module, target) \
+                    if target is not None else None
+                if fn is not None:
+                    self.entries.setdefault(fn, "threading.Thread target")
+            elif isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "submit" and call.args:
+                fn = self._resolve_callable(module, call.args[0])
+                if fn is not None:
+                    self.entries.setdefault(fn, "executor submit")
+            elif q == "signal.signal" and len(call.args) >= 2:
+                fn = self._resolve_callable(module, call.args[1])
+                if fn is not None:
+                    self.entries.setdefault(fn, "signal handler")
+                    self.exit_roots.setdefault(fn, "signal handler")
+            elif q == "atexit.register" and call.args:
+                fn = self._resolve_callable(module, call.args[0])
+                if fn is not None:
+                    self.exit_roots.setdefault(fn, "atexit handler")
+
+    def _collect_named_roots(self) -> None:
+        """Roots the registration sites can't reveal: the watchdog's
+        ``_fire`` runs on the watchdog thread when the process is already
+        presumed wedged, and any ``stamp_terminal`` is the
+        last-words-before-exit path by contract."""
+        for fn, fnode in self.index.node_of.items():
+            name = getattr(fn, "name", "")
+            base = fnode.module.rel_path.rsplit("/", 1)[-1]
+            if name == "_fire" and base == "watchdog.py":
+                self.exit_roots.setdefault(fn, "watchdog._fire")
+            elif name == "stamp_terminal":
+                self.exit_roots.setdefault(fn, "terminal stamp path")
+
+    def _reach_from(self, root: ast.AST) -> Set[ast.AST]:
+        out: Set[ast.AST] = set()
+        stack = [root]
+        while stack:
+            fn = stack.pop()
+            if fn in out:
+                continue
+            out.add(fn)
+            fnode = self.index.node_of.get(fn)
+            if fnode is None:
+                continue
+            for _c, target, _g in self.index.call_edges(fnode.module, fn):
+                if target.fn not in out:
+                    stack.append(target.fn)
+        return out
+
+    def _compute_reachability(self) -> None:
+        for entry in self.entries:
+            for fn in self._reach_from(entry):
+                self.entries_reaching.setdefault(fn, set()).add(entry)
+        for root, why in self.exit_roots.items():
+            fnode = self.index.node_of.get(root)
+            qual = fnode.qualname if fnode else getattr(root, "name", "?")
+            for fn in self._reach_from(root):
+                self.exit_reach.setdefault(fn, f"{qual} ({why})")
+
+    # ------------------------------------------------------------ coverage
+
+    def covered(self, module, acq: LockAcq, node: ast.AST) -> bool:
+        """Does ``node`` execute while ``acq``'s lock is held?"""
+        if node is acq.node:
+            return False
+        if acq.kind == "acquire":
+            ln = getattr(node, "lineno", None)
+            return ln is not None and \
+                (acq.node.end_lineno or acq.node.lineno) < ln <= acq.end_line
+        chain: Set[ast.AST] = set()
+        cur: Optional[ast.AST] = node
+        while cur is not None and cur is not acq.node:
+            chain.add(cur)
+            cur = module.parent(cur)
+        if cur is not acq.node:
+            return False
+        # inside the With — but items up to and including ours run their
+        # context expressions BEFORE this lock is held
+        for j in range(acq.item_idx + 1):
+            item = acq.node.items[j]
+            if item.context_expr is node or item.context_expr in chain:
+                return False
+        return True
+
+    def locks_covering(self, module, fn: Optional[ast.AST], node: ast.AST,
+                       include_bounded: bool = False) -> Set[str]:
+        out: Set[str] = set()
+        for acq in self.fn_acqs.get(fn, ()):
+            if acq.bounded and not include_bounded:
+                continue
+            if self.covered(module, acq, node):
+                out.add(acq.lock)
+        return out
+
+    # ------------------------------------------------------- propagation
+
+    def acquired_below(self, fnode: FunctionNode,
+                       _stack: Optional[Set[ast.AST]] = None
+                       ) -> Dict[str, Tuple[str, int, str]]:
+        """Unbounded acquisitions reachable from ``fnode`` (itself
+        included): {lock id: (rel_path, line, qualname)}. Top-level-only
+        memoization, same reasoning as ``reachable_collectives``."""
+        fn = fnode.fn
+        if fn in self._below:
+            return self._below[fn]
+        stack = _stack if _stack is not None else set()
+        if fn in stack:
+            return {}
+        stack.add(fn)
+        out: Dict[str, Tuple[str, int, str]] = {}
+        m = fnode.module
+        for acq in self.fn_acqs.get(fn, ()):
+            if not acq.bounded and acq.lock not in out:
+                out[acq.lock] = (m.rel_path, acq.node.lineno,
+                                 fnode.qualname)
+        for _call, target, _g in self.index.call_edges(m, fn):
+            for lk, w in self.acquired_below(target, stack).items():
+                out.setdefault(lk, w)
+        stack.discard(fn)
+        if _stack is None:
+            self._below[fn] = out
+        return out
+
+    def blocking_below(self, fnode: FunctionNode,
+                       _stack: Optional[Set[ast.AST]] = None
+                       ) -> Optional[Tuple[str, int, str, str]]:
+        """First unbounded-blocking witness reachable from ``fnode``:
+        (rel_path, line, qualname, reason), or None. ``testing/`` modules
+        are injection points, not blocking code, and are skipped."""
+        fn = fnode.fn
+        if fn in self._blocking:
+            return self._blocking[fn]
+        stack = _stack if _stack is not None else set()
+        if fn in stack:
+            return None
+        stack.add(fn)
+        out: Optional[Tuple[str, int, str, str]] = None
+        m = fnode.module
+        if "testing/" not in m.rel_path:
+            for node in m.nodes_by_fn.get(fn, ()):
+                if isinstance(node, ast.Call):
+                    reason = self.blocking_reason(m, node, fn)
+                    if reason is not None:
+                        out = (m.rel_path, node.lineno, fnode.qualname,
+                               reason)
+                        break
+            if out is None:
+                for _call, target, _g in self.index.call_edges(m, fn):
+                    below = self.blocking_below(target, stack)
+                    if below is not None:
+                        out = below
+                        break
+        stack.discard(fn)
+        if _stack is None:
+            self._blocking[fn] = out
+        return out
+
+    def blocking_reason(self, module, call: ast.Call,
+                        fn: Optional[ast.AST]) -> Optional[str]:
+        """Why this call can block unboundedly / sync the device, or
+        None. Acquisitions of *resolvable* locks return None — nesting is
+        TPU016's domain, and a Condition.wait on the held lock releases
+        it rather than blocking under it."""
+        f = call.func
+        if isinstance(f, ast.Lambda):
+            return None
+        q = self.index.qualify(module, f)
+        if q in _BLOCK_QUALS:
+            return _BLOCK_QUALS[q]
+        attr = f.attr if isinstance(f, ast.Attribute) else ""
+        if attr in _SYNC_ATTRS:
+            return f".{attr}() (device sync)"
+        if module.scope.is_jit_call(call):
+            return "a jit-compiled computation"
+        cq = self.index.collective_name(module, call)
+        if cq:
+            return f"collective {cq}"
+        target = self.index.resolve_call(module, call)
+        if target is not None \
+                and target.module.scope.fn_traced(target.fn):
+            return f"traced function {target.qualname}"
+        if attr in _IO_ATTRS:
+            return f"blocking I/O .{attr}()"
+        if target is None and attr in _ENGINE_ATTRS:
+            return f".{attr}() (unbounded device work)"
+        if isinstance(f, ast.Name) and fn is not None:
+            args = getattr(fn, "args", None)
+            if args is not None and _CB_PARAM.match(f.id):
+                all_params = (list(args.args) + list(args.posonlyargs)
+                              + list(args.kwonlyargs))
+                if any(a.arg == f.id for a in all_params):
+                    return f"opaque callback {f.id}()"
+        if isinstance(f, ast.Attribute):
+            recv = _UB._receiver(f)
+            if attr == "join" and not call.args and not call.keywords:
+                return f"unbounded {recv or 'thread'}.join()"
+            if attr in ("acquire", "wait", "get") \
+                    and not _UB._bounded(call):
+                if self.resolve_lock_expr(module, f.value, fn) is not None:
+                    return None       # known lock: TPU016/TPU019 territory
+                if attr == "acquire" and _UB._LOCKISH.search(recv):
+                    return f"unbounded {recv}.acquire()"
+                if attr == "wait" and _UB._EVENTISH.search(recv):
+                    return f"unbounded {recv}.wait()"
+                if attr == "get" and _UB._QUEUEISH.search(recv):
+                    return f"unbounded {recv}.get()"
+        return None
+
+    # --------------------------------------------------------- lock order
+
+    def order_edges(self) -> Dict[Tuple[str, str], tuple]:
+        """(outer lock, inner lock) -> (module, node, qualname, detail):
+        somewhere in the project the inner lock is acquired — directly or
+        through calls — while the outer is held. First witness wins."""
+        if self._edges is not None:
+            return self._edges
+        edges: Dict[Tuple[str, str], tuple] = {}
+        for m in self.index.modules:
+            for fn in m.nodes_by_fn:
+                if fn is None:
+                    continue
+                acqs = [a for a in self.fn_acqs.get(fn, ())
+                        if not a.bounded]
+                if not acqs:
+                    continue
+                qual = m.enclosing_qualname(fn)
+                for a in acqs:
+                    for b in acqs:
+                        if b.lock != a.lock \
+                                and self.covered(m, a, b.node):
+                            edges.setdefault(
+                                (a.lock, b.lock),
+                                (m, a.node, qual,
+                                 f"{self.short(b.lock)} acquired at "
+                                 f"{m.rel_path}:{b.node.lineno}"))
+                    for call, target, _g in self.index.call_edges(m, fn):
+                        if not self.covered(m, a, call):
+                            continue
+                        for lk, (rel, ln, tq) in \
+                                self.acquired_below(target).items():
+                            if lk == a.lock:
+                                continue
+                            edges.setdefault(
+                                (a.lock, lk),
+                                (m, call, qual,
+                                 f"via {target.qualname}(): "
+                                 f"{self.short(lk)} acquired at "
+                                 f"{rel}:{ln} in {tq}"))
+        self._edges = edges
+        return edges
+
+    def inversions(self) -> List[Tuple[Tuple[str, str], tuple, tuple]]:
+        """[(ordered pair, witness for that order, witness for the
+        opposite order)] — each inversion reported once, anchored on the
+        lexicographically-first direction's witness."""
+        edges = self.order_edges()
+        out = []
+        for (a, b), w in sorted(edges.items()):
+            if a < b and (b, a) in edges:
+                out.append(((a, b), w, edges[(b, a)]))
+        return out
+
+    # --------------------------------------------------------- held context
+
+    def context_held(self, fn: ast.AST) -> FrozenSet[str]:
+        """Locks held at EVERY call site of ``fn`` (intersection-meet
+        fixpoint; thread entries and uncalled functions hold nothing)."""
+        if self._held is None:
+            self._compute_context_held()
+        held = self._held.get(fn)
+        return held if held is not None else frozenset()
+
+    def _compute_context_held(self) -> None:
+        sites: Dict[ast.AST, List[tuple]] = {}
+        for m in self.index.modules:
+            for fn in m.nodes_by_fn:
+                if fn is None:
+                    continue
+                for call, target, _g in self.index.call_edges(m, fn):
+                    sites.setdefault(target.fn, []).append((m, fn, call))
+        held: Dict[ast.AST, Optional[FrozenSet[str]]] = {}
+        for m in self.index.modules:
+            for fn in m.nodes_by_fn:
+                if fn is None:
+                    continue
+                if fn in self.entries or fn not in sites:
+                    held[fn] = frozenset()
+                else:
+                    held[fn] = None                     # TOP (no info yet)
+        for _pass in range(20):
+            changed = False
+            for fn, slist in sites.items():
+                if fn in self.entries:
+                    continue            # entry: runs with nothing held
+                acc: Optional[Set[str]] = None
+                for m, cfn, call in slist:
+                    ctx = held.get(cfn)
+                    if ctx is None:
+                        continue                        # optimistic: TOP
+                    site = self.locks_covering(m, cfn, call,
+                                               include_bounded=True) | ctx
+                    acc = set(site) if acc is None else (acc & site)
+                if acc is None:
+                    continue
+                new = frozenset(acc)
+                if held.get(fn) != new:
+                    held[fn] = new
+                    changed = True
+            if not changed:
+                break
+        self._held = held
+
+    # ------------------------------------------------------------- display
+
+    def short(self, lock_id: str) -> str:
+        return lock_id[len("deepspeed_tpu."):] \
+            if lock_id.startswith("deepspeed_tpu.") else lock_id
+
+
+def get_model(index: ProjectIndex) -> LockModel:
+    """The lint run's LockModel, built once and cached on the index."""
+    model = getattr(index, "_gl_lock_model", None)
+    if model is None:
+        model = LockModel(index)
+        index._gl_lock_model = model
+    return model

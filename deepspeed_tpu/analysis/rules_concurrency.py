@@ -1,0 +1,532 @@
+"""graftlint rules TPU016–TPU021: concurrency safety for the supervision
+stack, plus two small contract-sync rules that ride the same sweep.
+
+TPU016–TPU019 consume the lock-and-thread model (locks.py) the way
+TPU011–TPU013 consume the call graph: the model resolves lock identity
+through self-attrs and imports, discovers thread entries and exit roots,
+and propagates held locks through call edges; the rules pattern-match the
+four bug shapes every review pass since PR 11 has caught by hand.
+
+TPU020 keeps the chaos failpoint catalog, the docs table and the source
+instrumentation in sync; TPU021 keeps the process exit-code contract
+single-sourced in ``exit_codes.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .. import exit_codes as _ec
+from .core import Finding, ModuleInfo, Rule, Severity, register
+from .locks import LockModel, get_model
+from .rules import UnboundedBlockingRule as _UB
+
+
+def _model(module: ModuleInfo) -> Optional[LockModel]:
+    if module.project is None:
+        return None
+    return get_model(module.project)
+
+
+@register
+class LockOrderRule(Rule):
+    """TPU016 — lock-order inversion anywhere in the project.
+
+    Two locks acquired in opposite nesting orders — directly or through
+    any chain of resolvable calls — deadlock the first time the two
+    paths interleave: thread 1 holds A and blocks on B while thread 2
+    holds B and blocks on A. This is the fleet/handoff/pool shape the
+    serving-tier review passes kept checking by hand (the replica lock,
+    the handoff mutex and the block-pool mutex each guard a different
+    tier and call across tiers). Bounded acquisitions
+    (``acquire(timeout=...)``) never create order edges: they fail
+    gracefully instead of deadlocking, and the codebase uses exactly
+    that idiom (``_replica_down``'s fence) to break cycles on purpose —
+    so the FIX for a true inversion is either to swap the nesting or to
+    bound one side.
+
+    Each inversion is reported once, anchored on the witness of the
+    lexicographically-first direction, citing both acquisition chains
+    with file:line so the cycle is reviewable without re-deriving it.
+    """
+
+    code = "TPU016"
+    name = "lock-order-inversion"
+    severity = Severity.ERROR
+    summary = "two locks acquired in opposite nesting orders"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        model = _model(module)
+        if model is None:
+            return
+        for (a, b), w_ab, w_ba in model.inversions():
+            m1, node1, qual1, detail1 = w_ab
+            m2, node2, qual2, detail2 = w_ba
+            if m1 is not module:
+                continue        # anchored in the other module's sweep
+            yield self.finding(
+                module, node1,
+                f"lock-order inversion between {model.short(a)} and "
+                f"{model.short(b)}: {qual1} holds {model.short(a)} and "
+                f"takes {model.short(b)} ({detail1}), but {qual2} at "
+                f"{m2.rel_path}:{node2.lineno} holds {model.short(b)} "
+                f"and takes {model.short(a)} ({detail2}) — interleaved, "
+                f"the two threads deadlock; swap the nesting or bound "
+                f"one acquisition with a timeout")
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    """TPU017 — blocking call or device sync while holding a lock.
+
+    A lock held across a jit-compiled step, a ``device_get``/
+    ``block_until_ready`` sync, a collective, socket I/O, an opaque
+    engine ``.step()``/callback, or a TPU015-class unbounded blocking
+    call turns an XLA wedge (or a dead peer) into a held lock — and the
+    supervisor that exists to detect the wedge then blocks on that very
+    lock. PR 11 fixed exactly this by hand (the fleet worker now steps
+    OUTSIDE the replica lock); this rule machine-checks the shape,
+    including transitively: a call under the lock whose callee reaches a
+    blocking site is cited with the full chain.
+
+    Regions entered through a *bounded* acquire are exempt — the
+    codebase's convention is that long-hold locks are only ever taken
+    with a timeout by other threads, so a bounded-entry region blocking
+    is survivable by design. ``Condition.wait`` on the held lock is also
+    exempt (wait releases it). Deliberate long holds (engine warmup,
+    donation-discipline device calls) get a suppression with a
+    justification, not a redesign.
+    """
+
+    code = "TPU017"
+    name = "blocking-under-lock"
+    severity = Severity.WARNING
+    summary = "blocking call or device sync while holding a lock"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        model = _model(module)
+        if model is None:
+            return
+        index = module.project
+        for fn in module.nodes_by_fn:
+            if fn is None:
+                continue
+            acqs = [a for a in model.fn_acqs.get(fn, ())
+                    if not a.bounded]
+            if not acqs:
+                continue
+            emitted: Set[str] = set()
+            for node in module.nodes_by_fn[fn]:
+                if not isinstance(node, ast.Call):
+                    continue
+                covering = [a for a in acqs
+                            if a.lock not in emitted
+                            and model.covered(module, a, node)]
+                if not covering:
+                    continue
+                reason = model.blocking_reason(module, node, fn)
+                if reason is None:
+                    target = index.resolve_call(module, node)
+                    if target is not None:
+                        below = model.blocking_below(target)
+                        if below is not None:
+                            rel, ln, qual, why = below
+                            reason = (f"a call into {target.qualname}() "
+                                      f"that reaches {why} at {rel}:{ln} "
+                                      f"(in {qual})")
+                if reason is None:
+                    continue
+                for acq in covering:
+                    emitted.add(acq.lock)
+                    yield self.finding(
+                        module, node,
+                        f"{model.short(acq.lock)} (held since line "
+                        f"{acq.node.lineno}) is held across {reason}: a "
+                        f"wedge there keeps the lock and starves every "
+                        f"waiter — move the blocking work outside the "
+                        f"lock, or take the lock with a timeout")
+
+
+@register
+class SharedStateRule(Rule):
+    """TPU018 — unsynchronized shared mutable state across threads.
+
+    An attribute written from one thread entry's reachable code and read
+    or written from a DIFFERENT entry's reachable code, with no lock
+    common to both access sites (neither held in-function nor guaranteed
+    by every caller), is a data race: torn reads, lost updates, and the
+    monitor-thread-reads-stale-status bugs the launcher review passes
+    fixed by hand. The rule is a heuristic and says so: it models
+    ``self.attr`` and unique-attr receivers only, ignores container
+    mutation through method calls, treats all instances of a class as
+    one, and trusts the intersection-meet held-lock propagation — so it
+    lists its evidence (both sites, both entries, both lock sets) and is
+    meant to be suppressed with a justification where the race is
+    benign (monotonic flags, single-writer-then-join protocols).
+
+    Attrs holding synchronization primitives or GIL-atomic deques are
+    exempt; accesses only reachable from the main thread never conflict
+    (two distinct entries are required); one finding per (class, attr).
+    """
+
+    code = "TPU018"
+    name = "unsynchronized-shared-state"
+    severity = Severity.WARNING
+    summary = "attr shared across threads with no common lock"
+
+    _INIT_NAMES = ("__init__", "__post_init__")
+
+    def _records(self, model: LockModel) -> Dict[Tuple[str, str], List[dict]]:
+        """(class id, attr) -> access records, computed once per run."""
+        cached = getattr(model, "_tpu018_records", None)
+        if cached is not None:
+            return cached
+        recs: Dict[Tuple[str, str], List[dict]] = {}
+        index = model.index
+        for m in index.modules:
+            for fn in m.nodes_by_fn:
+                if fn is None:
+                    continue
+                entries = model.entries_reaching.get(fn)
+                if not entries:
+                    continue        # main-thread-only code never conflicts
+                in_init = getattr(fn, "name", "") in self._INIT_NAMES
+                held_ctx = model.context_held(fn)
+                for node in m.nodes_by_fn[fn]:
+                    if not isinstance(node, ast.Attribute) \
+                            or not isinstance(node.value, ast.Name):
+                        continue
+                    if node.value.id == "self":
+                        cid = model.fn_class.get(fn)
+                    else:
+                        cid = model.attr_unique_class.get(node.attr)
+                    if cid is None or node.attr not in \
+                            model.class_attrs.get(cid, ()):
+                        continue
+                    if node.attr in model.sync_attrs.get(cid, ()) \
+                            or node.attr in model.class_locks.get(cid, {}):
+                        continue
+                    is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+                    locks = model.locks_covering(
+                        m, fn, node, include_bounded=True) | held_ctx
+                    recs.setdefault((cid, node.attr), []).append({
+                        "module": m, "fn": fn, "node": node,
+                        "write": is_write, "init": in_init,
+                        "entries": entries, "locks": locks,
+                    })
+        model._tpu018_records = recs
+        return recs
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        model = _model(module)
+        if model is None:
+            return
+        for (cid, attr), recs in sorted(
+                self._records(model).items(),
+                key=lambda kv: (kv[0][0], kv[0][1])):
+            writes = [r for r in recs if r["write"] and not r["init"]]
+            if not writes:
+                continue
+            conflict = None
+            for w in writes:
+                for r in recs:
+                    if r is w:
+                        continue
+                    if len(w["entries"] | r["entries"]) < 2:
+                        continue    # one entry = one thread per instance
+                    if w["locks"] & r["locks"]:
+                        continue    # a common lock serializes them
+                    conflict = (w, r)
+                    break
+                if conflict:
+                    break
+            if conflict is None:
+                continue
+            w, r = conflict
+            if w["module"] is not module:
+                continue            # anchored in the writing module
+            index = model.index
+
+            def _where(rec):
+                e = sorted(index.node_of[x].qualname
+                           for x in rec["entries"]
+                           if x in index.node_of)
+                locks = ", ".join(sorted(model.short(x)
+                                         for x in rec["locks"])) or "none"
+                return (f"{rec['module'].rel_path}:{rec['node'].lineno} "
+                        f"(thread entry {'/'.join(e) or '?'}; locks held: "
+                        f"{locks})")
+
+            kind = "written" if r["write"] else "read"
+            yield self.finding(
+                module, w["node"],
+                f"{model.short(cid)}.{attr} is written at {_where(w)} "
+                f"and {kind} at {_where(r)} with no common lock: threads "
+                f"from different entries race on it — guard both sides "
+                f"with one lock, or suppress with a justification if the "
+                f"race is benign")
+
+
+@register
+class ExitPathBlockingRule(Rule):
+    """TPU019 — unbounded blocking on an exit path.
+
+    Code reachable from a signal handler, an atexit hook, the watchdog's
+    ``_fire``, or any terminal-stamp path runs when the process is
+    already dying — often on a thread that interrupted the lock's
+    current holder. An unbounded ``acquire()``/``with lock:``, an
+    unbounded ``wait``/``join``/``get``, or a call into a bounded-lock
+    API *without* its ``lock_timeout=`` turns "exit with diagnostics"
+    into a self-deadlock: PR 6's second review pass fixed exactly this
+    three times (heartbeat exit paths, the watchdog's terminal stamp,
+    the preemption handler's re-acquire). Calls into APIs that expose a
+    ``lock_timeout=None`` parameter are autofixable (``--fix`` threads
+    ``lock_timeout=5.0`` through); raw ``with``/``acquire`` sites are
+    report-only because bounding them changes control flow the author
+    must own (what happens when the acquire times out?).
+    """
+
+    code = "TPU019"
+    name = "exit-path-blocking"
+    severity = Severity.WARNING
+    summary = "unbounded blocking reachable from an exit path"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        model = _model(module)
+        if model is None:
+            return
+        index = module.project
+        for fn in module.nodes_by_fn:
+            if fn is None or fn not in model.exit_reach:
+                continue
+            root = model.exit_reach[fn]
+            seen: Set[ast.AST] = set()
+            for acq in model.fn_acqs.get(fn, ()):
+                if acq.bounded or acq.node in seen:
+                    continue
+                seen.add(acq.node)
+                how = "with-statement" if acq.kind == "with" \
+                    else ".acquire() with no timeout"
+                yield self.finding(
+                    module, acq.node,
+                    f"unbounded {how} on {model.short(acq.lock)} on an "
+                    f"exit path (reachable from {root}): if the holder "
+                    f"is the wedged code this exit is escaping, the exit "
+                    f"deadlocks — acquire with a timeout and degrade to "
+                    f"exiting without the protected work")
+            for node in module.nodes_by_fn[fn]:
+                if not isinstance(node, ast.Call) or node in seen:
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    recv = _UB._receiver(f)
+                    flagged = None
+                    if f.attr == "join" and not node.args \
+                            and not node.keywords:
+                        flagged = f"{recv or 'thread'}.join()"
+                    elif f.attr == "acquire" and not _UB._bounded(node) \
+                            and model.resolve_lock_expr(
+                                module, f.value, fn) is None \
+                            and _UB._LOCKISH.search(recv):
+                        flagged = f"{recv}.acquire()"
+                    elif f.attr == "wait" and not _UB._bounded(node) \
+                            and _UB._EVENTISH.search(recv):
+                        flagged = f"{recv}.wait()"
+                    elif f.attr == "get" and not _UB._bounded(node) \
+                            and _UB._QUEUEISH.search(recv):
+                        flagged = f"{recv}.get()"
+                    if flagged:
+                        seen.add(node)
+                        yield self.finding(
+                            module, node,
+                            f"unbounded {flagged} on an exit path "
+                            f"(reachable from {root}): bound it and "
+                            f"handle the timed-out case")
+                        continue
+                target = index.resolve_call(module, node)
+                if target is None:
+                    continue
+                args = getattr(target.fn, "args", None)
+                if args is None:
+                    continue
+                has_param = any(
+                    a.arg == "lock_timeout"
+                    for a in (list(args.args) + list(args.kwonlyargs)))
+                if not has_param:
+                    continue
+                if any(kw.arg == "lock_timeout" for kw in node.keywords):
+                    continue
+                seen.add(node)
+                yield self.finding(
+                    module, node,
+                    f"{target.qualname}() called on an exit path "
+                    f"(reachable from {root}) without lock_timeout=: "
+                    f"the API blocks unboundedly by default — pass "
+                    f"lock_timeout= (autofixable with --fix)")
+
+
+@register
+class FailpointCatalogRule(Rule):
+    """TPU020 — chaos failpoint name missing from the catalog or docs.
+
+    Every ``failpoint("name")`` / ``chaos.flag("name")`` instrumentation
+    site in the package must use a name listed in ``testing/chaos.py``'s
+    ``FAILPOINTS`` catalog AND documented in docs/RESILIENCE.md's
+    failpoint table — the failpoint analogue of
+    ``test_facade_catalog_covers_comm_module``. An undocumented
+    failpoint is a resilience hook nobody can discover from the docs; a
+    cataloged-but-renamed one silently orphans every chaos test spec
+    that armed the old name. The rule is silent when the lint run does
+    not include the chaos module (snippet fixtures) and skips the docs
+    check when RESILIENCE.md is absent.
+    """
+
+    code = "TPU020"
+    name = "failpoint-catalog-drift"
+    severity = Severity.WARNING
+    summary = "failpoint name missing from catalog or docs table"
+
+    _NAME_RE = re.compile(r"`([a-z][a-z0-9_]*\.[a-z0-9_.]+)`")
+
+    def _catalog(self, index) -> Optional[Tuple[Set[str], Optional[Set[str]]]]:
+        cached = getattr(index, "_gl_failpoints", False)
+        if cached is not False:
+            return cached
+        out = None
+        for m in index.modules:
+            if not m.rel_path.endswith("testing/chaos.py"):
+                continue
+            names: Set[str] = set()
+            for node in m.nodes_by_fn.get(None, ()):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    target, value = node.targets[0].id, node.value
+                elif isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name) \
+                        and node.value is not None:
+                    target, value = node.target.id, node.value
+                else:
+                    continue
+                if target == "FAILPOINTS" and isinstance(value, ast.Dict):
+                    for k in value.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            names.add(k.value)
+            documented: Optional[Set[str]] = None
+            doc = os.path.join(os.path.dirname(m.path), os.pardir,
+                               os.pardir, "docs", "RESILIENCE.md")
+            try:
+                with open(doc, "r", encoding="utf-8") as fh:
+                    documented = set(self._NAME_RE.findall(fh.read()))
+            except OSError:
+                documented = None
+            out = (names, documented)
+            break
+        index._gl_failpoints = out
+        return out
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if module.project is None:
+            return
+        catalog = self._catalog(module.project)
+        if catalog is None:
+            return
+        names, documented = catalog
+        for call in module.all_calls:
+            q = module.project.qualify(module, call.func)
+            if q is None or not (q.endswith("chaos.failpoint")
+                                 or q.endswith("chaos.flag")):
+                continue
+            if not call.args or not isinstance(call.args[0], ast.Constant) \
+                    or not isinstance(call.args[0].value, str):
+                continue
+            name = call.args[0].value
+            if name not in names:
+                yield self.finding(
+                    module, call,
+                    f"failpoint '{name}' is not in testing/chaos.py's "
+                    f"FAILPOINTS catalog: add it (with a one-line "
+                    f"where-it-fires) so chaos specs and docs can "
+                    f"discover it")
+            elif documented is not None and name not in documented:
+                yield self.finding(
+                    module, call,
+                    f"failpoint '{name}' is cataloged but missing from "
+                    f"docs/RESILIENCE.md's failpoint table: document it "
+                    f"so the resilience matrix stays complete")
+
+
+@register
+class ExitCodeLiteralRule(Rule):
+    """TPU021 — hardcoded exit-code literal outside ``exit_codes.py``.
+
+    The rc contract (114 preemption / 117 stall / 118 integrity /
+    13 chaos kill) is dispatch logic spread across five layers; a raw
+    literal that drifts from the constant breaks restart accounting
+    silently (a 117 counted as preemption burns no restart budget; a 114
+    counted as a crash exhausts it). 114/117/118 are flagged anywhere in
+    code (they are contract-reserved values); 13 only in exit-shaped
+    contexts (an ``exit``/``_exit`` argument, a comparison against an
+    rc-named value, an ``*_EXIT_CODE`` assignment) because a bare 13 is
+    usually just a number. Autofixable: ``--fix`` swaps the literal for
+    the named constant and imports it from ``deepspeed_tpu.exit_codes``.
+    """
+
+    code = "TPU021"
+    name = "exit-code-literal"
+    severity = Severity.WARNING
+    summary = "hardcoded exit-code literal outside the contract module"
+
+    BY_VALUE = {v: n for n, v in (
+        ("PREEMPTION_EXIT_CODE", _ec.PREEMPTION_EXIT_CODE),
+        ("STALL_EXIT_CODE", _ec.STALL_EXIT_CODE),
+        ("INTEGRITY_EXIT_CODE", _ec.INTEGRITY_EXIT_CODE),
+        ("KILL_EXIT_CODE", _ec.KILL_EXIT_CODE))}
+    _RC_NAME = re.compile(r"^(rc|returncode|exit_?code|code)$", re.I)
+    _EXIT_FNS = {"exit", "_exit", "exit_fn"}
+
+    def _exit_context(self, module: ModuleInfo, node: ast.AST) -> bool:
+        parent = module.parent(node)
+        if isinstance(parent, ast.Call):
+            f = parent.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if name in self._EXIT_FNS:
+                return True
+        if isinstance(parent, ast.Compare):
+            for other in [parent.left] + list(parent.comparators):
+                if other is node:
+                    continue
+                name = other.attr if isinstance(other, ast.Attribute) \
+                    else (other.id if isinstance(other, ast.Name) else "")
+                if self._RC_NAME.match(name or ""):
+                    return True
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                if isinstance(t, ast.Name) and t.id.endswith("_EXIT_CODE"):
+                    return True
+        return False
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if module.rel_path.endswith("exit_codes.py"):
+            return
+        for node in module.all_nodes:
+            if not isinstance(node, ast.Constant) \
+                    or type(node.value) is not int \
+                    or node.value not in self.BY_VALUE:
+                continue
+            parent = module.parent(node)
+            if isinstance(parent, ast.UnaryOp):
+                continue            # -13 is a signal rc, not the contract
+            if node.value == 13 and not self._exit_context(module, node):
+                continue
+            name = self.BY_VALUE[node.value]
+            yield self.finding(
+                module, node,
+                f"hardcoded exit-code literal {node.value}: the rc "
+                f"contract is single-sourced — use "
+                f"deepspeed_tpu.exit_codes.{name} (autofixable with "
+                f"--fix)")

@@ -54,8 +54,9 @@ MEMBERSHIP_CHANGED = object()       # monitor sentinel; never equals an rc
 
 #: Exit code meaning "I was preempted but checkpointed; relaunch me and
 #: don't count this against max_restarts". Chosen outside the shell's
-#: conventional 126-165 signal range and Python's 0-2.
-PREEMPTION_EXIT_CODE = 114
+#: conventional 126-165 signal range and Python's 0-2. Re-exported from
+#: the single-source contract module so the literal lives in one place.
+from ..exit_codes import PREEMPTION_EXIT_CODE  # noqa: E402
 
 
 class DSElasticAgent:

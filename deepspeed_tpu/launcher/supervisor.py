@@ -266,8 +266,9 @@ class RunSupervisor:
                 for r in silent)
             # snapshot NOW: after the teardown freezes every rank's
             # record, re-evaluating would implicate the whole world
-            self._hb_silent = silent
-            self._hb_stall = desc
+            with self._lock:
+                self._hb_silent = silent
+                self._hb_stall = desc
             logger.error("supervisor: heartbeat silence — %s (timeout "
                          "%.1fs); tearing down the world", desc,
                          self.heartbeat_monitor.timeout)
@@ -436,8 +437,10 @@ class RunSupervisor:
             reader = getattr(proc, "_dstpu_reader", None)
             if reader is not None:
                 reader.join(timeout=5)
-            connect_failed = (spec.remote and not st.started
-                              and not st.signaled and rc == SSH_CONNECT_RC)
+            with self._lock:
+                connect_failed = (spec.remote and not st.started
+                                  and not st.signaled
+                                  and rc == SSH_CONNECT_RC)
             if connect_failed and self._retry_connect(
                     spec, st, attempt,
                     f"ssh exited {SSH_CONNECT_RC} before the remote shell "
@@ -450,7 +453,8 @@ class RunSupervisor:
                           and rc == SSH_CONNECT_RC):
             # the teardown aborted this rank's connect attempts — its 255
             # is an artifact of the abort, not the failure that triggered it
-            st.signaled = True
+            with self._lock:
+                st.signaled = True
         st.rc = SSH_CONNECT_RC if rc is None else rc
         st.finished_at = time.monotonic()
         self._on_rank_exit(idx)
@@ -477,7 +481,9 @@ class RunSupervisor:
     def _on_rank_exit(self, idx: int) -> None:
         st = self.status[idx]
         spec = self.specs[idx]
-        if st.rc != 0 and not st.signaled:
+        with self._lock:
+            signaled = st.signaled
+        if st.rc != 0 and not signaled:
             kind = {PREEMPTION_EXIT_CODE: "preempted"}.get(st.rc, "failed")
             logger.error("supervisor: rank %d (%s) %s with rc=%d — tearing "
                          "down the world", idx, spec.host, kind, st.rc)
@@ -547,13 +553,15 @@ class RunSupervisor:
         teardown signaled them): genuine crash > preemption > clean. The
         torn-down remnants' codes (-15/-9, or 114 from their own handlers)
         must not mask what actually happened first."""
-        voluntary = [st for st in self.status if not st.signaled]
+        with self._lock:
+            voluntary = [st for st in self.status if not st.signaled]
+            hb_stall = self._hb_stall
         crashes = [st for st in voluntary
                    if st.rc not in (0, PREEMPTION_EXIT_CODE)]
         if crashes:
             first = min(crashes, key=lambda s: s.finished_at or 0.0)
             return first.rc
-        if self._hb_stall is not None:
+        if hb_stall is not None:
             # the teardown was triggered by heartbeat silence, not an
             # exit: every rank is a torn-down remnant, and the honest rc
             # is "wedged" — counted by the elastic agent, like any stall

@@ -60,7 +60,10 @@ def _load(name: str, sources) -> Optional[ctypes.CDLL]:
     with _lock:
         if name in _libs:
             return _libs[name]
-        so = _compile(name, sources)
+        # compile-once cache: the lock MUST span the compile, or two
+        # threads race to build the same .so; waiters blocked on a slow
+        # compile are the intended serialization, not a wedge
+        so = _compile(name, sources)  # graftlint: disable=TPU017
         lib = ctypes.CDLL(so) if so else None
         _libs[name] = lib
         return lib

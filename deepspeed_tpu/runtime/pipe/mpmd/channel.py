@@ -187,18 +187,29 @@ class SocketChannel:
         self.generation = int(welcome.get("gen", 0))
 
     def send(self, kind: str, src: int, dst: int, micro: int,
-             payload) -> None:
+             payload, lock_timeout: float = 30.0) -> None:
         chaos.failpoint("pipe.xfer", key=f"{kind}:{src}->{dst}")
         arr = np.asarray(payload)
-        with self._lock:
-            write_frame(self._sock,
-                        {"kind": kind, "src": src, "dst": dst,
-                         "micro": int(micro), "gen": self.generation},
-                        _to_bytes(arr))
+        self._write({"kind": kind, "src": src, "dst": dst,
+                     "micro": int(micro), "gen": self.generation},
+                    _to_bytes(arr), lock_timeout)
 
-    def send_control(self, meta: dict) -> None:
-        with self._lock:
-            write_frame(self._sock, meta)
+    def send_control(self, meta: dict, lock_timeout: float = 30.0) -> None:
+        self._write(meta, b"", lock_timeout)
+
+    def _write(self, meta: dict, payload: bytes,
+               lock_timeout: float) -> None:
+        # bounded: a driver wedged mid-read keeps sendall — and with it
+        # the frame lock — stuck; a writer starved this long is facing a
+        # dead driver, and OSError is what a dead socket raises anyway
+        if not self._lock.acquire(timeout=lock_timeout):
+            raise OSError(
+                f"channel write lock starved for {lock_timeout}s "
+                "(driver wedged mid-frame?)")
+        try:
+            write_frame(self._sock, meta, payload)
+        finally:
+            self._lock.release()
 
     def _pump_one(self, timeout: Optional[float]) -> None:
         self._sock.settimeout(timeout)

@@ -42,11 +42,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from ....exit_codes import INTEGRITY_EXIT_CODE, PREEMPTION_EXIT_CODE
 from ...watchdog import STALL_EXIT_CODE
 from .channel import read_frame, write_frame
-
-PREEMPTION_EXIT_CODE = 114
-INTEGRITY_EXIT_CODE = 118
 
 
 class StageWorkerSpec:
@@ -71,9 +69,20 @@ class _StageConn:
         self.resume_step = resume_step
         self.wlock = threading.Lock()
 
-    def send(self, meta: dict, payload: bytes = b"") -> None:
-        with self.wlock:
+    def send(self, meta: dict, payload: bytes = b"",
+             lock_timeout: float = 5.0) -> None:
+        # bounded: a peer wedged mid-read keeps sendall — and with it
+        # this lock — stuck, and every later sender (welcome, broadcast)
+        # would pile up behind it. A starved writer is treated like a
+        # dead peer: OSError, which every caller already handles
+        if not self.wlock.acquire(timeout=lock_timeout):
+            raise OSError(
+                f"stage connection write lock starved for {lock_timeout}s "
+                "(peer wedged mid-frame?)")
+        try:
             write_frame(self.sock, meta, payload)
+        finally:
+            self.wlock.release()
 
 
 class MPMDStageSupervisor:

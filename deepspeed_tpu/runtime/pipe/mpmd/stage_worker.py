@@ -58,7 +58,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-PREEMPTION_EXIT_CODE = 114
+from ....exit_codes import PREEMPTION_EXIT_CODE
 
 
 def toy_spec(args) -> Dict[str, Any]:

@@ -71,10 +71,11 @@ from typing import Dict, Iterable, List, Optional, Tuple
 from ..utils.logging import logger
 
 #: rc for an integrity abort (ladder rung 3, or a detected SDC) — distinct
-#: from clean 0, preemption 114, and stall 117: the run is *wrong*, not
-#: dead or slow, and must not silently relaunch into the same divergence
-#: without the operator being able to tell.
-INTEGRITY_EXIT_CODE = 118
+#: from clean 0, preemption, and stall: the run is *wrong*, not dead or
+#: slow, and must not silently relaunch into the same divergence without
+#: the operator being able to tell. Re-exported from the single-source
+#: contract module.
+from ..exit_codes import INTEGRITY_EXIT_CODE  # noqa: E402
 
 #: heartbeat flag stamped by a rank whose device(s) lost the checksum
 #: majority vote — the elastic agent and supervisors read it as blacklist

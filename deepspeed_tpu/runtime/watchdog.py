@@ -62,8 +62,9 @@ from .heartbeat import (PHASE_COMPILE, PHASE_INIT, PHASE_RESTORE, PHASE_SAVE,
 
 #: Exit code meaning "this worker made no progress within its current
 #: phase's deadline". Distinct from Python's 0-2, shell signal codes
-#: (>=128), chaos.KILL_EXIT_CODE (13) and PREEMPTION_EXIT_CODE (114).
-STALL_EXIT_CODE = 117
+#: (>=128), chaos.KILL_EXIT_CODE and PREEMPTION_EXIT_CODE. Re-exported
+#: from the single-source contract module.
+from ..exit_codes import STALL_EXIT_CODE  # noqa: E402
 
 
 def _dump_stacks(stream, reason: str) -> None:
@@ -107,10 +108,17 @@ def _fire(stream, reason: str, exit_fn: Callable[[int], None],
     another deadline in this process is already mid-exit — the fix for
     an init deadline and an armed phase watchdog double-firing."""
     global _fire_in_progress
-    with _fire_lock:
+    # bounded: the guard only brackets flag flips, so a starved acquire
+    # means another deadline is mid-exit (or the interpreter is dying) —
+    # either way this fire yields rather than wedging the exit path
+    if not _fire_lock.acquire(timeout=_STAMP_LOCK_TIMEOUT):
+        return False
+    try:
         if _fire_in_progress:
             return False
         _fire_in_progress = True
+    finally:
+        _fire_lock.release()
     try:
         _dump_stacks(stream, reason)
         if heartbeat is not None:
@@ -128,8 +136,11 @@ def _fire(stream, reason: str, exit_fn: Callable[[int], None],
         exit_fn(STALL_EXIT_CODE)
         return True
     finally:
-        with _fire_lock:
+        # same bound on the reset: a test exit_fn that returns must not
+        # leave the NEXT fire waiting forever if the guard is starved
+        if _fire_lock.acquire(timeout=_STAMP_LOCK_TIMEOUT):
             _fire_in_progress = False
+            _fire_lock.release()
 
 
 class StallWatchdog:

@@ -311,5 +311,8 @@ class SharedPagedState:
         """Execute ``fn(params, pools, *args) -> (out, new_pools)`` with
         the live pool buffers, serialized against the other role."""
         with self.device_lock:
-            out, self.pools = fn(params, self.pools, *args)
+            # the lock MUST span fn: it donates self.pools, and the other
+            # role dispatching against donated-invalidated buffers is the
+            # exact aliasing bug this class exists to prevent
+            out, self.pools = fn(params, self.pools, *args)  # graftlint: disable=TPU017
             return out

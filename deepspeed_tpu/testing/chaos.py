@@ -111,13 +111,52 @@ from typing import Dict, List, Optional
 
 #: exit code used by ``kill`` mode — distinct from Python's 1 and from
 #: PREEMPTION_EXIT_CODE so tests can tell "chaos killed it" apart from
-#: ordinary failures.
-KILL_EXIT_CODE = 13
+#: ordinary failures. Re-exported from the single-source contract module.
+from ..exit_codes import KILL_EXIT_CODE  # noqa: E402
 
 _lock = threading.Lock()
 _armed: Dict[str, "_FailPoint"] = {}
 _env_loaded = False
 _history: List[str] = []        # fired failpoint names, in order
+
+#: Catalog of every failpoint/flag name instrumented in the package,
+#: name -> where it fires. graftlint rule TPU020 checks that every
+#: ``failpoint("...")`` / ``chaos.flag("...")`` call site in source uses a
+#: name listed here AND documented in docs/RESILIENCE.md's failpoint
+#: table, so the catalog, the docs, and the instrumentation can never
+#: drift apart (the failpoint analogue of
+#: ``test_facade_catalog_covers_comm_module``). Arming an uncataloged
+#: name from a test still works — the catalog constrains *source*
+#: instrumentation sites, not test scripts.
+FAILPOINTS: Dict[str, str] = {
+    "pipe.stage_kill": "MPMD stage worker, top of the stage step loop",
+    "pipe.xfer": "MPMD channel, inter-stage frame read/write",
+    "ckpt.write": "checkpoint shard write",
+    "ckpt.digest": "checkpoint shard digest computation",
+    "ckpt.marker": "checkpoint commit-marker write",
+    "ckpt.rename": "checkpoint atomic rename into place",
+    "ckpt.latest": "LATEST pointer update",
+    "ckpt.meta": "checkpoint metadata write",
+    "run.kill": "training step loop, hard kill",
+    "run.preempt": "training step loop, simulated preemption",
+    "run.hang": "training step loop, infinite hang",
+    "run.slow": "training step loop, injected per-step delay",
+    "run.compile_hang": "first-step compilation, infinite hang",
+    "sentinel.spike": "flag: sentinel sees a fake loss spike",
+    "sentinel.sdc": "flag: sentinel sees a fake checksum mismatch",
+    "hb.write": "heartbeat file write",
+    "host.blackhole": "launcher, host stops responding",
+    "launch.ssh": "launcher, ssh/session establishment",
+    "serve.chunk": "serving engine, per-chunk prefill",
+    "serve.handoff": "disagg prefill->decode block handoff push",
+    "serve.handoff_drop": "disagg handoff entry expiry/drop",
+    "serve.enqueue": "serving scheduler/fleet request enqueue",
+    "serve.replica_hang": "fleet replica worker, infinite hang",
+    "serve.replica_kill": "fleet replica worker, hard kill",
+    "serve.replica_slow": "fleet replica worker, injected delay",
+    "serve.requeue": "fleet, in-flight requeue after replica death",
+    "serve.oom": "KV block pool exhaustion",
+}
 
 
 class ChaosError(IOError):
@@ -211,7 +250,9 @@ def parse_spec(spec: str) -> Dict[str, _FailPoint]:
 
 def _load_env_once() -> None:
     global _env_loaded
-    with _lock:
+    # registry lock: brackets dict ops only, never blocking work — a
+    # signal handler passing through a failpoint cannot wedge on it
+    with _lock:  # graftlint: disable=TPU019
         if _env_loaded:
             return
         _env_loaded = True
@@ -290,7 +331,9 @@ def failpoint(name: str, key: Optional[str] = None) -> None:
         _load_env_once()
     if not _armed:
         return
-    with _lock:
+    # registry lock: dict lookups and counter bumps only (the injected
+    # hang/sleep happens AFTER release) — safe under a signal handler
+    with _lock:  # graftlint: disable=TPU019
         fp = _armed.get(name)
         if fp is None:
             return

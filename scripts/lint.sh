@@ -6,7 +6,8 @@
 #   scripts/lint.sh path/to/file.py   # lint a subset
 #   scripts/lint.sh --changed         # fast mode: only .py files changed vs main
 #   scripts/lint.sh --sarif out.sarif # additionally write SARIF 2.1.0 (CI PR annotation)
-#   scripts/lint.sh --fix             # apply autofixes (TPU008/TPU010), then lint
+#   scripts/lint.sh --fix             # apply autofixes, then lint
+#   scripts/lint.sh --timing          # per-rule wall time on stderr
 #
 # The checked-in baseline (.graftlint.json) is applied automatically; a
 # finding not in the baseline and not suppressed inline fails the run.
@@ -24,6 +25,7 @@ while [[ $# -gt 0 ]]; do
     --changed) CHANGED=1; shift ;;
     --sarif) EXTRA+=("--sarif" "$2"); shift 2 ;;
     --fix) EXTRA+=("--fix"); shift ;;
+    --timing) EXTRA+=("--timing"); shift ;;
     *) ARGS+=("$1"); shift ;;
   esac
 done

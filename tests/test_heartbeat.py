@@ -413,3 +413,24 @@ def test_init_deadline_rides_the_watchdog_machinery():
         time.sleep(0.4)
     assert rcs == [STALL_EXIT_CODE]
     assert "rendezvous-probe" in buf.getvalue()
+
+
+def test_fire_guard_starvation_yields_instead_of_wedging(monkeypatch):
+    """Regression (TPU019 sweep): the rc-117 once-guard is now bounded —
+    if it cannot be taken, this fire yields (another deadline is
+    mid-exit, or the interpreter is dying) rather than wedging the one
+    path whose job is converting hangs into exits."""
+    monkeypatch.setattr(wdg, "_STAMP_LOCK_TIMEOUT", 0.05)
+    rcs = []
+    wdg._fire_lock.acquire()             # the guard's holder is wedged
+    try:
+        t0 = time.monotonic()
+        assert wdg._fire(io.StringIO(), "starved guard", rcs.append) \
+            is False
+        assert time.monotonic() - t0 < 2
+    finally:
+        wdg._fire_lock.release()
+    assert rcs == []                     # yielded without side effects
+    # guard released: the next deadline fires normally
+    assert wdg._fire(io.StringIO(), "after release", rcs.append)
+    assert rcs == [STALL_EXIT_CODE]

@@ -597,3 +597,57 @@ def test_restart_budget_exhausted_propagates_rc(tmp_path):
     rc, _losses, sup = _run_driver(str(tmp_path), steps=6, specs=specs,
                                    max_restarts=0)
     assert rc == chaos.KILL_EXIT_CODE
+
+
+def test_stageconn_send_raises_when_write_lock_starved():
+    """Regression (TPU017 sweep): a peer wedged mid-read used to keep
+    the per-connection write lock — and every later sender (welcome,
+    broadcast) — stuck forever. A starved writer now fails like a dead
+    peer, which every caller already handles."""
+    import socket
+    import time
+    from deepspeed_tpu.runtime.pipe.mpmd.driver import _StageConn
+
+    a, b = socket.socketpair()
+    try:
+        conn = _StageConn(a, 0)
+        conn.wlock.acquire()            # the wedged sender
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(OSError, match="starved"):
+                conn.send({"cmd": "ping"}, lock_timeout=0.05)
+            assert time.monotonic() - t0 < 2
+        finally:
+            conn.wlock.release()
+        conn.send({"cmd": "ping"}, lock_timeout=0.05)   # lock free: sends
+    finally:
+        a.close()
+        b.close()
+
+
+def test_socket_channel_send_raises_when_write_lock_starved():
+    """Same contract on the worker side: the frame lock is bounded, and
+    starvation surfaces as the OSError a dead driver socket raises."""
+    import socket
+    import threading as _th
+    from deepspeed_tpu.runtime.pipe.mpmd.channel import SocketChannel
+
+    a, b = socket.socketpair()
+    try:
+        ch = SocketChannel.__new__(SocketChannel)
+        ch._sock = a
+        ch._lock = _th.Lock()
+        ch.generation = 0
+        ch._lock.acquire()
+        try:
+            with pytest.raises(OSError, match="starved"):
+                ch.send_control({"cmd": "parked"}, lock_timeout=0.05)
+            with pytest.raises(OSError, match="starved"):
+                ch.send("act", 0, 1, 0, np.zeros(2, np.float32),
+                        lock_timeout=0.05)
+        finally:
+            ch._lock.release()
+        ch.send_control({"cmd": "parked"}, lock_timeout=0.05)
+    finally:
+        a.close()
+        b.close()
