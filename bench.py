@@ -22,6 +22,7 @@ micro-1 shape was the real ceiling there: 84.5 -> ~93 TF).
 """
 
 import json
+import os
 
 BASELINE_TFLOPS_PER_CHIP = 50.0
 
@@ -36,6 +37,73 @@ def _emit(r, metric):
     }), flush=True)
 
 
+def paged_decode_microbench():
+    """int8-vs-baseline paged-decode attention step (round 17): same block
+    table, same query, pool stored int8 + per-row scales vs the model
+    dtype. On TPU this times the in-kernel dequant tier (int8 crosses
+    HBM); on CPU the jnp reference's post-gather dequant. Emits one JSON
+    line; under ``DSTPU_SERVE_BENCH_GATE=1`` an int8 step slower than 2x
+    the baseline is fatal (the SERVEBENCH gate convention)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.ops.attention import paged_attention
+    from deepspeed_tpu.quant_format import kv_quantize
+
+    on_tpu = jax.default_backend() == "tpu"
+    base_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    B, nh, hd, bs = (8, 16, 64, 32) if on_tpu else (4, 8, 64, 32)
+    num_blocks, nbk = (1024, 32) if on_tpu else (128, 8)
+    rng = np.random.default_rng(0)
+    kp = rng.standard_normal((nh, num_blocks, bs, hd)).astype(np.float32)
+    vp = rng.standard_normal((nh, num_blocks, bs, hd)).astype(np.float32)
+    perm = rng.permutation(num_blocks - 1)[:B * nbk] + 1
+    bt = jnp.asarray(perm.reshape(B, nbk).astype(np.int32))
+    lens = jnp.full((B,), nbk * bs, jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, nh, 1, hd)), base_dtype)
+    kb, vb = jnp.asarray(kp, base_dtype), jnp.asarray(vp, base_dtype)
+    (kq, ks), (vq, vs) = kv_quantize(jnp.asarray(kp)), kv_quantize(
+        jnp.asarray(vp))
+
+    f_base = jax.jit(lambda q, k, v: paged_attention(q, k, v, bt, lens))
+    f_int8 = jax.jit(lambda q, k, ks, v, vs: paged_attention(
+        q, k, v, bt, lens, k_scale=ks, v_scale=vs))
+
+    def timed(fn, *a, iters=30):
+        np.asarray(fn(*a).reshape(-1)[0])           # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*a)
+        np.asarray(out.reshape(-1)[0])
+        return (time.perf_counter() - t0) / iters
+
+    t_base = timed(f_base, q, kb, vb)
+    t_int8 = timed(f_int8, q, kq, ks, vq, vs)
+    speedup = t_base / max(t_int8, 1e-9)
+    print(json.dumps({
+        "metric": "paged_decode_int8_vs_baseline_speedup",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "detail": {"baseline_dtype": str(jnp.dtype(base_dtype)),
+                   "baseline_ms": round(t_base * 1e3, 3),
+                   "int8_ms": round(t_int8 * 1e3, 3),
+                   "batch": B, "heads": nh, "head_dim": hd,
+                   "block_size": bs, "blocks_per_seq": nbk,
+                   "pool_blocks": num_blocks,
+                   "backend": jax.default_backend()},
+    }), flush=True)
+    if t_int8 > 2.0 * t_base:
+        msg = (f"PAGED-DECODE REGRESSION: int8 step {t_int8 * 1e3:.3f}ms > "
+               f"2x baseline {t_base * 1e3:.3f}ms")
+        if os.environ.get("DSTPU_SERVE_BENCH_GATE") == "1":
+            raise SystemExit(msg)
+        print(msg, flush=True)
+    return speedup
+
+
 def main():
     import jax
     from deepspeed_tpu.benchmarks.training_bench import run_training_bench
@@ -44,6 +112,11 @@ def main():
     if on_tpu:
         import gc
 
+        # tiny HBM footprint: the decode microbench runs before the
+        # training legs claim the chip
+        paged_decode_microbench()
+        gc.collect()
+        jax.clear_caches()
         # the 1.3b legs need nearly the whole chip: run them FIRST (clean
         # HBM), free everything, then run the 350m leg; emit the north-star
         # 1.3b seq-1024 line LAST so the driver records it.
@@ -115,6 +188,7 @@ def main():
         _emit(r20, "gpt2_1p3b_seq2048_zero3_train_tflops_per_chip")
         _emit(r13, "gpt2_1p3b_zero3_train_tflops_per_chip")
     else:  # smoke path for CPU-only environments
+        paged_decode_microbench()
         r = run_training_bench("gpt2-tiny", seq=128, micro=8, gas=1, steps=3,
                                zero_stage=1, fused_loss=True, verbose=False)
         _emit(r, "gpt2_train_tflops_per_chip")

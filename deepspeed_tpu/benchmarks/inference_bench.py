@@ -23,6 +23,12 @@ recorded tokens/s prints a LOUD regression, and
 ``DSTPU_SERVE_BENCH_GATE=1`` makes it fatal. ``--chunk N`` arms chunked
 prefill for the serving rows (mode column records it).
 
+Round 17 adds the quantized-compute legs: ``--kv-dtype int8`` serves
+from the int8 KV pool (in-kernel dequant) and ``--weight-dtype int8``
+from blockwise weight-only int8 matmuls; the rows carry ``kv_dtype`` /
+``weight_dtype`` columns and the regression key includes them, so the
+bf16 and int8 tiers baseline independently.
+
     python -m deepspeed_tpu.benchmarks.inference_bench \
         [--preset gpt2-125m] [--batches 1,8] [--seqs 128,1024] [--new 64]
     python -m deepspeed_tpu.benchmarks.inference_bench --poisson \
@@ -219,6 +225,8 @@ def run_poisson(preset: str, rate: float, num_requests: int,
         "preset": preset, "rate": float(rate), "requests": num_requests,
         "prompt": prompt_len, "new_tokens": new_tokens,
         "chunk": int((serving or {}).get("prefill_chunk_tokens", 0)),
+        "kv_dtype": (serving or {}).get("kv_cache_dtype"),
+        "weight_dtype": (serving or {}).get("weight_dtype"),
         "wall_s": round(wall, 3),
         "p50_s": round(float(np.percentile(lat, 50)), 4),
         "p99_s": round(float(np.percentile(lat, 99)), 4),
@@ -391,6 +399,8 @@ def run_poisson_fleet(preset: str, rate: float, num_requests: int,
             int(fleet_cfg["replicas"]), "requests": num_requests,
         "prompt": prompt_len, "new_tokens": new_tokens,
         "chunk": int(scfg.get("prefill_chunk_tokens", 0)),
+        "kv_dtype": scfg.get("kv_cache_dtype"),
+        "weight_dtype": scfg.get("weight_dtype"),
         "wall_s": round(wall, 3),
         "p50_s": round(float(np.percentile(lat, 50)), 4),
         "p99_s": round(float(np.percentile(lat, 99)), 4),
@@ -448,12 +458,15 @@ def check_serve_regression(current: List[Dict], baseline: List[Dict],
                            ) -> List[str]:
     """Rows whose p50 latency exceeds ``factor`` x the recorded one, or
     whose tokens/s fell below recorded / ``factor`` — keyed by
-    (mode, preset, rate, prompt, new_tokens, replicas, chunk). Missing
-    rows are NOT flagged (a narrower re-run is legitimate)."""
+    (mode, preset, rate, prompt, new_tokens, replicas, chunk, kv_dtype,
+    weight_dtype) so the round-17 quantized legs never gate the bf16 row
+    (or vice versa). Missing rows are NOT flagged (a narrower re-run is
+    legitimate)."""
     def key(r):
         return (r.get("mode", "poisson"), r.get("preset"),
                 r.get("rate"), r.get("prompt"), r.get("new_tokens"),
-                r.get("replicas"), r.get("chunk", 0))
+                r.get("replicas"), r.get("chunk", 0),
+                r.get("kv_dtype"), r.get("weight_dtype"))
 
     base = {key(r): r for r in baseline}
     problems = []
@@ -538,6 +551,15 @@ def main(argv=None):
     p.add_argument("--chunk", type=int, default=0,
                    help="serving.prefill_chunk_tokens for the poisson "
                         "legs (0 = whole prefill)")
+    p.add_argument("--kv-dtype", choices=("int8", "bf16", "f32"),
+                   default=None,
+                   help="serving.kv_cache_dtype for the poisson legs "
+                        "(int8 = quantized pool, in-kernel dequant; "
+                        "default: model dtype)")
+    p.add_argument("--weight-dtype", choices=("int8",), default=None,
+                   help="serving.weight_dtype for the poisson legs "
+                        "(int8 = blockwise weight-only quant, packed "
+                        "once at engine build)")
     p.add_argument("--record", default="",
                    help="write the poisson rows to this JSON path "
                         "(commit as SERVEBENCH_rNN.json)")
@@ -554,8 +576,14 @@ def main(argv=None):
         run_ragged(args.preset, args.ragged_batch, args.ragged_seq, args.new)
         return
     if args.poisson:
-        serving = ({"prefill_chunk_tokens": args.chunk}
-                   if args.chunk > 0 else None)
+        serving = {}
+        if args.chunk > 0:
+            serving["prefill_chunk_tokens"] = args.chunk
+        if args.kv_dtype:
+            serving["kv_cache_dtype"] = args.kv_dtype
+        if args.weight_dtype:
+            serving["weight_dtype"] = args.weight_dtype
+        serving = serving or None
         rows = []
         for rate in (float(x) for x in args.rates.split(",")):
             if args.fleet > 1:

@@ -46,6 +46,7 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
                        pure_bf16: bool = False,
                        grad_accum_dtype=None,
                        masked=None,
+                       low_precision=None,
                        verbose: bool = True,
                        **model_kw):
     """Measure sustained train-step model TFLOPs/chip for a preset.
@@ -78,6 +79,10 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
     kw = dict(max_seq_len=max(seq, 512), remat=remat,
               remat_policy=remat_policy, fused_loss=fused_loss,
               loss_chunk=256)
+    if low_precision:
+        # round-17 experiment: int8/fp8 fake-quant on every block matmul
+        # input (quant_format.fake_quant_act, STE) — sentinel-gated below
+        kw["activation_quant"] = low_precision
     kw.update(model_kw)
     model, cfg = build_model(preset, **kw)
     batch_size = micro * gas * max(n_chips, 1)
@@ -96,6 +101,10 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
     }
     if grad_accum_dtype:
         config["data_types"] = {"grad_accum_dtype": grad_accum_dtype}
+    if low_precision:
+        # the experiment ships with its guardrail: the integrity sentinel's
+        # skip/rollback ladder (the engine refuses the flag without it)
+        config["integrity"] = {"enabled": True}
     rng = np.random.default_rng(0)
     if masked is None:
         masked = not causal
@@ -165,6 +174,8 @@ def run_training_bench(preset: str = "bert-large", seq: int = 128,
                    "zero_stage": zero_stage, "remat": remat,
                    "remat_policy": remat_policy if remat else None,
                    "pure_bf16": pure_bf16,
+                   **({"low_precision": low_precision} if low_precision
+                      else {}),
                    "grad_accum_dtype": grad_accum_dtype or "fp32",
                    "step_time_s": round(dt, 4),
                    "step_time_spread": round(spread, 4),
@@ -206,10 +217,14 @@ def main(argv=None):
                     help="ragged attention_mask batches (default for BERT)")
     mk.add_argument("--no-masked", dest="masked", action="store_false",
                     help="maskless batches (the pre-round-6 upper bound)")
+    p.add_argument("--low-precision", choices=("int8", "fp8"), default=None,
+                   help="round-17 experiment: fake-quant block matmul "
+                        "inputs (sentinel-gated; e.g. --preset gpt2-350m)")
     a = p.parse_args(argv)
     run_training_bench(a.preset, a.seq, a.micro, a.gas, a.steps, a.zero,
                        a.remat, remat_policy=a.remat_policy,
-                       fused_loss=a.fused_loss, masked=a.masked)
+                       fused_loss=a.fused_loss, masked=a.masked,
+                       low_precision=a.low_precision)
 
 
 if __name__ == "__main__":

@@ -505,7 +505,14 @@ class ServingConfig(DeepSpeedConfigModel):
     prefix_cache: bool = True          # reuse shared full-block prefixes
     max_queue: int = 4096              # admission queue bound (backpressure)
     kv_cache_dtype: Optional[str] = None   # None = model dtype; "int8" =
-    #                                    quantized pool (round 12)
+    #                                    quantized pool (round 12; round 17
+    #                                    dequantizes IN the Pallas kernel)
+    # weight-only blockwise int8 (round 17): "int8" packs the dense decode
+    # kernels ONCE at engine construction into int8 + one f32 scale per
+    # 256 contraction elements (quant_format's wire format) and routes
+    # the decode matmuls through ops/pallas/quant_matmul — half the
+    # weight HBM per token. None = serve the model dtype.
+    weight_dtype: Optional[str] = None
     seed: int = 0                      # sampling PRNG seed
     # chunked prefill (round 12): > 0 advances a prompt's prefill at most
     # this many tokens per loop iteration, interleaved with decode steps

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..quant_format import kv_quantize as _kv_quantize  # noqa: F401 (shared
+#   format, round 17 — re-exported: serving/model_runner imports it here)
 from .transformer import TransformerConfig
 
 PyTree = Any
@@ -41,14 +43,31 @@ def _layer_norm(x, p, eps, rms: bool = False):
 
 
 def _kernel_of(p, dtype):
-    """Matmul weight, dequantizing the int8 weight-only form in place.
+    """Matmul weight, dequantizing the int8 weight-only forms in place.
 
-    int8 kernels carry a per-output-channel symmetric scale
-    (``kernel_scale``); the convert+multiply fuses into the consuming dot,
-    so the HBM read is half the bf16 bytes — the role of the reference's
-    int8 inference kernels (csrc/transformer/inference, pt_binding
-    ds_*_int8 entry points)."""
+    int8 kernels carry either a per-output-channel symmetric scale
+    (``kernel_scale``, the inference engine's format) or per-256-element
+    blockwise scales along the contraction dim (``kernel_qscale``, the
+    round-17 serving pack — quant_format's wire format on a weight); the
+    convert+multiply fuses into the consuming dot, so the HBM read is
+    half the bf16 bytes — the role of the reference's int8 inference
+    kernels (csrc/transformer/inference, pt_binding ds_*_int8 entry
+    points). The serving decode hot path does NOT come through here for
+    blockwise kernels: ``_dense`` routes those to the Pallas
+    ``quant_matmul`` kernel, which dequantizes per block IN-kernel —
+    this full materialization is the einsum/oracle fallback only."""
     k = p["kernel"]
+    if "kernel_qscale" in p:
+        # blockwise along the contraction dim: q [..., Kp, N] int8,
+        # scales [..., Kp/block, N] f32 -> w[i, n] = q[i, n] * s[i//block, n]
+        # (Kp is the padded contraction — padded rows dequantize to 0)
+        s = p["kernel_qscale"]
+        nkb = s.shape[-2]
+        qb = k.shape[-2] // nkb
+        w = (k.astype(jnp.float32).reshape(
+                k.shape[:-2] + (nkb, qb, k.shape[-1]))
+             * s[..., :, None, :])
+        return w.reshape(k.shape).astype(dtype)
     if "kernel_scale" in p:
         # dequantize in f32: the scale is deliberately stored f32 by the
         # inference engine, and an int8->f32 multiply keeps the scale/2
@@ -58,8 +77,16 @@ def _kernel_of(p, dtype):
     return k.astype(dtype)
 
 
-def _dense(x, p):
-    y = x @ _kernel_of(p, x.dtype)
+def _dense(x, p, interpret: bool = False):
+    if "kernel_qscale" in p:
+        # round 17: blockwise-int8 packed kernel (serving.weight_dtype
+        # "int8") — int8 stays int8 until the Pallas kernel's VMEM
+        # dequant; no full-weight f32/bf16 copy materializes here
+        from ..ops.pallas.quant_matmul import quant_matmul
+        y = quant_matmul(x, p["kernel"], p["kernel_qscale"],
+                         interpret=interpret)
+    else:
+        y = x @ _kernel_of(p, x.dtype)
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
     return y
@@ -104,16 +131,6 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
     if pad_lens is not None:
         cache["pad"] = jnp.asarray(pad_lens, jnp.int32)
     return cache
-
-
-def _kv_quantize(t: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """[B, nh, T, hd] -> (int8 values, f32 per-position scales [B,nh,T,1])."""
-    scale = jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1,
-                    keepdims=True) / 127.0
-    scale = jnp.where(scale == 0.0, 1.0, scale)
-    q = jnp.clip(jnp.round(t.astype(jnp.float32) / scale),
-                 -127, 127).astype(jnp.int8)
-    return q, scale
 
 
 def ensure_scan_layout(params: PyTree, num_layers: int) -> PyTree:

@@ -162,6 +162,13 @@ class TransformerConfig:
     # stays usable as a jit static argument. Keys mirror
     # config.SparseAttentionConfig ("mode", "block", "num_local_blocks", ...).
     sparse_attention: Optional[Tuple[Tuple[str, Any], ...]] = None
+    # round-17 low-precision training EXPERIMENT (not a default): "int8"
+    # or "fp8" fake-quantizes every block matmul input (straight-through
+    # gradients, quant_format.fake_quant_act) — emulated low-precision
+    # compute numerics at full-precision speed. The engine wires it from
+    # compression_training.activation_quantization and REQUIRES the
+    # integrity sentinel's skip/rollback ladder to be armed.
+    activation_quant: Optional[str] = None
 
     def __post_init__(self):
         # gated_mlp + moe_experts is the Mixtral family: SwiGLU experts
@@ -173,6 +180,10 @@ class TransformerConfig:
             raise NotImplementedError(
                 "post_block_norms (Gemma-2 sandwich) + parallel_residual "
                 "is not implemented")
+        if self.activation_quant not in (None, "int8", "fp8"):
+            raise ValueError(
+                f"activation_quant {self.activation_quant!r}: expected "
+                "'int8', 'fp8' or None")
 
     @property
     def head_dim(self) -> int:
@@ -644,10 +655,21 @@ class Block(nn.Module):
         _KSPEC = {"attn_qkv": (None, "model"), "attn_proj": ("model", None),
                   "mlp_fc": (None, "model"), "mlp_gate": (None, "model"),
                   "mlp_proj": ("model", None)}
-        dense = lambda feats, name, bias=None: _TDense(
+        _mk_dense = lambda feats, name, bias=None: _TDense(
             feats, kernel_spec=_KSPEC.get(name),
             use_bias=cfg.use_bias if bias is None else bias,
             dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+        if cfg.activation_quant is None:
+            dense = _mk_dense
+        else:
+            # round-17 low-precision experiment: every block matmul sees
+            # an int8/fp8-rounded INPUT (straight-through gradient) — the
+            # module is built eagerly so the flax param order is identical
+            # to the unquantized block (checkpoints interchange freely)
+            from ..quant_format import fake_quant_act
+            dense = lambda feats, name, bias=None: (
+                lambda h, _m=_mk_dense(feats, name, bias): _m(
+                    fake_quant_act(h, cfg.activation_quant)))
         if cfg.norm == "rmsnorm":
             ln = lambda name: nn.RMSNorm(epsilon=cfg.layer_norm_eps,
                                          dtype=cfg.dtype,

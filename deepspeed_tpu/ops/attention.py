@@ -135,6 +135,8 @@ def paged_attention(q, k_pool, v_pool, block_tables, context_lens, *,
                     softcap: float = 0.0,
                     window=None,
                     layer_idx=None,
+                    k_scale=None,
+                    v_scale=None,
                     q_start=None,
                     impl: str = "auto",
                     interpret: bool = False) -> jnp.ndarray:
@@ -147,10 +149,13 @@ def paged_attention(q, k_pool, v_pool, block_tables, context_lens, *,
     or interpret) with ALiBi/softcap/window in-kernel; every other regime
     — prefill (T > 1, possibly with PADDED trailing queries positioned by
     ``q_start``), CPU, untileable shapes — runs the exact jnp gather
-    reference. ``impl="reference"`` forces the oracle.
+    reference. int8 pools ride both paths via ``k_scale``/``v_scale``
+    (per-(layer, head, slot) f32, dequantized in-kernel / post-gather).
+    ``impl="reference"`` forces the oracle.
     """
     kw = dict(sm_scale=sm_scale, alibi_slopes=alibi_slopes, softcap=softcap,
-              window=window, layer_idx=layer_idx)
+              window=window, layer_idx=layer_idx, k_scale=k_scale,
+              v_scale=v_scale)
     on_tpu = jax.default_backend() == "tpu"
     if impl in ("auto", "flash") and (on_tpu or interpret) \
             and q.shape[2] == 1:

@@ -47,9 +47,12 @@ __all__ = ["paged_attention", "paged_attention_reference"]
 _QROWS = 8
 
 
-def _kernel(bt_ref, lens_ref, misc_ref, q_ref, k_ref, v_ref, slopes_ref,
-            o_ref, acc, m_scr, l_scr, *, hg, bs, nbk, sm_scale, softcap,
-            has_alibi, stacked):
+def _kernel(bt_ref, lens_ref, misc_ref, q_ref, k_ref, v_ref, *rest, hg, bs,
+            nbk, sm_scale, softcap, has_alibi, stacked, quant):
+    if quant:
+        ks_ref, vs_ref, slopes_ref, o_ref, acc, m_scr, l_scr = rest
+    else:
+        slopes_ref, o_ref, acc, m_scr, l_scr = rest
     b, j = pl.program_id(0), pl.program_id(2)
     ctx = lens_ref[b]
     window = misc_ref[0]
@@ -66,6 +69,14 @@ def _kernel(bt_ref, lens_ref, misc_ref, q_ref, k_ref, v_ref, slopes_ref,
         q = q_ref[0, 0]                                     # [hg, 8, hd]
         k = k_ref[0, :, 0] if stacked else k_ref[:, 0]      # [hg, bs, hd]
         v = v_ref[0, :, 0] if stacked else v_ref[:, 0]
+        if quant:
+            # int8 tier (round 17): the DMA moved int8 rows + one f32
+            # scale per (head, slot); dequantize HERE, on the block
+            # already in VMEM — only int8 crossed HBM
+            ks = ks_ref[0, :, 0] if stacked else ks_ref[:, 0]   # [hg, bs]
+            vs = vs_ref[0, :, 0] if stacked else vs_ref[:, 0]
+            k = (k.astype(jnp.float32) * ks[..., None]).astype(q.dtype)
+            v = (v.astype(jnp.float32) * vs[..., None]).astype(q.dtype)
         s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
                                 preferred_element_type=jnp.float32) * sm_scale
         if softcap:
@@ -111,6 +122,8 @@ def paged_attention(q: jnp.ndarray,
                     softcap: float = 0.0,
                     window=None,
                     layer_idx=None,
+                    k_scale=None,
+                    v_scale=None,
                     interpret: bool = False) -> jnp.ndarray:
     """One decode token per sequence against a paged KV pool.
 
@@ -120,6 +133,13 @@ def paged_attention(q: jnp.ndarray,
        (traced i32 ok) the stacked [L, nh, num_blocks, block_size, hd]
        layout — the index_map picks the layer straight out of the
        scan-carried pool, no materialized per-layer slice.
+    k_scale/v_scale: the int8 tier (round 17) — pools are int8 in the
+       ``quant_format.kv_quantize`` layout and these carry the f32
+       per-(layer, head, slot) scales (any shape that reshapes to the
+       pool's [..., num_blocks, block_size], e.g. init_pool's
+       [L, nh, num_slots, 1]). The scale blocks ride the SAME block-table
+       index_map as k/v and the dequant happens in-kernel, so the HBM
+       read is int8 + 4 bytes/slot — no pool-slice f32 copy exists.
     block_tables: [B, max_blocks] i32 — logical block j of sequence b
        lives in physical pool block ``block_tables[b, j]``. Entries past
        the live count are never DMA'd (the index_map clamps them to the
@@ -144,6 +164,19 @@ def paged_attention(q: jnp.ndarray,
                          "of 8 required)")
     if hd % 8 != 0 and not interpret:
         raise ValueError(f"head_dim {hd} does not tile")
+    quant = k_scale is not None
+    if quant:
+        if k_pool.dtype != jnp.int8:
+            raise ValueError("k_scale/v_scale given but the pool dtype is "
+                             f"{k_pool.dtype} — scales pair with int8 pools")
+        if bs % 32 != 0 and not interpret:
+            raise ValueError(f"block_size {bs} does not tile the int8 KV "
+                             "tier (int8 sublane multiple of 32 required)")
+        ks_pool = jnp.asarray(k_scale, jnp.float32).reshape(k_pool.shape[:-1])
+        vs_pool = jnp.asarray(v_scale, jnp.float32).reshape(v_pool.shape[:-1])
+    elif k_pool.dtype == jnp.int8:
+        raise ValueError("int8 KV pool needs k_scale/v_scale "
+                         "(quant_format.kv_quantize layout)")
     nbk = block_tables.shape[1]
     hg = _head_group(nh, bs, hd, k_pool.dtype.itemsize)
     ng = nh // hg
@@ -182,6 +215,21 @@ def paged_attention(q: jnp.ndarray,
 
     in_specs = [q_spec, kv_spec, kv_spec]
     operands = [qf, k_pool, v_pool]
+    if quant:
+        # scale blocks follow the K/V through the SAME clamped
+        # block-table index_map (hd dim dropped: one f32 per slot row)
+        if stacked:
+            sc_spec = pl.BlockSpec(
+                (1, hg, 1, bs),
+                lambda b, g, j, bt_s, lens_s, misc_s: (
+                    misc_s[1], g, _phys(j, bt_s, lens_s, b), 0))
+        else:
+            sc_spec = pl.BlockSpec(
+                (hg, 1, bs),
+                lambda b, g, j, bt_s, lens_s, misc_s: (
+                    g, _phys(j, bt_s, lens_s, b), 0))
+        in_specs += [sc_spec, sc_spec]
+        operands += [ks_pool, vs_pool]
     has_alibi = alibi_slopes is not None
     if has_alibi:
         sl = jnp.asarray(alibi_slopes, jnp.float32).reshape(ng, hg)
@@ -209,7 +257,8 @@ def paged_attention(q: jnp.ndarray,
     with jax.named_scope("paged_attention"):
         out = pl.pallas_call(
             partial(_kernel, hg=hg, bs=bs, nbk=nbk, sm_scale=scale,
-                    softcap=softcap, has_alibi=has_alibi, stacked=stacked),
+                    softcap=softcap, has_alibi=has_alibi, stacked=stacked,
+                    quant=quant),
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((B, ng, hg, _QROWS, hd), q.dtype),
             interpret=interpret,
@@ -228,6 +277,8 @@ def paged_attention_reference(q: jnp.ndarray,
                               softcap: float = 0.0,
                               window=None,
                               layer_idx=None,
+                              k_scale=None,
+                              v_scale=None,
                               q_start=None) -> jnp.ndarray:
     """jnp oracle / CPU fallback: dense gather through the block table,
     then exactly the decode-path attention math (f32 scores, softcap
@@ -239,13 +290,30 @@ def paged_attention_reference(q: jnp.ndarray,
     given: a bucket-PADDED prefill carries trailing garbage queries past
     ctx whose outputs the caller discards), so one definition serves
     prefill and decode.
+
+    int8 tier (``k_scale``/``v_scale``, the kernel's layout): the gather
+    moves int8 rows and their scales, and the dequant happens AFTER the
+    gather — O(attended tokens), not O(pool). Gather-then-dequantize is
+    elementwise identical to the round-12 dequantize-then-gather, so
+    greedy decodes are token-for-token unchanged.
     """
     B, nh, T, hd = q.shape
+    quant = k_scale is not None
+    if quant:
+        k_scale = jnp.asarray(k_scale, jnp.float32).reshape(
+            k_pool.shape[:-1])
+        v_scale = jnp.asarray(v_scale, jnp.float32).reshape(
+            v_pool.shape[:-1])
     if layer_idx is not None:
         k_pool = jax.lax.dynamic_index_in_dim(k_pool, layer_idx, 0,
                                               keepdims=False)
         v_pool = jax.lax.dynamic_index_in_dim(v_pool, layer_idx, 0,
                                               keepdims=False)
+        if quant:
+            k_scale = jax.lax.dynamic_index_in_dim(k_scale, layer_idx, 0,
+                                                   keepdims=False)
+            v_scale = jax.lax.dynamic_index_in_dim(v_scale, layer_idx, 0,
+                                                   keepdims=False)
     bs = k_pool.shape[2]
     nbk = block_tables.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(hd)
@@ -257,6 +325,13 @@ def paged_attention_reference(q: jnp.ndarray,
         B, nh, nbk * bs, hd)
     v = jnp.transpose(v_pool[:, bt], (1, 0, 2, 3, 4)).reshape(
         B, nh, nbk * bs, hd)
+    if quant:
+        ks = jnp.transpose(k_scale[:, bt], (1, 0, 2, 3)).reshape(
+            B, nh, nbk * bs)
+        vs = jnp.transpose(v_scale[:, bt], (1, 0, 2, 3)).reshape(
+            B, nh, nbk * bs)
+        k = (k.astype(jnp.float32) * ks[..., None]).astype(q.dtype)
+        v = (v.astype(jnp.float32) * vs[..., None]).astype(q.dtype)
 
     if q_start is not None:
         q_abs = (jnp.asarray(q_start, jnp.int32).reshape(B)[:, None]

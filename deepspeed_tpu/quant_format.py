@@ -1,0 +1,138 @@
+"""THE quantization format — single-sourced blockwise/per-row int8.
+
+Round 17: the repo carried three near-copies of the same symmetric-int8
+math — the blockwise wire format in ``runtime/comm/quantized.py``, the
+per-(head, position) KV-cache format in ``models/generation``, and the
+paged-pool variant documented in ``serving/kv_cache.py``. They are ONE
+format family (absmax / 127 symmetric scales over a fixed granularity)
+and now live here; every consumer imports these definitions, so the
+error model documented in docs/COMM.md ("error <= block_absmax / 127
+per element") is a property of one function, not a convention three
+files re-implement.
+
+Two granularities:
+
+* **blockwise** (:func:`block_quant` / :func:`block_dequant`): the last
+  dim is cut into ``QUANT_BLOCK``-element blocks, one f32 scale each —
+  the int8 wire format of the quantized collectives (ZeRO++ qgZ /
+  EQuARX style) AND the weight-only decode matmuls
+  (``ops/pallas/quant_matmul.py`` stores kernels int8 with the SAME
+  per-256-element scales along the contraction dim, dequantized
+  in-kernel).
+* **per-row** (:func:`kv_quantize`): one f32 scale per trailing row
+  (absmax over the last dim) — the KV-cache format shared by the dense
+  ``generate()`` cache and the paged serving pool, where a "row" is one
+  (layer, head, position/slot) K or V vector and the Pallas paged
+  kernel dequantizes it in-kernel (round 17).
+
+:func:`fake_quant_act` is the straight-through activation fake-quant of
+the round-17 low-precision training experiment (int8 blockwise or
+emulated fp8-e4m3), built on the same blockwise math.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: elements per quantization block (one f32 scale each): 256 keeps the
+#: scale overhead at 4/256 = 1.6% of the int8 payload while bounding an
+#: outlier's blast radius to its own block
+QUANT_BLOCK = 256
+
+#: float8_e4m3 dynamic range (finite max) — the fp8 fake-quant scale target
+_E4M3_MAX = 448.0
+
+
+def block_quant(x: jnp.ndarray, bits: int = 8, block: int = QUANT_BLOCK
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+    """Blockwise symmetric quantization of the LAST dim.
+
+    x [..., L] -> (q int8 [..., Lp], scales f32 [..., Lp/block], pad)
+    with Lp = L padded up to a block multiple. Zero blocks get scale 1
+    (quantize to 0 exactly); q is clipped to the symmetric range.
+    Per-element roundtrip error is bounded by block_absmax / (2^(bits-1)
+    - 1) — half a quantization step of the block's own scale."""
+    qmax = float(2 ** (bits - 1) - 1)
+    L = x.shape[-1]
+    nb = -(-L // block)
+    pad = nb * block - L
+    xf = x.astype(jnp.float32)
+    if pad:
+        xf = jnp.pad(xf, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    xb = xf.reshape(x.shape[:-1] + (nb, block))
+    absmax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = jnp.where(absmax == 0, 1.0, absmax / qmax)
+    q = jnp.clip(jnp.round(xb / scale), -qmax, qmax).astype(jnp.int8)
+    return (q.reshape(x.shape[:-1] + (nb * block,)),
+            scale.reshape(x.shape[:-1] + (nb,)), pad)
+
+
+def block_dequant(q: jnp.ndarray, scales: jnp.ndarray, pad: int
+                  ) -> jnp.ndarray:
+    """Inverse of :func:`block_quant` (f32 out, padding stripped)."""
+    nb = scales.shape[-1]
+    block = q.shape[-1] // nb
+    xb = q.astype(jnp.float32).reshape(q.shape[:-1] + (nb, block))
+    out = (xb * scales[..., None]).reshape(q.shape)
+    if pad:
+        out = out[..., :q.shape[-1] - pad]
+    return out
+
+
+def kv_quantize(t: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., hd] -> (int8 values, f32 per-row scales [..., 1]).
+
+    One symmetric scale per trailing row (absmax / 127 over the last
+    dim; zero rows scale 1) — the KV-cache format: a row is one
+    (layer, head, position/slot) K or V vector, in both the dense
+    ``generate()`` cache and the paged serving pool."""
+    scale = jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1,
+                    keepdims=True) / 127.0
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(t.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def fake_quant_act(x: jnp.ndarray, fmt: str = "int8",
+                   block: int = QUANT_BLOCK) -> jnp.ndarray:
+    """Straight-through activation fake-quant (round-17 low-precision
+    training experiment): the forward value is the ``fmt`` roundtrip of
+    ``x`` over the last dim, the gradient passes through untouched.
+
+    * ``"int8"`` — the blockwise format above (error <= block_absmax /
+      127 per element).
+    * ``"fp8"``  — e4m3-style: one f32 scale per block maps the block's
+      absmax onto the e4m3 range, values round through
+      ``float8_e4m3fn`` (jax ships ml_dtypes), scale divides back out.
+      Emulation of delayed-scaling fp8 compute at bf16 speed — the
+      numerics experiment, not the MXU feed.
+    """
+    if fmt not in ("int8", "fp8"):
+        raise ValueError(f"fake_quant_act fmt {fmt!r}: expected int8|fp8")
+
+    @jax.custom_vjp
+    def _fq(x):
+        if fmt == "int8":
+            q, s, pad = block_quant(x, 8, block)
+            return block_dequant(q, s, pad).astype(x.dtype)
+        L = x.shape[-1]
+        nb = -(-L // block)
+        pad = nb * block - L
+        xf = x.astype(jnp.float32)
+        if pad:
+            xf = jnp.pad(xf, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+        xb = xf.reshape(x.shape[:-1] + (nb, block))
+        absmax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+        scale = jnp.where(absmax == 0, 1.0, absmax / _E4M3_MAX)
+        y = (xb / scale).astype(jnp.float8_e4m3fn).astype(jnp.float32)
+        out = (y * scale).reshape(x.shape[:-1] + (nb * block,))
+        if pad:
+            out = out[..., :L]
+        return out.astype(x.dtype)
+
+    _fq.defvjp(lambda x: (_fq(x), None), lambda _, g: (g,))
+    return _fq(x)

@@ -35,12 +35,18 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ...quant_format import QUANT_BLOCK, block_dequant, block_quant
 from ...utils.jax_compat import shard_map
 
-#: default elements per quantization block (one f32 scale each): 256
-#: keeps the scale overhead at 4/256 = 1.6% of the int8 payload while
-#: bounding an outlier's blast radius to its own block
-DEFAULT_BLOCK = 256
+#: re-export: the wire format's block granularity is THE shared format's
+#: (deepspeed_tpu/quant_format.py — single-sourced round 17; the
+#: blockwise quant/dequant imported above live there too)
+DEFAULT_BLOCK = QUANT_BLOCK
+
+__all__ = ["DEFAULT_BLOCK", "block_quant", "block_dequant",
+           "rs_quantized_local", "rs_exact_local", "ag_quantized_local",
+           "a2a_quantized_local", "quantized_reduce_scatter", "grad_sync",
+           "quantized_all_to_all", "make_queue_exchange"]
 
 
 def _axes_tuple(axis) -> Tuple[str, ...]:
@@ -52,40 +58,6 @@ def _axes_size(mesh, axis) -> int:
     for a in _axes_tuple(axis):
         n *= mesh.shape[a]
     return n
-
-
-def block_quant(x: jnp.ndarray, bits: int = 8, block: int = DEFAULT_BLOCK
-                ) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
-    """Blockwise symmetric quantization of the LAST dim.
-
-    x [..., L] -> (q int8 [..., Lp], scales f32 [..., Lp/block], pad)
-    with Lp = L padded up to a block multiple. Zero blocks get scale 1
-    (quantize to 0 exactly); q is clipped to the symmetric range."""
-    qmax = float(2 ** (bits - 1) - 1)
-    L = x.shape[-1]
-    nb = -(-L // block)
-    pad = nb * block - L
-    xf = x.astype(jnp.float32)
-    if pad:
-        xf = jnp.pad(xf, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
-    xb = xf.reshape(x.shape[:-1] + (nb, block))
-    absmax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
-    scale = jnp.where(absmax == 0, 1.0, absmax / qmax)
-    q = jnp.clip(jnp.round(xb / scale), -qmax, qmax).astype(jnp.int8)
-    return (q.reshape(x.shape[:-1] + (nb * block,)),
-            scale.reshape(x.shape[:-1] + (nb,)), pad)
-
-
-def block_dequant(q: jnp.ndarray, scales: jnp.ndarray, pad: int
-                  ) -> jnp.ndarray:
-    """Inverse of :func:`block_quant` (f32 out, padding stripped)."""
-    nb = scales.shape[-1]
-    block = q.shape[-1] // nb
-    xb = q.astype(jnp.float32).reshape(q.shape[:-1] + (nb, block))
-    out = (xb * scales[..., None]).reshape(q.shape)
-    if pad:
-        out = out[..., :q.shape[-1] - pad]
-    return out
 
 
 # ---------------------------------------------------------------------------

@@ -157,6 +157,74 @@ def wire_attention_config(model, config: DeepSpeedConfig):
     return model
 
 
+def wire_low_precision(model, config: DeepSpeedConfig):
+    """Consume ``compression_training.activation_quantization`` by rewiring
+    the model's ``activation_quant`` (round 17 — the section parsed into
+    ``CompressionSpec.activation_bits`` since round 6 but nothing read it:
+    a parsed-but-dead section, the wire_attention_config contract).
+
+    The low-precision step is an EXPERIMENT, not a default: it requires
+    the integrity sentinel (``config.integrity.enabled``) so a quantized
+    step that degrades loss rides the skip -> rollback -> abort ladder
+    instead of silently training through it. Unknown bit widths and
+    activation schedule offsets RAISE — silently running full precision
+    would be a wrong answer.
+    """
+    act = (config.compression_training.model_dump()
+           .get("activation_quantization") or {})
+    shared = act.get("shared_parameters") or {}
+    from ..models.transformer import TransformerConfig
+    mcfg = getattr(model, "cfg", None)
+    if not shared.get("enabled", False):
+        # model-knob route (build_model(activation_quant=...)) still gets
+        # the sentinel gate below when training through the engine
+        if isinstance(mcfg, TransformerConfig) and mcfg.activation_quant \
+                and not config.integrity.enabled:
+            raise ValueError(
+                "activation_quant is a gated experiment: enable the "
+                "integrity sentinel (config.integrity.enabled) so bad "
+                "quantized steps hit the skip/rollback ladder")
+        return model
+    if int(shared.get("schedule_offset", 0)):
+        raise NotImplementedError(
+            "activation_quantization.schedule_offset is not supported for "
+            "the low-precision step (the quant lives inside the model, "
+            "which does not see the step counter)")
+    bits_list = [int((g.get("params") or {}).get("bits", 8))
+                 for g in (act.get("different_groups") or {}).values()]
+    bits = min(bits_list) if bits_list else 8
+    if bits != 8:
+        raise ValueError(
+            f"activation_quantization bits={bits}: only 8 (blockwise int8 "
+            "fake-quant; fp8 emulation rides the model knob "
+            "activation_quant='fp8')")
+    if not isinstance(mcfg, TransformerConfig):
+        raise ValueError(
+            "activation_quantization requires the in-tree transformer "
+            "family (models.build_model); this model has no "
+            "TransformerConfig to wire activation_quant into")
+    if not config.integrity.enabled:
+        raise ValueError(
+            "activation_quantization is a gated experiment: enable the "
+            "integrity sentinel (config.integrity.enabled) so bad "
+            "quantized steps hit the skip/rollback ladder")
+    if mcfg.activation_quant not in (None, "int8"):
+        raise ValueError(
+            f"activation_quantization conflicts with the model's hand-set "
+            f"activation_quant={mcfg.activation_quant!r}")
+    import dataclasses as _dc
+    model = model.clone(cfg=_dc.replace(mcfg, activation_quant="int8")) \
+        if hasattr(model, "clone") else model
+    if getattr(getattr(model, "cfg", None), "activation_quant", None) \
+            != "int8":
+        raise ValueError(
+            f"cannot rebuild model {type(model).__name__} with "
+            "activation_quant='int8'")
+    log_dist("low-precision experiment wired: activation_quant='int8' "
+             "(sentinel-gated)", ranks=[0])
+    return model
+
+
 class DeepSpeedEngine:
     def __init__(self,
                  model,
@@ -176,6 +244,9 @@ class DeepSpeedEngine:
         # sections by rewiring the model's attention_impl (VERDICT: the two
         # parsed-but-dead sections). Must happen before apply_fn is built.
         model = wire_attention_config(model, self.config)
+        # compression_training.activation_quantization -> the round-17
+        # low-precision step (sentinel-gated; also before apply_fn)
+        model = wire_low_precision(model, self.config)
         self.module = model
         self.mesh_mgr = mesh_manager or build_mesh_from_config(self.config)
         self.mesh = self.mesh_mgr.mesh

@@ -172,17 +172,19 @@ class ServingEngine:
                 "one-shot generate()")
         self.params = ensure_scan_layout(params, cfg.num_layers)
         kv_dtype = resolve_kv_dtype(serving)
-        if kv_dtype == jnp.int8 and (jax.default_backend() == "tpu"
-                                     or interpret):
-            # dtype-mismatch guard AT CONSTRUCTION: the Pallas decode
-            # kernel reads the pool's native dtype — it has no int8
-            # dequant tier yet, and discovering that mid-decode would be
-            # a shape error inside the compiled step
-            raise NotImplementedError(
-                "serving.kv_cache_dtype='int8' decodes through the jnp "
-                "gather reference path (dequantize-on-read); the Pallas "
-                "paged-attention kernel does not read int8 pools — run "
-                "on the CPU backend or use bf16/f32 pools on TPU")
+        # int8 pools decode through the Pallas kernel's in-kernel dequant
+        # tier (round 17) — the round-12 construction guard is gone.
+        if serving.weight_dtype is not None:
+            if serving.weight_dtype != "int8":
+                raise ValueError(
+                    f"serving.weight_dtype {serving.weight_dtype!r}: only "
+                    "'int8' (blockwise weight-only) or null")
+            # pack ONCE at construction: dense kernels -> blockwise int8
+            # + per-256-element f32 scales (quant_format's wire format);
+            # the decode matmuls then ride ops/pallas/quant_matmul and
+            # never materialize a full-precision weight copy
+            from ..ops.pallas.quant_matmul import pack_decode_weights
+            self.params = pack_decode_weights(self.params)
         # the paged-KV state: PRIVATE by default, SHARED when a
         # disaggregated pair (serving/disagg.py) passes one in — block
         # IDs then mean the same pool slots to both roles, which is what

@@ -59,9 +59,11 @@ def init_pool(cfg, num_blocks: int, block_size: int,
 
     ``dtype=jnp.int8`` (round 12): the quantized pool tier — k/v store
     int8 with a per-(layer, head, slot) f32 scale (symmetric over the
-    head dim, the dense generate() cache's ``_kv_quantize`` format),
-    halving pool HBM vs bf16. The paged forward quantizes on write and
-    dequantizes on read (see serving/model_runner.py)."""
+    head dim, ``quant_format.kv_quantize`` — the single-sourced format),
+    halving pool HBM vs bf16. The paged forward quantizes on write;
+    reads dequantize IN-kernel (round 17): the Pallas paged-attention
+    kernel takes the int8 blocks plus scales and dequantizes per block
+    in VMEM, so int8 is what crosses HBM (no pool-slice f32 copy)."""
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, cfg.num_heads, num_blocks * block_size,
              cfg.head_dim)

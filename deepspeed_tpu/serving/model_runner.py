@@ -27,6 +27,7 @@ paged sequences are always exact-length.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict, Tuple
 
 import jax
@@ -70,19 +71,24 @@ def paged_forward(cfg: TransformerConfig,
     post-LN encoders don't decode; int8 weight-only params work unchanged
     (the dequant rides ``_kernel_of``).
 
-    int8 KV pools (round 12): when ``pools`` carries ``k_scale`` /
-    ``v_scale`` (``init_pool(dtype=jnp.int8)``), K/V rows are QUANTIZED
-    ON WRITE — symmetric int8 over the head dim with one f32 scale per
-    (layer, head, slot), the dense generate() cache's ``_kv_quantize``
-    format — and DEQUANTIZED ON READ (the layer's pool slice, before the
-    block gather; the jnp reference path — the Pallas decode kernel does
-    not read int8 pools, guarded at engine construction). Error per
-    element is bounded by that row's absmax / 254. Deliberate cost of
-    this correctness-first tier: the read dequantizes the WHOLE per-layer
-    pool slice (O(pool), not O(attended blocks)) into a transient
-    f32->compute-dtype copy — the at-rest HBM saving is real, the
-    per-step read is not; gathering-then-dequantizing (or dequant inside
-    the kernel) is the ROADMAP item-4 rung.
+    int8 KV pools (round 12, in-kernel since round 17): when ``pools``
+    carries ``k_scale`` / ``v_scale`` (``init_pool(dtype=jnp.int8)``),
+    K/V rows are QUANTIZED ON WRITE — symmetric int8 over the head dim
+    with one f32 scale per (layer, head, slot), the single-sourced
+    ``quant_format.kv_quantize`` format — and the int8 pool plus scales
+    go STRAIGHT to attention: the Pallas decode kernel DMAs int8 blocks
+    through the block table and dequantizes them in VMEM; the jnp
+    reference dequantizes after its gather. Either way the dequant is
+    O(attended blocks), not O(pool) — the round-12 full-pool-slice
+    f32 read copy is gone (ROADMAP item-2 rung, this PR). Error per
+    element is bounded by that row's absmax / 254; greedy decodes are
+    token-for-token identical to the round-12 path (gather and dequant
+    are elementwise, so they commute).
+
+    int8 weights (round 17): ``kernel_qscale`` leaves (engine-packed
+    under ``serving.weight_dtype: "int8"``) route every block matmul
+    through ``ops.pallas.quant_matmul`` — blockwise dequant in-kernel,
+    jnp per-block reference elsewhere.
     """
     if cfg.post_ln:
         raise NotImplementedError("post-LN encoders (BERT) do not serve")
@@ -115,6 +121,9 @@ def paged_forward(cfg: TransformerConfig,
     bt = jnp.asarray(block_tables, jnp.int32)
     q_start = jnp.asarray(q_start, jnp.int32).reshape(B)
     ctx = jnp.asarray(context_lens, jnp.int32).reshape(B)
+    # interpret threads into the weight path too: blockwise-int8 kernels
+    # (kernel_qscale) route through the Pallas quant matmul
+    dense = partial(_dense, interpret=interpret)
 
     wte = params["wte"]["embedding"]
     x = wte.astype(cfg.dtype)[input_ids]
@@ -149,7 +158,7 @@ def paged_forward(cfg: TransformerConfig,
         k_pool, v_pool = kv["k"], kv["v"]
         p, window, li = xs
         h = _layer_norm(x, p["ln1"], cfg.layer_norm_eps, rms)
-        qkv = _dense(h, p["attn_qkv"])
+        qkv = dense(h, p["attn_qkv"])
         q, k, v = jnp.split(qkv, [nh * hd, (nh + kvh) * hd], axis=-1)
         to_heads = lambda t, n: t.reshape(B, T, n, hd).transpose(0, 2, 1, 3)
         q, k, v = to_heads(q, nh), to_heads(k, kvh), to_heads(v, kvh)
@@ -189,35 +198,21 @@ def paged_forward(cfg: TransformerConfig,
             v_pool = v_pool.at[li, :, flat_slots].set(
                 v_rows.astype(v_pool.dtype))
         kv_new["k"], kv_new["v"] = k_pool, v_pool
-        # attention through the block table (kernel on TPU decode,
-        # exact jnp gather elsewhere; int8 tier: dequantize THIS layer's
-        # pool slice and run the layer-free reference view)
-        if quant_kv:
-            kl = jax.lax.dynamic_index_in_dim(k_pool, li, 0, keepdims=False)
-            vl = jax.lax.dynamic_index_in_dim(v_pool, li, 0, keepdims=False)
-            ksl = jax.lax.dynamic_index_in_dim(kv_new["k_scale"], li, 0,
-                                               keepdims=False)
-            vsl = jax.lax.dynamic_index_in_dim(kv_new["v_scale"], li, 0,
-                                               keepdims=False)
-            kp5 = (kl.astype(jnp.float32) * ksl).astype(cfg.dtype).reshape(
-                nh, nb_pool, bs, hd)
-            vp5 = (vl.astype(jnp.float32) * vsl).astype(cfg.dtype).reshape(
-                nh, nb_pool, bs, hd)
-            o = paged_attention(q, kp5, vp5, bt, ctx, sm_scale=sm_scale,
-                                alibi_slopes=slopes,
-                                softcap=cfg.attn_softcap, window=window,
-                                layer_idx=None, q_start=q_start,
-                                interpret=interpret)
-        else:
-            kp5 = k_pool.reshape(L, nh, nb_pool, bs, hd)
-            vp5 = v_pool.reshape(L, nh, nb_pool, bs, hd)
-            o = paged_attention(q, kp5, vp5, bt, ctx, sm_scale=sm_scale,
-                                alibi_slopes=slopes,
-                                softcap=cfg.attn_softcap, window=window,
-                                layer_idx=li, q_start=q_start,
-                                interpret=interpret)
+        # attention through the block table (kernel on TPU decode, exact
+        # jnp gather elsewhere); the int8 tier passes the pool AS int8
+        # with its scales — dequant happens in-kernel / post-gather,
+        # O(attended blocks), never a pool-slice copy
+        kp5 = k_pool.reshape(L, nh, nb_pool, bs, hd)
+        vp5 = v_pool.reshape(L, nh, nb_pool, bs, hd)
+        scale_kw = (dict(k_scale=kv_new["k_scale"],
+                         v_scale=kv_new["v_scale"]) if quant_kv else {})
+        o = paged_attention(q, kp5, vp5, bt, ctx, sm_scale=sm_scale,
+                            alibi_slopes=slopes,
+                            softcap=cfg.attn_softcap, window=window,
+                            layer_idx=li, q_start=q_start,
+                            interpret=interpret, **scale_kw)
         o = o.transpose(0, 2, 1, 3).reshape(B, T, nh * hd)
-        attn_out = _dense(o, p["attn_proj"])
+        attn_out = dense(o, p["attn_proj"])
         if cfg.post_block_norms:
             attn_out = _layer_norm(attn_out, p["post_attn_norm"],
                                    cfg.layer_norm_eps, rms)
@@ -226,9 +221,9 @@ def paged_forward(cfg: TransformerConfig,
             if cfg.moe_experts > 0:
                 return _moe_mlp(cfg, p["moe"], hin)
             if cfg.gated_mlp:
-                g = act(_dense(hin, p["mlp_gate"]))
-                return _dense(g * _dense(hin, p["mlp_fc"]), p["mlp_proj"])
-            return _dense(act(_dense(hin, p["mlp_fc"])), p["mlp_proj"])
+                g = act(dense(hin, p["mlp_gate"]))
+                return dense(g * dense(hin, p["mlp_fc"]), p["mlp_proj"])
+            return dense(act(dense(hin, p["mlp_fc"])), p["mlp_proj"])
 
         if cfg.parallel_residual:
             m_in = (_layer_norm(x, p["ln2"], cfg.layer_norm_eps, rms)
@@ -250,7 +245,7 @@ def paged_forward(cfg: TransformerConfig,
     if cfg.tie_embeddings:
         logits = jnp.einsum("bth,vh->btv", x, wte.astype(x.dtype))
     else:
-        logits = _dense(x, params["lm_head"])
+        logits = dense(x, params["lm_head"])
     if cfg.final_logit_softcap:
         from ..ops.attention import apply_softcap
         logits = apply_softcap(logits, cfg.final_logit_softcap)

@@ -23,6 +23,10 @@
 # by DSElasticAgent with the degraded world resuming training; a
 # serve.replica_slow-degraded replica is drained exactly-once token-exact
 # and blacklisted on repeat, with the poisson_fleet_slow bench row.
+# Round 17 adds the low-precision training leg (tests/test_low_precision.py):
+# chaos grad spike on a sentinel-gated int8 fake-quant engine -> in-jit
+# skip + loss parity with the uninjected low-precision twin — the
+# guardrail the activation_quant experiment is gated on, fired under it.
 # Round 12 adds the disaggregated-serving matrices (tests/test_disagg.py):
 # replica kill at serve.chunk / serve.handoff / serve.handoff_drop ->
 # every request completes token-exact or FAILED-within-retry-budget with
@@ -50,6 +54,7 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_fleet.py \
     tests/test_straggler.py \
     tests/test_disagg.py \
+    tests/test_low_precision.py \
     tests/test_mpmd.py \
     "tests/test_multiprocess.py::test_two_process_sharded_save_with_per_rank_failpoint" \
     "tests/test_multiprocess.py::test_two_process_sdc_bitflip_detected_and_attributed" \

@@ -149,6 +149,11 @@ def test_cross_topology_roundtrip(tmp_path):
     np.testing.assert_allclose(ref, got, rtol=2e-2)
 
 
+# tier-2 (round-17 budget sweep, ~10s): the cheaper tier-1 cousins are
+# test_bf16_preserved_bit_exact and
+# test_sharded_write_and_assemble_roundtrip (same on-disk layout the
+# inspector reads); scripts/tier2.sh runs the inspector end-to-end
+@pytest.mark.slow
 def test_checkpoint_inspector(tmp_path):
     engine = _gpt_engine({})
     engine.train_batch(_lm_batch(0))

@@ -311,6 +311,11 @@ def _engine(cfg_extra=None, seed=7, hidden=32):
     return engine
 
 
+# tier-2 (round-17 budget sweep, ~10s): the cheaper tier-1 cousins are
+# test_overlap_grad_sync_value (wire values) and
+# test_hlo_grad_sync_overlap_is_chunked_no_full_collective (structure);
+# scripts/tier2.sh runs this 12-step engine parity leg
+@pytest.mark.slow
 def test_engine_zero2_overlap_12step_loss_parity():
     """Acceptance: exact-vs-overlap 12-step loss parity through the
     shared _finalize_step tail. The overlap wire moves exact values, so
@@ -424,6 +429,11 @@ def test_engine_overlap_selected_from_recorded_plan(tmp_path):
     assert np.isfinite(float(m["loss"]))
 
 
+# tier-2 (round-17 budget sweep, ~11s): the cheaper tier-1 cousins are
+# test_comm_plan.test_engine_accuracy_guard_forces_exact (lossy latch)
+# and test_engine_zero3_overlap_param_gather_parity (exact-wire overlap
+# keeps running); scripts/tier2.sh runs this exemption matrix
+@pytest.mark.slow
 def test_accuracy_guard_exempts_exact_wire_overlap():
     """The guard forces exact only for LOSSY formats: overlap_int8
     latches to exact, plain overlap keeps running (it already moves
@@ -447,6 +457,11 @@ def test_accuracy_guard_exempts_exact_wire_overlap():
 
 # ------------------------------------------------------------- envelope pins
 
+# tier-2 (round-17 budget sweep, ~12s): the cheaper tier-1 cousins are
+# test_comm_plan.test_engine_forced_sync_outside_envelope_degrades (same
+# degrade contract, one site) and test_effective_chunks_divisibility;
+# scripts/tier2.sh runs the full forced/unforced matrix
+@pytest.mark.slow
 def test_envelope_degrade_matrix():
     """Round-14 contract: a forced non-exact grad sync OUTSIDE the
     envelope degrades to exact with a warning instead of raising, and

@@ -355,6 +355,11 @@ def test_engine_forced_sync_outside_envelope_degrades():
     assert np.isfinite(float(e.train_batch(random_batch(16))["loss"]))
 
 
+# tier-2 (round-17 budget sweep, ~10s): the cheaper tier-1 cousins are
+# test_engine_forced_sync_outside_envelope_degrades (same degrade path,
+# forced) and test_resolve_unknown_bucket_falls_back_to_heuristic;
+# scripts/tier2.sh runs this unforced-selection leg
+@pytest.mark.slow
 def test_engine_unforced_selection_degrades_to_exact_outside_envelope():
     """A plan-driven (not forced) int8 verdict on an incompatible mesh
     logs and runs exact — selection must never brick a launch."""

@@ -67,6 +67,12 @@ def _fleet_serving(prefill=1, decode=1, chunk=10, **fleet_kw):
 # the acceptance-criteria three-mode matrix
 # ---------------------------------------------------------------------------
 
+# tier-2 (round-17 budget sweep, ~14s): the cheaper tier-1 cousins are
+# test_chunked_prefill_fairness_no_stall_beyond_one_chunk,
+# test_disagg_handoff_chaos_refcount_exact and
+# test_init_inference_serve_disagg_entry; scripts/chaos.sh and
+# scripts/tier2.sh run this acceptance matrix
+@pytest.mark.slow
 def test_three_modes_staggered_token_exact(tiny):
     """Whole prefill, chunked prefill (non-block-aligned chunk) and the
     disaggregated pair produce IDENTICAL greedy outputs for a staggered

@@ -76,6 +76,12 @@ def _wait_inflight(flt, idx, timeout=30.0):
 # tier-1: kill -> requeue (with a requeue crash folded in), exactly-once
 # ---------------------------------------------------------------------------
 
+# tier-2 (round-17 budget sweep, ~13s): the cheaper tier-1 cousins are
+# test_fleet_retry_budget_exhaustion_fails_cleanly (death -> retry path),
+# test_fleet_supervisor_verdict_units and
+# test_init_inference_serve_returns_started_fleet; scripts/chaos.sh and
+# scripts/tier2.sh run this leg and the 3-replica kill matrix
+@pytest.mark.slow
 def test_fleet_kill_requeues_exactly_once_token_exact(tiny):
     """serve.replica_kill mid-decode: the dead replica's in-flight
     requests requeue onto survivors and finish token-exact vs sequential
@@ -217,6 +223,11 @@ def test_fleet_supervisor_verdict_units():
     assert sup0._verdict(rep, stale, now) is None
 
 
+# tier-2 (round-17 budget sweep, ~10s): the cheaper tier-1 cousin is
+# test_serving.test_inference_bench_poisson_line (same row plumbing,
+# single engine); the slow-replica fleet row rides
+# test_inference_bench_poisson_fleet_slow_replica_row in tier2
+@pytest.mark.slow
 def test_inference_bench_poisson_fleet_line(capsys):
     """--poisson --fleet N failure-injection leg prints the
     machine-readable degraded-throughput row (tokens/s before / during /

@@ -226,6 +226,11 @@ def test_int8_quantization_error_bound():
     assert q["ln"]["scale"].dtype == np.float32
 
 
+# tier-2 (round-17 budget sweep, ~10s): the cheaper tier-1 cousins are
+# test_serving.test_int8_weight_only_decode_parity and
+# test_serving.test_int8_kv_pool_parity_jnp_and_kernel (the round-17
+# blockwise int8 tier, token-exact end to end); tier2.sh runs this leg
+@pytest.mark.slow
 def test_int8_engine_logits_close_and_generates():
     """dtype:int8 builds a weight-only-quantized engine whose logits track
     the bf16 engine within int8 noise and whose generate() runs end to end
@@ -570,8 +575,9 @@ def test_timestep_embedding_matches_torch_mirror():
     np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
 
 
-# tier-2 (round 8 budget): test_int8_engine_logits_close_and_generates
-# keeps the int8 tier gating tier-1
+# tier-2 (round 8 budget; round-17 re-homed the gating cousins to
+# test_serving.test_int8_kv_pool_parity_jnp_and_kernel +
+# test_int8_weight_only_decode_parity, which keep the int8 tier tier-1)
 @pytest.mark.slow
 def test_int8_kv_cache_parity_and_capacity():
     """kv_cache_dtype='int8': greedy generations match the bf16-cache path

@@ -504,6 +504,11 @@ def test_serving_arch_matrix_token_exact():
 # round 12: chunked prefill, per-lane top-k/top-p, int8 paged KV pool
 # ---------------------------------------------------------------------------
 
+# tier-2 (round-17 budget sweep, ~10s): the cheaper tier-1 cousins are
+# test_disagg.test_chunked_prefill_fairness_no_stall_beyond_one_chunk and
+# test_disagg.test_disagg_fleet_requeue_carries_chunk_progress (same
+# chunk machinery under fault); scripts/tier2.sh runs this compile-bound pin
+@pytest.mark.slow
 def test_chunked_prefill_token_exact_and_compile_bound(tiny):
     """A non-block-aligned chunk size is token-exact vs whole prefill,
     and the chunk machinery adds at most ONE extra prefill bucket (all
@@ -583,13 +588,15 @@ def test_sampling_filters_guard_and_greedy_invariance(tiny):
         assert cache_size() == 1
 
 
-def test_int8_kv_pool_parity_and_guard(tiny):
+def test_int8_kv_pool_parity_jnp_and_kernel(tiny):
     """The quantized pool tier (serving.kv_cache_dtype='int8'):
-    quantize-on-write / dequant-on-read with the dense path's per-channel
-    scale format. Greedy outputs match the f32 oracle within the int8
-    error bound (token-equal on this fixture — f32 compute, real logit
-    gaps), the pool leaves are genuinely int8 + f32 scales, and the
-    dtype guard rejects the Pallas-kernel path at construction."""
+    quantize-on-write, dequantize IN-kernel (round 17 — the round-12
+    construction guard is gone). Greedy outputs match the f32 oracle
+    within the int8 error bound (token-equal on this fixture — f32
+    compute, real logit gaps) on BOTH decode paths: the jnp
+    gather-then-dequant reference AND the Pallas kernel's int8 tier
+    (interpret=True forces it on CPU), which must also agree with each
+    other token-for-token."""
     cfg, params = tiny
     rng = np.random.default_rng(29)
     prompts = [list(rng.integers(1, 64, size=n)) for n in (5, 21)]
@@ -601,9 +608,40 @@ def test_int8_kv_pool_parity_and_guard(tiny):
     for p, o in zip(prompts, outs):
         assert o == _oracle_tokens(cfg, params, p, 6), \
             "int8 pool beyond the quantization error bound"
-    # construction guard: the Pallas kernel (interpret=True forces it on
-    # CPU) has no int8 dequant tier — fail loudly now, not mid-decode
-    with pytest.raises(NotImplementedError):
+    # the Pallas int8 tier: same pools, dequant in-kernel
+    eng_k = ServingEngine(cfg, params,
+                          serving=dict(SERVE_CFG, kv_cache_dtype="int8"),
+                          interpret=True)
+    outs_k = eng_k.generate_batch(prompts, max_new_tokens=6)
+    assert outs_k == outs, "in-kernel dequant diverged from the jnp path"
+
+
+def test_int8_weight_only_decode_parity(tiny):
+    """serving.weight_dtype='int8' (round 17): dense kernels pack ONCE to
+    blockwise int8 + per-256-element f32 scales and every decode matmul
+    rides the quant path. Greedy outputs are token-equal with the
+    unquantized oracle on this fixture (f32 compute, real logit gaps
+    exceed the <=absmax/127 weight error), the packed leaves are
+    genuinely int8, and the kernel (interpret) and jnp reference paths
+    agree token-for-token."""
+    cfg, params = tiny
+    rng = np.random.default_rng(31)
+    prompts = [list(rng.integers(1, 64, size=n)) for n in (4, 18)]
+    eng = ServingEngine(cfg, params,
+                        serving=dict(SERVE_CFG, weight_dtype="int8"))
+    blk = eng.params["blocks"]
+    assert blk["attn_qkv"]["kernel"].dtype == jnp.int8
+    assert blk["attn_qkv"]["kernel_qscale"].dtype == jnp.float32
+    outs = eng.generate_batch(prompts, max_new_tokens=6)
+    for p, o in zip(prompts, outs):
+        assert o == _oracle_tokens(cfg, params, p, 6), \
+            "int8 weight-only decode beyond the quantization error bound"
+    eng_k = ServingEngine(cfg, params,
+                          serving=dict(SERVE_CFG, weight_dtype="int8",
+                                       kv_cache_dtype="int8"),
+                          interpret=True)
+    outs_k = eng_k.generate_batch(prompts, max_new_tokens=6)
+    assert outs_k == outs, "quantized kernels diverged from the jnp path"
+    with pytest.raises(ValueError):
         ServingEngine(cfg, params,
-                      serving=dict(SERVE_CFG, kv_cache_dtype="int8"),
-                      interpret=True)
+                      serving=dict(SERVE_CFG, weight_dtype="int4"))
