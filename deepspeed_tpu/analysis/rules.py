@@ -933,8 +933,10 @@ class UnboundedBlockingRule(Rule):
     paths, the watchdog's terminal stamp, the preemption handler's
     self-deadlocking re-acquire). The rule fires only in the supervision
     modules (``supervisor.py`` / ``watchdog.py`` / ``fleet.py`` /
-    ``elastic_agent.py`` / ``straggler.py`` / the MPMD ``driver.py``) —
-    ordinary code is allowed to wait.
+    ``elastic_agent.py`` / ``straggler.py`` / the MPMD ``driver.py`` /
+    the round-18 transfer fabric ``endpoint.py``/``sockets.py``/
+    ``local.py`` and process fleet ``procfleet.py``/
+    ``replica_worker.py``) — ordinary code is allowed to wait.
 
     Receiver-name vocabulary keeps the check precise: ``.acquire()`` on
     lock-ish names, ``.wait()`` on event/condition-ish names (a
@@ -953,9 +955,14 @@ class UnboundedBlockingRule(Rule):
     severity = Severity.WARNING
     summary = "unbounded blocking call in a supervision module"
 
-    #: files whose job is supervision — the only place the rule fires
+    #: files whose job is supervision — the only place the rule fires.
+    #: Round 18 adds the transfer-fabric layer (runtime/fabric/) and the
+    #: process-placement fleet: a channel or hub that blocks forever IS
+    #: the wedge the supervision stack exists to catch.
     _MODULES = ("supervisor.py", "watchdog.py", "fleet.py",
-                "elastic_agent.py", "straggler.py", "driver.py")
+                "elastic_agent.py", "straggler.py", "driver.py",
+                "endpoint.py", "sockets.py", "local.py",
+                "procfleet.py", "replica_worker.py")
     _LOCKISH = re.compile(r"lock|mutex|sem", re.I)
     _EVENTISH = re.compile(r"evt|event|done|stop|ready|cond|barrier|sig",
                            re.I)

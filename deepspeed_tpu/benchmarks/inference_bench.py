@@ -23,6 +23,14 @@ recorded tokens/s prints a LOUD regression, and
 ``DSTPU_SERVE_BENCH_GATE=1`` makes it fatal. ``--chunk N`` arms chunked
 prefill for the serving rows (mode column records it).
 
+Round 18 adds the process-placement leg: ``--fleet N --placement
+process`` drives the process-per-replica fleet (serving/procfleet.py —
+worker processes over the transfer fabric) and SIGKILLs a replica
+PROCESS at 1/3 completion, printing a ``poisson_fleet_proc`` row with
+tokens/s before/during/after the real process death; the row's
+``heartbeat_dir`` is live for ``dstpu health`` (per-process replica
+rows with pid/queue/pool gauges).
+
 Round 17 adds the quantized-compute legs: ``--kv-dtype int8`` serves
 from the int8 KV pool (in-kernel dequant) and ``--weight-dtype int8``
 from blockwise weight-only int8 matmuls; the rows carry ``kv_dtype`` /
@@ -430,6 +438,142 @@ def run_poisson_fleet(preset: str, rate: float, num_requests: int,
     return row
 
 
+def run_poisson_fleet_proc(preset: str, rate: float, num_requests: int,
+                           prompt_len: int, new_tokens: int,
+                           replicas: int = 2,
+                           serving: Optional[dict] = None,
+                           fail_replica: bool = True, seed: int = 0,
+                           model_kwargs: Optional[dict] = None) -> dict:
+    """Poisson load against the PROCESS-placement fleet (round 18,
+    serving/procfleet.py): each replica engine in a supervised OS
+    process, request/token streams over the transfer fabric's TCP star.
+    Once a third of the requests have completed, the last replica's
+    PROCESS takes a real ``SIGKILL`` — actual process death, not a
+    failpoint — and the row records tokens/s BEFORE / DURING / AFTER
+    the loss plus the death-ledger columns, the process-placement
+    counterpart of the ``poisson_fleet`` resilience number. The
+    heartbeat channel is a real directory (``heartbeat_dir`` column):
+    ``dstpu health <dir>`` shows the per-process replica rows —
+    pid/queue/pool gauges per worker — mid-run and after. Row::
+
+        inference_bench poisson_fleet_proc: {"rate": ..., "replicas":
+            ..., "tps_before": ..., "tps_during": ..., "tps_after": ...,
+            "requeues": ..., "deaths": ..., ...}
+    """
+    import signal as _signal
+
+    from ..models import build_model
+    from ..serving.procfleet import ProcessFleet
+    model, cfg = build_model(preset, max_seq_len=prompt_len + new_tokens,
+                             **(model_kwargs or {}))
+    rng = np.random.default_rng(seed)
+    ids0 = rng.integers(0, cfg.vocab_size, (1, prompt_len))
+    # one-shot bench setup: init compiles once before the timed region
+    # graftlint: disable=TPU002
+    params = jax.jit(lambda r: model.init(r, {"input_ids": ids0})
+                     ["params"])(jax.random.PRNGKey(0))
+    scfg = dict(serving or {})
+    fleet_cfg = dict(scfg.pop("fleet", {}))
+    fleet_cfg.setdefault("replicas", replicas)
+    fleet_cfg["placement"] = "process"
+    # snappy recovery for the bench window (production defaults are lazier)
+    fleet_cfg.setdefault("poll_interval", 0.05)
+    fleet_cfg.setdefault("heartbeat_interval", 0.05)
+    scfg["fleet"] = fleet_cfg
+    flt = ProcessFleet(cfg, params, serving=scfg)
+    flt.start()
+    # workers warm THEMSELVES at spawn (weights + compile off the
+    # serving path); this is the ready barrier, not the trigger
+    flt.warmup(timeout=600.0)
+    base = dict(flt.stats)              # row reports the timed window only
+
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=prompt_len))
+               for _ in range(num_requests)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=num_requests))
+    t0 = time.perf_counter()
+    t0_mono = time.monotonic()
+    reqs: List = []
+    next_i = 0
+    killed_at = None
+    victim = int(fleet_cfg["replicas"]) - 1
+    timeline: List[tuple] = []          # (t, tokens_emitted) samples
+    while True:
+        now = time.perf_counter() - t0
+        while next_i < num_requests and arrivals[next_i] <= now:
+            reqs.append(flt.submit(prompts[next_i], new_tokens))
+            next_i += 1
+        done = sum(1 for r in reqs if r.done)
+        timeline.append((now, flt.stats["tokens_emitted"]))
+        if (fail_replica and killed_at is None
+                and done >= max(num_requests // 3, 1)):
+            pid = flt.pids().get(victim)
+            if pid is not None:
+                os.kill(pid, _signal.SIGKILL)   # a real process death
+                killed_at = now
+        if next_i >= num_requests and done >= num_requests:
+            break
+        time.sleep(0.005)
+    wall = time.perf_counter() - t0
+    if killed_at is not None:
+        # the victim may have died idle — give the supervisor its poll
+        # so the row's death/attribution columns are stable
+        t_wait = time.perf_counter()
+        while (flt.stats["deaths"] == base["deaths"]
+               and time.perf_counter() - t_wait < 10.0):
+            time.sleep(0.01)
+
+    def _tps(t_lo, t_hi):
+        if t_hi - t_lo <= 0:
+            return None
+        lo = min((s for s in timeline if s[0] >= t_lo),
+                 default=timeline[-1])
+        hi = max((s for s in timeline if s[0] <= t_hi),
+                 default=timeline[-1])
+        if hi[0] - lo[0] <= 0:
+            return None
+        return round((hi[1] - lo[1]) / (hi[0] - lo[0]), 1)
+
+    t_rec = None
+    if flt.deaths:
+        rts = (flt.deaths[-1]["restarted_ts"]
+               or flt.deaths[-1]["detected_ts"])
+        t_rec = rts - t0_mono
+    lat = sorted(r.finish_ts - (t0_mono + arr)
+                 for r, arr in zip(reqs, arrivals) if r.finish_ts)
+    n_chips = jax.device_count()
+    row = {
+        "mode": "poisson_fleet_proc",
+        "preset": preset, "rate": float(rate),
+        "replicas": int(fleet_cfg["replicas"]), "requests": num_requests,
+        "prompt": prompt_len, "new_tokens": new_tokens,
+        "chunk": int(scfg.get("prefill_chunk_tokens", 0)),
+        "kv_dtype": scfg.get("kv_cache_dtype"),
+        "weight_dtype": scfg.get("weight_dtype"),
+        "wall_s": round(wall, 3),
+        "p50_s": round(float(np.percentile(lat, 50)), 4),
+        "p99_s": round(float(np.percentile(lat, 99)), 4),
+        "tokens_per_s": round(num_requests * new_tokens / wall, 1),
+        "tokens_per_s_per_chip": round(
+            num_requests * new_tokens / wall / n_chips, 1),
+        "tps_before": _tps(0.0, killed_at) if killed_at else None,
+        "tps_during": (_tps(killed_at, t_rec)
+                       if killed_at and t_rec else None),
+        "tps_after": _tps(t_rec, wall) if t_rec else None,
+        "kill_at_s": round(killed_at, 3) if killed_at else None,
+        "recovered_at_s": round(t_rec, 3) if t_rec else None,
+        "deaths": flt.stats["deaths"] - base["deaths"],
+        "requeues": flt.stats["requeues"] - base["requeues"],
+        "completed": flt.stats["completed"] - base["completed"],
+        "failed": flt.stats["failed"] - base["failed"],
+        "timeout": flt.stats["timeout"] - base["timeout"],
+        "heartbeat_dir": flt.heartbeat_dir,
+        "n_chips": n_chips,
+    }
+    flt.close()
+    print("inference_bench poisson_fleet_proc: " + json.dumps(row))
+    return row
+
+
 def record_serve_bench(rows: List[Dict], path: str) -> str:
     """Write serving-bench rows in the SERVEBENCH report shape (the
     comm-sweep convention: ``{"n": device_count, "rows": [...]}`` so
@@ -537,6 +681,13 @@ def main(argv=None):
                    help="with --poisson: drive a supervised N-replica "
                         "fleet instead of one engine; prints the "
                         "poisson_fleet degraded-throughput row")
+    p.add_argument("--placement", choices=("thread", "process"),
+                   default="thread",
+                   help="fleet leg replica placement: 'process' (round "
+                        "18) runs each replica in a supervised OS "
+                        "process over the transfer fabric and SIGKILLs "
+                        "a replica PROCESS at 1/3 completion — the "
+                        "poisson_fleet_proc degraded-throughput row")
     p.add_argument("--no-fail-replica", action="store_true",
                    help="fleet leg: skip the replica-kill injection "
                         "(steady-state fleet throughput only)")
@@ -586,7 +737,12 @@ def main(argv=None):
         serving = serving or None
         rows = []
         for rate in (float(x) for x in args.rates.split(",")):
-            if args.fleet > 1:
+            if args.fleet > 1 and args.placement == "process":
+                rows.append(run_poisson_fleet_proc(
+                    args.preset, rate, args.requests, args.prompt,
+                    args.new, replicas=args.fleet, serving=serving,
+                    fail_replica=not args.no_fail_replica))
+            elif args.fleet > 1:
                 rows.append(run_poisson_fleet(
                     args.preset, rate, args.requests, args.prompt,
                     args.new, replicas=args.fleet, serving=serving,

@@ -453,6 +453,15 @@ class FleetConfig(DeepSpeedConfigModel):
     directory ``dstpu health`` can read (default: a private tempdir,
     exposed as ``ServingFleet.heartbeat_dir``)."""
     replicas: int = 1                  # 1 = plain single-engine serving
+    # replica placement (round 18, serving/procfleet.py): "thread" runs
+    # replica engines as threads in this process (the round-11 fleet);
+    # "process" runs each replica as a supervised OS PROCESS — weights
+    # via checkpoint load, request/token streams over the transfer
+    # fabric's TCP star (runtime/fabric/), SERVE heartbeats with gauges
+    # in the shared channel, warmed restart on death — the
+    # fleet-across-a-pod shape. Process placement requires plain
+    # replicas (disagg roles share one in-process pool by construction).
+    placement: str = "thread"          # "thread" | "process"
     # disaggregated serving (round 12, serving/disagg.py): with BOTH > 0
     # the fleet runs prefill-role and decode-role replicas over ONE
     # shared paged-KV state, connected by the bounded block-handoff

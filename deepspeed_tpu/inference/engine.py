@@ -286,8 +286,9 @@ class InferenceEngine:
                 f"{scfg.fleet.decode_replicas})")
         disagg = (scfg.fleet.prefill_replicas > 0
                   and scfg.fleet.decode_replicas > 0)
-        if scfg.fleet.replicas > 1 or disagg:
-            from ..serving.fleet import ServingFleet
+        if (scfg.fleet.replicas > 1 or disagg
+                or str(scfg.fleet.placement) == "process"):
+            from ..serving.procfleet import make_fleet
             from ..utils.logging import logger
             hb_dir = scfg.fleet.heartbeat_dir
             if heartbeat is not None and hb_dir is None:
@@ -309,8 +310,11 @@ class InferenceEngine:
                     "a fleet — its rc-117 exit would take every replica; "
                     "the FleetSupervisor (fleet.heartbeat_timeout) "
                     "supervises replicas instead")
-            fleet = ServingFleet(self.module.cfg, self.params, serving=scfg,
-                                 heartbeat_dir=hb_dir, interpret=interpret)
+            # placement-dispatching: "thread" builds the round-11
+            # ServingFleet, "process" the round-18 ProcessFleet —
+            # same serving surface either way
+            fleet = make_fleet(self.module.cfg, self.params, serving=scfg,
+                               heartbeat_dir=hb_dir, interpret=interpret)
             fleet.start()
             return fleet
         from ..serving.engine import ServingEngine

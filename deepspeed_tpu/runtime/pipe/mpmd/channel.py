@@ -3,18 +3,24 @@
 The SPMD pipeline moves activations with ``lax.ppermute`` *inside* one
 compiled program; the MPMD placement moves them BETWEEN programs, so the
 transfer is a first-class host-visible object with a failure mode of its
-own. Two implementations share one interface:
+own. Since round 18 both implementations are thin adapters over the
+unified transfer fabric (:mod:`deepspeed_tpu.runtime.fabric`) — the
+framing, CRC trailer, generation fencing, reconnect/backoff, and the
+``net.*`` chaos surface all live THERE; this module only adds the
+pipeline's demux (per-(kind, micro) FIFOs, control side-queue) and the
+npy payload codec:
 
 * :class:`LocalChannel` — in-process: payloads are jax Arrays handed
-  device-to-device via ``jax.device_put`` onto the receiving stage's
-  submesh placement (on TPU this is an ICI/DCN copy; on the CPU backend a
-  host copy — either way the boundary crossing is explicit and auditable,
-  which is what graftlint TPU014 polices inside compiled step paths).
-* :class:`SocketChannel` — cross-process host bounce: numpy payloads ride
-  a length-prefixed JSON+bytes frame over ONE TCP connection to the
-  driver, which routes stage→stage (a star, so a restarted stage just
-  reconnects — no peer rewiring). This is the CPU-testable reference
-  path; device-to-device DCN transport slots in behind the same
+  device-to-device via the local endpoint's ``device_put`` place hook
+  onto the receiving stage's submesh placement (on TPU an ICI/DCN copy;
+  on the CPU backend a host copy — either way the boundary crossing is
+  explicit and auditable, which is what graftlint TPU014 polices inside
+  compiled step paths).
+* :class:`SocketChannel` — cross-process host bounce: numpy payloads
+  ride fabric frames over ONE TCP connection to the driver, which
+  routes stage→stage (a star, so a restarted stage just reconnects —
+  no peer rewiring). This is the CPU-testable reference path;
+  device-to-device DCN transport slots in behind the same fabric
   interface.
 
 Ordering contract: the clock tables send each edge's payloads in strictly
@@ -24,7 +30,8 @@ of silently consuming the wrong tensor.
 
 Failure injection: every send and recv traverses the ``pipe.xfer``
 failpoint (keyed ``"<kind>:<src>-><dst>"``), the chaos hook the recovery
-matrix in tests/test_mpmd.py arms. A recv past its deadline raises
+matrix in tests/test_mpmd.py arms — and, below it, the fabric's
+``net.*`` failpoints. A recv past its deadline raises
 :class:`ChannelTimeout` — the "peer parked at the transfer barrier"
 signal the park/resync protocol (driver.py) is built on.
 """
@@ -32,10 +39,6 @@ signal the park/resync protocol (driver.py) is built on.
 from __future__ import annotations
 
 import io
-import json
-import socket
-import struct
-import threading
 import time
 from collections import defaultdict, deque
 from typing import Any, Dict, Optional, Tuple
@@ -43,18 +46,15 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from ....testing import chaos
+# re-exported: the exceptions are the channel's public contract, now
+# owned by the fabric (stage_worker, driver, and tests import them here)
+from ...fabric import (ChannelClosed, ChannelTimeout,  # noqa: F401
+                       LocalEndpoint, RedialPolicy, SocketEndpoint,
+                       read_frame, write_frame)
 
 #: transfer kinds — activations flow downstream, cotangents upstream
 KIND_ACT = "act"
 KIND_GRAD = "grad"
-
-
-class ChannelTimeout(IOError):
-    """recv() exceeded its deadline — the sending peer is late or dead."""
-
-
-class ChannelClosed(IOError):
-    """The transport is gone (peer hangup / driver teardown)."""
 
 
 class LocalChannel:
@@ -62,25 +62,39 @@ class LocalChannel:
 
     ``placements``: optional {stage: jax.sharding.Sharding} — when given,
     every payload is ``jax.device_put`` onto the RECEIVING stage's
-    placement at send time (the device-to-device hop). Without it the
-    payload is handed over as-is (single-submesh tests).
+    placement at send time (the device-to-device hop, applied by the
+    local endpoint's place hook). Without it the payload is handed over
+    as-is (single-submesh tests).
     """
 
     def __init__(self, placements: Optional[Dict[int, Any]] = None):
-        self._q: Dict[Tuple[str, int], deque] = defaultdict(deque)
         self.placements = placements or {}
+        self._ep = LocalEndpoint(ident="pipe", place=self._place)
+        self._q: Dict[Tuple[str, int], deque] = defaultdict(deque)
 
-    def send(self, kind: str, src: int, dst: int, micro: int,
-             payload) -> None:
-        chaos.failpoint("pipe.xfer", key=f"{kind}:{src}->{dst}")
-        sh = self.placements.get(dst)
+    def _place(self, meta: dict, payload):
+        sh = self.placements.get(meta.get("dst"))
         if sh is not None:
             import jax
             payload = jax.device_put(payload, sh)
-        self._q[(kind, dst)].append((micro, payload))
+        return payload
+
+    def send(self, kind: str, src: int, dst: int, micro: int,
+             payload) -> None:
+        edge = f"{kind}:{src}->{dst}"
+        chaos.failpoint("pipe.xfer", key=edge)
+        self._ep.send({"kind": kind, "src": src, "dst": dst,
+                       "micro": int(micro)}, payload, key=edge)
+
+    def _drain(self) -> None:
+        while self._ep.pending():
+            meta, payload = self._ep.recv(timeout=0.0)
+            self._q[(meta["kind"], meta["dst"])].append(
+                (meta["micro"], payload))
 
     def recv(self, kind: str, dst: int, micro: int,
              timeout: Optional[float] = None):
+        self._drain()
         q = self._q[(kind, dst)]
         if not q:
             # in-process execution is synchronous: an empty queue is a
@@ -95,41 +109,17 @@ class LocalChannel:
         return payload
 
     def pending(self, kind: str, dst: int) -> int:
+        self._drain()
         return len(self._q[(kind, dst)])
 
     def clear(self) -> None:
         """Drop every queued payload (park: the in-flight step is
         abandoned, its transfers must not leak into the replay)."""
+        self._ep.clear()
         self._q.clear()
 
 
-# ---------------------------------------------------------------- wire format
-
-def _pack_frame(meta: dict, payload: bytes = b"") -> bytes:
-    head = json.dumps(meta, sort_keys=True).encode()
-    return struct.pack("!II", len(head), len(payload)) + head + payload
-
-
-def _read_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ChannelClosed("peer closed the transfer connection")
-        buf += chunk
-    return buf
-
-
-def read_frame(sock: socket.socket) -> Tuple[dict, bytes]:
-    hlen, plen = struct.unpack("!II", _read_exact(sock, 8))
-    meta = json.loads(_read_exact(sock, hlen).decode())
-    payload = _read_exact(sock, plen) if plen else b""
-    return meta, payload
-
-
-def write_frame(sock: socket.socket, meta: dict, payload: bytes = b"") -> None:
-    sock.sendall(_pack_frame(meta, payload))
-
+# ------------------------------------------------------------ payload codec
 
 def _to_bytes(arr) -> bytes:
     bio = io.BytesIO()
@@ -142,7 +132,12 @@ def _from_bytes(raw: bytes) -> np.ndarray:
 
 
 class SocketChannel:
-    """One stage's endpoint of the host-bounce star (see module docstring).
+    """One stage's endpoint of the host-bounce star (see module
+    docstring). The fabric :class:`SocketEndpoint` owns the connection:
+    dial backoff, CRC framing, the bounded write lock, mid-stream
+    redial, and generation fencing (stale frames from an abandoned
+    park/resync generation are dropped at receipt, inside the
+    endpoint).
 
     Data frames ({kind, src, dst, micro} + npy payload) interleave with
     CONTROL frames ({cmd: park|resync|stop, ...}) from the driver on the
@@ -155,76 +150,50 @@ class SocketChannel:
     def __init__(self, driver_addr: Tuple[str, int], stage: int,
                  resume_step: int = 0, connect_timeout: float = 30.0):
         self.stage = stage
-        #: park/resync generation — stamped on every data frame; frames
-        #: from another generation are DROPPED at receipt (a peer's last
-        #: sends before a park must never leak into the replayed step).
-        #: Deliberately NOT the step number: healthy pipelining crosses
-        #: step boundaries (a fast upstream stage legitimately sends
-        #: step k+1 activations while downstream finishes step k).
-        self.generation = 0
-        self._lock = threading.Lock()
-        deadline = time.monotonic() + connect_timeout
-        last_err: Optional[Exception] = None
-        while True:
-            try:
-                self._sock = socket.create_connection(driver_addr, timeout=5.0)
-                break
-            except OSError as e:          # driver not listening yet
-                last_err = e
-                if time.monotonic() >= deadline:
-                    raise ChannelClosed(
-                        f"stage {stage}: cannot reach driver at "
-                        f"{driver_addr}: {last_err}")
-                time.sleep(0.05)
-        self._sock.settimeout(None)
+        # the driver's welcome hands the CURRENT park/resync generation
+        # — a restarted stage must stamp its frames so the parked
+        # survivors accept them. NOT the step number: healthy
+        # pipelining crosses step boundaries (a fast upstream stage
+        # legitimately sends step k+1 activations while downstream
+        # finishes step k).
+        self._ep = SocketEndpoint(
+            driver_addr, ident=f"stage-{stage}",
+            hello={"stage": stage, "resume_step": int(resume_step)},
+            connect_timeout=connect_timeout,
+            redial=RedialPolicy(attempts=2, base=0.05, dial_timeout=2.0))
         self._data: Dict[Tuple[str, int], deque] = defaultdict(deque)
         self._control: deque = deque()
-        write_frame(self._sock, {"cmd": "hello", "stage": stage,
-                                 "resume_step": int(resume_step)})
-        # the driver answers with the CURRENT generation — a restarted
-        # stage must stamp its frames so the parked survivors accept them
-        welcome = self.wait_control("welcome", timeout=connect_timeout)
-        self.generation = int(welcome.get("gen", 0))
+
+    @property
+    def generation(self) -> int:
+        """Park/resync generation — lives in the fabric endpoint (it
+        stamps every data frame and fences receipt); the resync control
+        path assigns it here."""
+        return self._ep.generation
+
+    @generation.setter
+    def generation(self, gen: int) -> None:
+        self._ep.generation = int(gen)
 
     def send(self, kind: str, src: int, dst: int, micro: int,
              payload, lock_timeout: float = 30.0) -> None:
-        chaos.failpoint("pipe.xfer", key=f"{kind}:{src}->{dst}")
-        arr = np.asarray(payload)
-        self._write({"kind": kind, "src": src, "dst": dst,
-                     "micro": int(micro), "gen": self.generation},
-                    _to_bytes(arr), lock_timeout)
+        edge = f"{kind}:{src}->{dst}"
+        chaos.failpoint("pipe.xfer", key=edge)
+        self._ep.send({"kind": kind, "src": src, "dst": dst,
+                       "micro": int(micro)},
+                      _to_bytes(np.asarray(payload)),
+                      key=edge, lock_timeout=lock_timeout)
 
     def send_control(self, meta: dict, lock_timeout: float = 30.0) -> None:
-        self._write(meta, b"", lock_timeout)
-
-    def _write(self, meta: dict, payload: bytes,
-               lock_timeout: float) -> None:
-        # bounded: a driver wedged mid-read keeps sendall — and with it
-        # the frame lock — stuck; a writer starved this long is facing a
-        # dead driver, and OSError is what a dead socket raises anyway
-        if not self._lock.acquire(timeout=lock_timeout):
-            raise OSError(
-                f"channel write lock starved for {lock_timeout}s "
-                "(driver wedged mid-frame?)")
-        try:
-            write_frame(self._sock, meta, payload)
-        finally:
-            self._lock.release()
+        self._ep.send(meta, b"", lock_timeout=lock_timeout)
 
     def _pump_one(self, timeout: Optional[float]) -> None:
-        self._sock.settimeout(timeout)
-        try:
-            meta, payload = read_frame(self._sock)
-        except socket.timeout:
-            raise ChannelTimeout("transfer barrier deadline exceeded")
-        finally:
-            self._sock.settimeout(None)
+        meta, payload = self._ep.recv(timeout)
         if "cmd" in meta:
             self._control.append(meta)
-        elif meta.get("gen", self.generation) == self.generation:
+        else:
             self._data[(meta["kind"], meta["micro"])].append(
                 _from_bytes(payload))
-        # else: a stale frame from an abandoned generation — dropped
 
     def recv(self, kind: str, dst: int, micro: int,
              timeout: Optional[float] = None) -> np.ndarray:
@@ -285,10 +254,7 @@ class SocketChannel:
         self._data.clear()
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._ep.close()
 
 
 class ParkSignal(Exception):

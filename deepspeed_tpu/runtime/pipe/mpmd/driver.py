@@ -43,8 +43,8 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from ....exit_codes import INTEGRITY_EXIT_CODE, PREEMPTION_EXIT_CODE
+from ...fabric import HubConn, read_frame
 from ...watchdog import STALL_EXIT_CODE
-from .channel import read_frame, write_frame
 
 
 class StageWorkerSpec:
@@ -63,26 +63,14 @@ class StageWorkerSpec:
         self._spawned = False
 
 
-class _StageConn:
-    def __init__(self, sock: socket.socket, resume_step: int):
-        self.sock = sock
-        self.resume_step = resume_step
-        self.wlock = threading.Lock()
+class _StageConn(HubConn):
+    """Hub-side stage connection — the fabric :class:`HubConn` (bounded
+    write lock: a peer wedged mid-read starves later senders into the
+    OSError a dead peer raises anyway) plus the stage's resume step."""
 
-    def send(self, meta: dict, payload: bytes = b"",
-             lock_timeout: float = 5.0) -> None:
-        # bounded: a peer wedged mid-read keeps sendall — and with it
-        # this lock — stuck, and every later sender (welcome, broadcast)
-        # would pile up behind it. A starved writer is treated like a
-        # dead peer: OSError, which every caller already handles
-        if not self.wlock.acquire(timeout=lock_timeout):
-            raise OSError(
-                f"stage connection write lock starved for {lock_timeout}s "
-                "(peer wedged mid-frame?)")
-        try:
-            write_frame(self.sock, meta, payload)
-        finally:
-            self.wlock.release()
+    def __init__(self, sock: socket.socket, resume_step: int):
+        super().__init__(sock)
+        self.resume_step = resume_step
 
 
 class MPMDStageSupervisor:

@@ -13,7 +13,12 @@
   exactly-once request requeue, blacklist/parole, graceful degradation);
 - :mod:`~deepspeed_tpu.serving.disagg` — disaggregated serving (round
   12): PrefillEngine/DecodeEngine roles over a bounded paged-KV block
-  handoff, zero-copy via the shared refcounted pool.
+  handoff, zero-copy via the shared refcounted pool;
+- :mod:`~deepspeed_tpu.serving.procfleet` — process-per-replica
+  placement (round 18): each replica engine in a supervised OS process
+  (serving/replica_worker.py), request/token streams over the transfer
+  fabric (runtime/fabric/), the same fleet surface — pick by
+  ``serving.fleet.placement`` via :func:`make_fleet`.
 
 Entry points: ``ServingEngine(cfg, params, serving_config)`` directly,
 ``DisaggEngine`` for the single-process disagg pair, or
@@ -28,10 +33,11 @@ from .engine import ServingEngine, lane_topk_topp
 from .fleet import FleetRequest, FleetSupervisor, ServingFleet
 from .kv_cache import (BlockPool, BlockPoolExhausted, PrefixCache,
                        SharedPagedState, init_pool)
+from .procfleet import ProcessFleet, make_fleet
 from .scheduler import Request, Scheduler
 
 __all__ = ["ServingEngine", "ServingFleet", "FleetSupervisor",
            "FleetRequest", "BlockPool", "BlockPoolExhausted", "PrefixCache",
            "SharedPagedState", "init_pool", "Request", "Scheduler",
            "DisaggEngine", "PrefillEngine", "DecodeEngine", "BlockHandoff",
-           "HandoffItem", "lane_topk_topp"]
+           "HandoffItem", "lane_topk_topp", "ProcessFleet", "make_fleet"]

@@ -54,12 +54,12 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..runtime.fabric import ChannelTimeout, LocalEndpoint
 from ..testing import chaos
 from ..utils.logging import logger
 from .engine import ServingEngine, _Seq, resolve_kv_dtype
@@ -101,7 +101,11 @@ class BlockHandoff:
         self.pool = pool
         self.capacity = int(capacity)
         self.on_push = on_push
-        self._q: deque = deque()
+        # the queue is a local fabric endpoint (round 18): items ride
+        # BY REFERENCE — ownership transfer, never KV bytes — and every
+        # push/pop traverses the fabric's net.* chaos surface, the same
+        # failure model the cross-process backends exercise
+        self._ep = LocalEndpoint(ident="handoff")
         self._mu = threading.Lock()
         self.pushed = 0
         self.popped = 0
@@ -109,34 +113,43 @@ class BlockHandoff:
 
     @property
     def pending(self) -> int:
-        with self._mu:
-            return len(self._q)
+        return self._ep.pending()
 
     def push(self, item: HandoffItem) -> None:
-        """Enqueue a finished prefill. The ``serve.handoff`` failpoint
-        fires BEFORE the item is queued: a crash there leaves the blocks
+        """Enqueue a finished prefill. The ``serve.handoff`` failpoint —
+        and the fabric's ``net.send`` below it — fires BEFORE the item
+        is queued or its state mutated: a crash there leaves the blocks
         owned by the (dying) prefill role, whose death path releases
         them — the item is never half-queued. Raises :class:`HandoffFull`
         at capacity."""
         chaos.failpoint("serve.handoff")
         with self._mu:
-            if len(self._q) >= self.capacity:
+            if self._ep.pending() >= self.capacity:
                 raise HandoffFull(
                     f"handoff queue at capacity ({self.capacity}); "
                     "decode is behind — prefill holds the item")
+            self._ep.send({"kind": "handoff", "rid": item.req.rid},
+                          item, key="handoff")
             item.req.state = HANDOFF
-            self._q.append(item)
             self.pushed += 1
             if self.on_push is not None:
                 self.on_push(item)
 
     def pop(self) -> Optional[HandoffItem]:
-        with self._mu:
-            if not self._q:
+        # bounded acquire: recv(timeout=0) is a non-blocking poll, but a
+        # wedged chaos hook inside it must not hold _mu against push and
+        # shed forever — a starved pop returns None like an empty queue
+        if not self._mu.acquire(timeout=5.0):
+            return None
+        try:
+            try:
+                _meta, item = self._ep.recv(timeout=0.0, key="handoff")
+            except ChannelTimeout:
                 return None
-            item = self._q.popleft()
             self.popped += 1
             return item
+        finally:
+            self._mu.release()
 
     def shed_expired(self) -> List[HandoffItem]:
         """Deadline-aware: conclude every queued item whose request
@@ -145,11 +158,9 @@ class BlockHandoff:
         admission wait bounds it."""
         now = time.monotonic()
         with self._mu:
-            expired = [it for it in self._q if it.req.expired(now)]
-            if expired:
-                self._q = deque(it for it in self._q
-                                if not it.req.expired(now))
-                self.timed_out += len(expired)
+            expired = [it for _m, it in self._ep.purge(
+                lambda _meta, it: it.req.expired(now))]
+            self.timed_out += len(expired)
         for it in expired:
             self.pool.release(it.blocks)
             logger.warning("disagg: request %d shed from the handoff "

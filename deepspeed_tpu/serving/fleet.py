@@ -208,6 +208,11 @@ class ServingFleet:
         self.params = params
         self.scfg = serving
         self.fcfg = serving.fleet
+        if str(self.fcfg.placement) == "process":
+            raise ValueError(
+                "serving.fleet.placement='process' builds a ProcessFleet "
+                "(serving/procfleet.py) — construct one directly or go "
+                "through serving.make_fleet(...)")
         self.interpret = interpret
         # disaggregated roles (round 12, serving/disagg.py): prefill
         # replicas fill paged blocks and hand them — zero-copy, over ONE

@@ -156,6 +156,13 @@ FAILPOINTS: Dict[str, str] = {
     "serve.replica_slow": "fleet replica worker, injected delay",
     "serve.requeue": "fleet, in-flight requeue after replica death",
     "serve.oom": "KV block pool exhaustion",
+    "net.connect": "fabric endpoint, per dial attempt (initial + redial)",
+    "net.send": "fabric endpoint send, surfaced to the caller unretried",
+    "net.recv": "fabric endpoint recv, frame delivery to the caller",
+    "net.corrupt": "flag: fabric frame codec flips a payload bit on-wire",
+    "net.partition": "fabric link I/O, mid-stream loss driving the "
+                     "redial ladder",
+    "net.slow": "fabric endpoint send, injected link latency",
 }
 
 

@@ -1,0 +1,390 @@
+"""Transfer-fabric suite (round 18): one channel layer, one failure model.
+
+Proves the `runtime/fabric/` contract every transport now rides — the
+MPMD star, the disagg handoff, the process fleet:
+
+- wire format: length-prefixed JSON+bytes frames with a CRC32 trailer;
+  a flipped bit ANYWHERE raises ``FrameCorrupt`` (an ``OSError``) at
+  receipt, including one injected on-wire by the ``net.corrupt``
+  failpoint AFTER the trailer was computed;
+- generation fencing: data frames from a stale epoch are dropped at
+  receipt; control frames bypass the fence; a mid-stream welcome bumps
+  the receiver's generation;
+- bounded jittered reconnect: ``net.connect`` fires per dial attempt;
+  a mid-stream ``OSError`` (``net.partition``, peer reset) runs the
+  redial ladder and resumes with a FRESH generation from the hub's
+  welcome; exhausted attempts raise ``ChannelClosed``;
+- per-recv deadlines raise ``ChannelTimeout``; ``recv(timeout=0)`` is a
+  genuine poll — a frame already on the wire IS delivered (regression:
+  the serve loop drains commands between engine steps this way);
+- bounded write locks starve into ``WriteLockStarved`` instead of
+  wedging the caller on a peer stuck mid-read.
+
+Everything here is pure-socket/pure-thread — no JAX, no engines — so
+the whole file runs in a few seconds of tier-1 wall clock.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.runtime.fabric import (ChannelClosed, ChannelTimeout,
+                                          FrameCorrupt, HubConn,
+                                          LocalEndpoint, RedialPolicy,
+                                          SocketEndpoint, WriteLockStarved,
+                                          pack_frame, read_frame,
+                                          write_frame)
+from deepspeed_tpu.testing import chaos
+
+
+# --------------------------------------------------------------------------
+# frame codec
+
+
+def _pipe():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_frame_roundtrip():
+    a, b = _pipe()
+    try:
+        write_frame(a, {"cmd": "x", "seq": 7}, b"\x00\x01payload\xff")
+        meta, payload = read_frame(b)
+        assert meta == {"cmd": "x", "seq": 7}
+        assert payload == b"\x00\x01payload\xff"
+        write_frame(a, {"empty": True})          # zero-length payload leg
+        meta, payload = read_frame(b)
+        assert meta == {"empty": True} and payload == b""
+    finally:
+        a.close(); b.close()
+
+
+def test_frame_bitflip_is_peer_fatal():
+    raw = bytearray(pack_frame({"seq": 1}, b"abcdef"))
+    raw[-6] ^= 0x10                              # one bit, inside the payload
+    a, b = _pipe()
+    try:
+        a.sendall(bytes(raw))
+        with pytest.raises(FrameCorrupt) as ei:
+            read_frame(b)
+        assert isinstance(ei.value, OSError)     # callers treat it as a dead peer
+    finally:
+        a.close(); b.close()
+
+
+@pytest.mark.parametrize("payload", [b"block-bytes", b""])
+def test_net_corrupt_flips_on_wire(payload):
+    """net.corrupt injects AFTER the CRC is computed — proven caught at
+    the receiving end, not silently absorbed by a recomputed trailer."""
+    chaos.arm("net.corrupt", mode="flag")
+    a, b = _pipe()
+    try:
+        write_frame(a, {"seq": 1}, payload, key="spoke-0")
+        with pytest.raises(FrameCorrupt):
+            read_frame(b)
+    finally:
+        a.close(); b.close()
+
+
+def test_net_corrupt_respects_match_key():
+    chaos.arm("net.corrupt", mode="flag", match="spoke-1")
+    a, b = _pipe()
+    try:
+        write_frame(a, {"seq": 1}, b"x", key="spoke-0")   # other spoke: clean
+        assert read_frame(b)[1] == b"x"
+    finally:
+        a.close(); b.close()
+
+
+# --------------------------------------------------------------------------
+# local backend
+
+
+def test_local_fifo_and_nonblocking_poll():
+    ep = LocalEndpoint("loop")
+    for i in range(3):
+        ep.send({"seq": i}, i)
+    assert [ep.recv(timeout=0.0)[0]["seq"] for _ in range(3)] == [0, 1, 2]
+    with pytest.raises(ChannelTimeout):
+        ep.recv(timeout=0.0)                     # empty queue, surfaced now
+    ep.close()
+    with pytest.raises(ChannelClosed):
+        ep.recv(timeout=0.0)
+
+
+def test_local_fence_drops_stale_data_keeps_control():
+    ep = LocalEndpoint("loop", fence=True)
+    ep.send({"seq": "stale"})                    # stamped gen=0
+    ep.send({"cmd": "park"})                     # control: bypasses the fence
+    ep.generation = 1                            # epoch bump (resync)
+    ep.send({"seq": "fresh"})                    # stamped gen=1
+    metas = [ep.recv(timeout=0.0)[0] for _ in range(2)]
+    assert [m.get("cmd", m.get("seq")) for m in metas] == ["park", "fresh"]
+    with pytest.raises(ChannelTimeout):
+        ep.recv(timeout=0.0)                     # the stale frame is GONE
+
+
+def test_local_chaos_surface():
+    ep = LocalEndpoint("loop")
+    chaos.arm("net.send")
+    with pytest.raises(chaos.ChaosError):
+        ep.send({"seq": 0})
+    chaos.disarm()
+    ep.send({"seq": 0})
+    chaos.arm("net.recv")
+    with pytest.raises(chaos.ChaosError):
+        ep.recv(timeout=0.0)
+
+
+# --------------------------------------------------------------------------
+# socket backend — a minimal hub (per-ident epochs, recorded frames)
+
+
+class MiniHub:
+    def __init__(self):
+        self.srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(8)
+        self.addr = self.srv.getsockname()
+        self.epochs = {}
+        self.conns = {}
+        self.frames = []
+        self.hellos = []
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while not self._stop.is_set():
+            try:
+                sock, _ = self.srv.accept()
+            except OSError:
+                return
+            if self._stop.is_set():              # raced close(): a blocked
+                sock.close()                     # accept holds the fd alive
+                continue
+            threading.Thread(target=self._serve, args=(sock,),
+                             daemon=True).start()
+
+    def _serve(self, sock):
+        try:
+            hello, _ = read_frame(sock)
+        except OSError:
+            sock.close()
+            return
+        ident = hello.get("ident", "?")
+        with self._mu:
+            self.hellos.append(hello)
+            self.epochs[ident] = self.epochs.get(ident, 0) + 1
+            conn = HubConn(sock, ident, gen=self.epochs[ident])
+            self.conns[ident] = conn
+        conn.welcome()
+        while True:
+            try:
+                meta, payload = read_frame(sock)
+            except OSError:
+                break
+            with self._mu:
+                self.frames.append((ident, meta, payload))
+        conn.close()
+
+    @staticmethod
+    def _sever(conn):
+        # shutdown first: close() alone leaves a reader blocked in recv
+        # holding the fd, and no FIN/RST ever reaches the spoke
+        try:
+            conn.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        conn.close()
+
+    def conn(self, ident, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._mu:
+                if ident in self.conns:
+                    return self.conns[ident]
+            time.sleep(0.01)
+        raise AssertionError(f"no hub connection for {ident}")
+
+    def drop(self, ident):
+        self._sever(self.conn(ident))
+
+    def wait_frames(self, n, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._mu:
+                if len(self.frames) >= n:
+                    return list(self.frames)
+            time.sleep(0.01)
+        with self._mu:
+            return list(self.frames)
+
+    def close(self):
+        self._stop.set()
+        try:
+            self.srv.shutdown(socket.SHUT_RDWR)  # wake a blocked accept
+        except OSError:
+            pass
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+        with self._mu:
+            conns = list(self.conns.values())
+        for c in conns:
+            self._sever(c)
+
+
+@pytest.fixture()
+def hub():
+    h = MiniHub()
+    yield h
+    h.close()
+
+
+def _spoke(hub, ident="spoke-0", **kw):
+    kw.setdefault("redial", RedialPolicy(attempts=3, base=0.01, cap=0.05,
+                                         dial_timeout=2.0))
+    return SocketEndpoint(tuple(hub.addr), ident, connect_timeout=5.0, **kw)
+
+
+def test_dial_retries_through_net_connect(hub):
+    chaos.arm("net.connect", times=2)            # first two dials refused
+    ep = _spoke(hub)
+    try:
+        assert ep.generation == 1                # handed out by the welcome
+        assert len(chaos.fired("net.connect")) == 2
+        ep.send({"seq": 0}, b"ok")
+        ident, meta, payload = hub.wait_frames(1)[0]
+        assert (ident, payload) == ("spoke-0", b"ok")
+        assert meta["gen"] == 1                  # frames stamped with the epoch
+    finally:
+        ep.close()
+
+
+def test_recv_deadline_raises_channel_timeout(hub):
+    ep = _spoke(hub)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(ChannelTimeout):
+            ep.recv(timeout=0.2)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        ep.close()
+
+
+def test_recv_zero_timeout_delivers_inflight_frame(hub):
+    """Regression: timeout=0 is a POLL, not a no-op — a frame already on
+    the wire must come out (the serve loop drains commands this way)."""
+    ep = _spoke(hub)
+    try:
+        hub.conn("spoke-0").send({"cmd": "serve", "rid": 7})
+        deadline = time.monotonic() + 2.0
+        while True:
+            try:
+                meta, _ = ep.recv(timeout=0.0)   # never a positive timeout
+                break
+            except ChannelTimeout:
+                assert time.monotonic() < deadline, \
+                    "in-flight frame never delivered via timeout=0 poll"
+                time.sleep(0.01)
+        assert meta["rid"] == 7
+    finally:
+        ep.close()
+
+
+def test_partition_redials_into_fresh_generation(hub):
+    """net.partition mid-send runs the redial ladder; the re-sent frame
+    carries the NEW generation (the maybe-delivered original is fenced)."""
+    chaos.arm("net.partition", times=1)
+    ep = _spoke(hub)
+    try:
+        assert ep.generation == 1
+        ep.send({"seq": 0}, b"after-heal")
+        assert ep.generation == 2                # fresh epoch from re-welcome
+        frames = hub.wait_frames(1)
+        assert frames[-1][1]["gen"] == 2
+        assert len(hub.hellos) == 2              # one redial happened
+    finally:
+        ep.close()
+
+
+def test_hub_restart_spoke_redials_new_generation(hub):
+    """A dropped hub connection (restarted peer) is NOT death: the spoke
+    re-dials into a fresh epoch and traffic resumes."""
+    ep = _spoke(hub)
+    try:
+        ep.send({"seq": 0})
+        hub.wait_frames(1)
+        hub.drop("spoke-0")
+        # TCP may buffer one send into the dead socket; keep sending until
+        # a frame lands on the NEW epoch
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            ep.send({"seq": 1})
+            if any(m["gen"] == 2 for _, m, _ in hub.wait_frames(2, 0.2)):
+                break
+            time.sleep(0.05)
+        assert ep.generation == 2
+        assert any(m["gen"] == 2 for _, m, _ in hub.wait_frames(2))
+    finally:
+        ep.close()
+
+
+def test_stale_generation_frame_dropped_at_receipt(hub):
+    ep = _spoke(hub)
+    try:
+        conn = hub.conn("spoke-0")
+        conn.send({"seq": "stale", "gen": 0})    # from a dead epoch
+        conn.send({"seq": "fresh", "gen": 1})
+        meta, _ = ep.recv(timeout=2.0)
+        assert meta["seq"] == "fresh"            # the stale frame never surfaced
+    finally:
+        ep.close()
+
+
+def test_midstream_welcome_bumps_generation(hub):
+    ep = _spoke(hub)
+    try:
+        conn = hub.conn("spoke-0")
+        conn.send({"cmd": "welcome", "gen": 5})  # hub-side epoch bump
+        conn.send({"seq": 1, "gen": 5})
+        meta, _ = ep.recv(timeout=2.0)
+        assert meta["seq"] == 1 and ep.generation == 5
+    finally:
+        ep.close()
+
+
+def test_write_lock_starved_is_oserror_not_wedge(hub):
+    ep = _spoke(hub)
+    try:
+        assert ep._wlock.acquire(timeout=1.0)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(WriteLockStarved) as ei:
+                ep.send({"seq": 0}, lock_timeout=0.1)
+            assert isinstance(ei.value, OSError)
+            assert time.monotonic() - t0 < 2.0
+            assert ep.generation == 1            # starvation never redials
+        finally:
+            ep._wlock.release()
+    finally:
+        ep.close()
+
+
+def test_redial_exhaustion_raises_channel_closed(hub):
+    ep = _spoke(hub, redial=RedialPolicy(attempts=1, base=0.01,
+                                         dial_timeout=0.3))
+    hub.close()                                  # the hub is GONE, not restarting
+    with pytest.raises(ChannelClosed):
+        for _ in range(20):
+            ep.send({"seq": 0}, b"x" * 4096)
+            time.sleep(0.02)
+    ep.close()

@@ -626,19 +626,31 @@ def test_stageconn_send_raises_when_write_lock_starved():
 
 
 def test_socket_channel_send_raises_when_write_lock_starved():
-    """Same contract on the worker side: the frame lock is bounded, and
+    """Same contract on the worker side: the frame lock — now owned by
+    the fabric endpoint the channel rides (round 18) — is bounded, and
     starvation surfaces as the OSError a dead driver socket raises."""
     import socket
     import threading as _th
+    from collections import deque
+
+    from deepspeed_tpu.runtime.fabric import SocketEndpoint
     from deepspeed_tpu.runtime.pipe.mpmd.channel import SocketChannel
 
     a, b = socket.socketpair()
     try:
+        ep = SocketEndpoint.__new__(SocketEndpoint)
+        ep.ident = "stage-0"
+        ep._sock = a
+        ep._wlock = _th.Lock()
+        ep._redial = None
+        ep._closed = False
+        ep.generation = 0
         ch = SocketChannel.__new__(SocketChannel)
-        ch._sock = a
-        ch._lock = _th.Lock()
-        ch.generation = 0
-        ch._lock.acquire()
+        ch.stage = 0
+        ch._ep = ep
+        ch._data = {}
+        ch._control = deque()
+        ep._wlock.acquire()
         try:
             with pytest.raises(OSError, match="starved"):
                 ch.send_control({"cmd": "parked"}, lock_timeout=0.05)
@@ -646,7 +658,7 @@ def test_socket_channel_send_raises_when_write_lock_starved():
                 ch.send("act", 0, 1, 0, np.zeros(2, np.float32),
                         lock_timeout=0.05)
         finally:
-            ch._lock.release()
+            ep._wlock.release()
         ch.send_control({"cmd": "parked"}, lock_timeout=0.05)
     finally:
         a.close()
