@@ -962,7 +962,7 @@ class UnboundedBlockingRule(Rule):
     _MODULES = ("supervisor.py", "watchdog.py", "fleet.py",
                 "elastic_agent.py", "straggler.py", "driver.py",
                 "endpoint.py", "sockets.py", "local.py",
-                "procfleet.py", "replica_worker.py")
+                "procfleet.py", "replica_worker.py", "autoscale.py")
     _LOCKISH = re.compile(r"lock|mutex|sem", re.I)
     _EVENTISH = re.compile(r"evt|event|done|stop|ready|cond|barrier|sig",
                            re.I)

@@ -574,6 +574,175 @@ def run_poisson_fleet_proc(preset: str, rate: float, num_requests: int,
     return row
 
 
+def parse_trace(spec: str) -> List[Tuple[float, float]]:
+    """``--trace`` spec -> [(rate_req_per_s, duration_s), ...]. The
+    format is comma-separated ``rate@seconds`` segments, e.g.
+    ``0.5@10,1.5@10,0.5@10`` — a 3x burst framed by the base rate —
+    driven open-loop as piecewise-Poisson arrivals."""
+    segs = []
+    for part in spec.split(","):
+        rate, dur = part.split("@")
+        segs.append((float(rate), float(dur)))
+    if not segs:
+        raise ValueError(f"--trace {spec!r}: no segments")
+    return segs
+
+
+def trace_arrivals(segs: List[Tuple[float, float]], rng) -> List[float]:
+    """Piecewise-Poisson arrival times over the trace segments."""
+    arrivals, start = [], 0.0
+    for rate, dur in segs:
+        t, end = start, start + dur
+        while True:
+            t += float(rng.exponential(1.0 / rate))
+            if t >= end:
+                break
+            arrivals.append(t)
+        start = end
+    return arrivals
+
+
+#: deterministic tier mix for the autoscale leg: mostly standard, a
+#: latency request (tight-deadline SLO traffic) and a batch request
+#: (deferrable backfill) interleaved — enough of each for per-tier p99
+_TIER_CYCLE = ("standard", "latency", "standard", "batch", "standard")
+
+
+def run_poisson_autoscale(preset: str, trace: List[Tuple[float, float]],
+                          prompt_len: int, new_tokens: int,
+                          serving: Optional[dict] = None, seed: int = 0,
+                          max_replicas: int = 3,
+                          model_kwargs: Optional[dict] = None) -> dict:
+    """Bursty piecewise-Poisson load against the AUTOSCALING fleet
+    (round 19): the fleet starts at ``min_replicas=1``, the trace's
+    burst segment pushes queue depth over the scale-up trigger, the
+    supervisor spawns warmed replicas up to ``max_replicas``, and the
+    post-burst idle trough drains them back down. Requests carry mixed
+    priority tiers (``_TIER_CYCLE``), so the row reports per-tier p99 —
+    the traffic-shaping number: latency-tier p99 should survive the
+    burst that batch-tier p99 absorbs. Machine-readable row::
+
+        inference_bench poisson_autoscale: {"trace": "...", "scale_ups":
+            ..., "scale_downs": ..., "p99_by_tier": {...}, ...}
+
+    ``clean_drain`` asserts the conclusion: every request concluded,
+    every scale-down's drain completed (``drained_ts`` stamped), and
+    the fleet ended back at its floor."""
+    from ..models import build_model
+    from ..serving.fleet import ServingFleet
+    model, cfg = build_model(preset, max_seq_len=prompt_len + new_tokens,
+                             **(model_kwargs or {}))
+    rng = np.random.default_rng(seed)
+    ids0 = rng.integers(0, cfg.vocab_size, (1, prompt_len))
+    # one-shot bench setup: init compiles once before the timed region
+    # graftlint: disable=TPU002
+    params = jax.jit(lambda r: model.init(r, {"input_ids": ids0})
+                     ["params"])(jax.random.PRNGKey(0))
+    scfg = dict(serving or {})
+    fleet_cfg = dict(scfg.pop("fleet", {}))
+    fleet_cfg.setdefault("replicas", 1)
+    fleet_cfg.setdefault("poll_interval", 0.05)
+    fleet_cfg.setdefault("heartbeat_interval", 0.05)
+    # a warm scale-up compile on CPU can starve sibling heartbeats for
+    # tens of seconds (GIL-bound tracing) — the bench measures traffic
+    # shaping, not silence detection (run_poisson_fleet's convention)
+    fleet_cfg.setdefault("heartbeat_timeout", 300.0)
+    # aging short enough that a queued batch request can still promote
+    # within the bench window (the starvation floor, observable)
+    fleet_cfg.setdefault("priority_aging_s", 30.0)
+    fleet_cfg.setdefault("autoscale", {
+        "enabled": True, "min_replicas": 1, "max_replicas": max_replicas,
+        "up_queue_per_replica": 2, "up_after": 2,
+        "down_idle_s": 1.0, "cooldown_s": 2.0})
+    scfg["fleet"] = fleet_cfg
+    flt = ServingFleet(cfg, params, serving=scfg)
+    flt.start()
+    flt.warmup(prompt=list(rng.integers(1, cfg.vocab_size,
+                                        size=prompt_len)))
+    base = dict(flt.stats)
+
+    arrivals = trace_arrivals(trace, rng)
+    n = len(arrivals)
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=prompt_len))
+               for _ in range(n)]
+    tiers = [_TIER_CYCLE[i % len(_TIER_CYCLE)] for i in range(n)]
+    trace_end = sum(d for _, d in trace)
+    t0 = time.perf_counter()
+    t0_mono = time.monotonic()
+    reqs: List = []
+    next_i = 0
+    max_live = len(flt.live_replicas())
+    while True:
+        now = time.perf_counter() - t0
+        while next_i < n and arrivals[next_i] <= now:
+            reqs.append(flt.submit(
+                prompts[next_i], new_tokens, priority=tiers[next_i]))
+            next_i += 1
+        max_live = max(max_live, len(flt.live_replicas()))
+        if next_i >= n and all(r.done for r in reqs):
+            break
+        time.sleep(0.005)
+    wall = time.perf_counter() - t0
+    # the idle tail: give the trough trigger its down_idle_s + cooldown
+    # so the row records the drain-down, not just the spawn-up
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        ups = sum(1 for e in flt.scale_events if e.action == "up")
+        downs = [e for e in flt.scale_events if e.action == "down"]
+        if ups and downs and all(e.drained_ts for e in downs) \
+                and len(flt.live_replicas()) <= max(
+                    1, int(fleet_cfg["autoscale"]["min_replicas"])):
+            break
+        time.sleep(0.05)
+
+    lat_by_tier: Dict[str, List[float]] = {}
+    for r, arr in zip(reqs, arrivals):
+        if r.finish_ts:
+            lat_by_tier.setdefault(r.priority, []).append(
+                r.finish_ts - (t0_mono + arr))
+    p99 = {t: round(float(np.percentile(v, 99)), 4)
+           for t, v in sorted(lat_by_tier.items())}
+    downs = [e for e in flt.scale_events if e.action == "down"]
+    clean_drain = (all(r.done for r in reqs)
+                   and all(e.drained_ts is not None for e in downs))
+    n_chips = jax.device_count()
+    row = {
+        "mode": "poisson_autoscale",
+        "preset": preset,
+        "trace": ",".join(f"{r:g}@{d:g}" for r, d in trace),
+        "rate": trace[0][0],            # regression key: the base rate
+        "burst_rate": max(r for r, _ in trace),
+        "requests": n, "prompt": prompt_len, "new_tokens": new_tokens,
+        "trace_s": round(trace_end, 1), "wall_s": round(wall, 3),
+        "p50_s": round(float(np.percentile(
+            [v for vs in lat_by_tier.values() for v in vs], 50)), 4),
+        "p99_s": round(float(np.percentile(
+            [v for vs in lat_by_tier.values() for v in vs], 99)), 4),
+        "p99_by_tier": p99,
+        "tokens_per_s": round(n * new_tokens / wall, 1),
+        "replicas_floor": int(fleet_cfg["replicas"]),
+        "max_replicas": max_replicas, "max_live": max_live,
+        "scale_ups": flt.stats["scale_ups"] - base["scale_ups"],
+        "scale_downs": flt.stats["scale_downs"] - base["scale_downs"],
+        "scale_events": [
+            {"action": e.action, "replica": e.replica,
+             "reason": e.reason, "t_s": round(e.ts - t0_mono, 3),
+             "drained_t_s": (round(e.drained_ts - t0_mono, 3)
+                             if e.drained_ts else None)}
+            for e in flt.scale_events],
+        "shed": flt.stats["shed"] - base["shed"],
+        "preempted": flt.stats["preempted"] - base["preempted"],
+        "completed": flt.stats["completed"] - base["completed"],
+        "failed": flt.stats["failed"] - base["failed"],
+        "timeout": flt.stats["timeout"] - base["timeout"],
+        "clean_drain": bool(clean_drain),
+        "n_chips": n_chips,
+    }
+    flt.close()
+    print("inference_bench poisson_autoscale: " + json.dumps(row))
+    return row
+
+
 def record_serve_bench(rows: List[Dict], path: str) -> str:
     """Write serving-bench rows in the SERVEBENCH report shape (the
     comm-sweep convention: ``{"n": device_count, "rows": [...]}`` so
@@ -699,6 +868,15 @@ def main(argv=None):
                         "tps_before/during/after + drain/recovery stamps")
     p.add_argument("--slow-ms", type=int, default=250,
                    help="--slow-replica: injected per-iteration delay")
+    p.add_argument("--trace", default="",
+                   help="with --poisson: bursty piecewise-Poisson trace "
+                        "as rate@seconds segments (e.g. 0.5@10,1.5@10,"
+                        "0.5@10 — a 3x burst) against the AUTOSCALING "
+                        "fleet with mixed priority tiers; prints the "
+                        "poisson_autoscale row (scale events, per-tier "
+                        "p99, clean drain)")
+    p.add_argument("--max-replicas", type=int, default=3,
+                   help="--trace: autoscaler ceiling (floor is 1)")
     p.add_argument("--chunk", type=int, default=0,
                    help="serving.prefill_chunk_tokens for the poisson "
                         "legs (0 = whole prefill)")
@@ -736,7 +914,13 @@ def main(argv=None):
             serving["weight_dtype"] = args.weight_dtype
         serving = serving or None
         rows = []
-        for rate in (float(x) for x in args.rates.split(",")):
+        if args.trace:
+            rows.append(run_poisson_autoscale(
+                args.preset, parse_trace(args.trace), args.prompt,
+                args.new, serving=serving,
+                max_replicas=args.max_replicas))
+        for rate in ((float(x) for x in args.rates.split(","))
+                     if not args.trace else ()):
             if args.fleet > 1 and args.placement == "process":
                 rows.append(run_poisson_fleet_proc(
                     args.preset, rate, args.requests, args.prompt,

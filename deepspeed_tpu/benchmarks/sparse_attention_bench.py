@@ -35,7 +35,7 @@ def _timed(attn_fn, q, k, v, iters=20):
                 qq = q.at[0, 0, 0, 0].add(carry.astype(q.dtype))
                 o = attn_fn(qq, k, v)
                 return o[0, 0, 0, 0].astype(jnp.float32)
-            return lax.fori_loop(0, n, body, jnp.float32(0))
+            return lax.fori_loop(0, n, body, jnp.zeros((), jnp.float32))
         f = jax.jit(run)
         np.asarray(f(q, k, v))              # compile + warm; fetch = fence
         t0 = time.perf_counter()

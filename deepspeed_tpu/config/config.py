@@ -432,6 +432,34 @@ class WatchdogConfig(DeepSpeedConfigModel):
     serve_timeout: float = 0.0    # SERVE: serving-loop iteration gap; 0 = off
 
 
+class AutoscaleConfig(DeepSpeedConfigModel):
+    """TPU-native (round 19): traffic-shaped replica autoscaling
+    (``serving/autoscale.py``, docs/SERVING.md §Autoscaling). With
+    ``enabled`` the FleetSupervisor/ProcessFleet feed their own SERVE
+    heartbeat gauges (queue depth, active lanes, deadline pressure)
+    through an AutoscalePolicy each poll: ``up_after`` consecutive
+    overloaded observations (queue deeper than ``up_queue_per_replica``
+    per live replica, or any queued request within ``pressure_s`` of its
+    deadline) spawn ONE warmed replica; a trough of ``down_idle_s``
+    seconds with an empty queue drains the newest replica through the
+    straggler-drain path (admission stops, lanes finish, then teardown
+    — never a mid-lane kill). ``cooldown_s`` debounces both directions
+    so a single burst cannot flap, and no verdict at all is issued
+    while a replica is still warming (its silence is compile, not
+    idleness). Bounds: ``min_replicas`` <= live <= ``max_replicas``.
+    Every scale event lands in the heartbeat channel (`dstpu health`
+    rank 999) and in ``fleet.scale_events``."""
+    enabled: bool = False
+    min_replicas: int = 1              # scale-down floor
+    max_replicas: int = 4              # scale-up ceiling
+    up_queue_per_replica: int = 4      # queue depth per live replica = overload
+    pressure_s: float = 0.0            # queued-TTL window that reads as
+    #                                    deadline pressure; 0 = off
+    up_after: int = 2                  # consecutive overloaded polls -> up
+    down_idle_s: float = 10.0          # idle-trough duration -> down
+    cooldown_s: float = 15.0           # min seconds between scale events
+
+
 class FleetConfig(DeepSpeedConfigModel):
     """TPU-native (round 11): the supervised multi-replica serving fleet
     (``serving/fleet.py``, docs/SERVING.md §Fleet). With ``replicas > 1``
@@ -481,6 +509,25 @@ class FleetConfig(DeepSpeedConfigModel):
     max_queue: int = 4096              # shared admission queue bound
     default_deadline_s: float = 0.0    # queue-wait TTL; 0 = none
     heartbeat_dir: Optional[str] = None  # None = private tempdir
+    # priority lanes (round 19, serving/scheduler.py TieredQueue):
+    # submit(priority=) picks latency/standard/batch; dispatch serves
+    # the highest tier first, FIFO within a tier, and a head that has
+    # waited longer than ``priority_aging_s`` is served regardless of
+    # tier (the starvation floor). A latency-tier request within
+    # ``preempt_pressure_s`` of its deadline (or waiting past it with
+    # no deadline set) may PREEMPT a running batch-tier lane: the
+    # victim requeues through the exactly-once token-exact path
+    # (emitted prefix carried, no retry_budget charge). 0 disables
+    # preemption. ``batch_highwater`` is the admission ladder's soft
+    # rung: once the queue is past this fraction of max_queue, new
+    # batch-tier submissions get a machine-readable AdmissionRejected
+    # instead of deepening the backlog — saturation degrades batch
+    # before latency.
+    priority_aging_s: float = 30.0     # tier starvation floor (seconds)
+    preempt_pressure_s: float = 0.0    # latency deadline slack -> preempt;
+    #                                    0 = preemption off
+    batch_highwater: float = 0.9       # queue fraction that sheds batch tier
+    autoscale: AutoscaleConfig = Field(default_factory=AutoscaleConfig)
     # straggler drain (round 15, runtime/straggler.py): with
     # straggler.enabled the FleetSupervisor runs the cross-rank
     # relative-slowness detector over the replicas' step_ms SERVE gauges

@@ -286,7 +286,11 @@ class InferenceEngine:
                 f"{scfg.fleet.decode_replicas})")
         disagg = (scfg.fleet.prefill_replicas > 0
                   and scfg.fleet.decode_replicas > 0)
+        # autoscale.enabled forces the fleet even at replicas=1 — a
+        # floor-1 autoscaling fleet IS the replicas=1 case, and the
+        # single-engine path has no supervisor to grow it
         if (scfg.fleet.replicas > 1 or disagg
+                or scfg.fleet.autoscale.enabled
                 or str(scfg.fleet.placement) == "process"):
             from ..serving.procfleet import make_fleet
             from ..utils.logging import logger

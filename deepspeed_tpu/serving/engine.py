@@ -45,7 +45,8 @@ from ..testing import chaos
 from ..utils.logging import log_dist, logger
 from .kv_cache import (NULL_BLOCK, BlockPoolExhausted, SharedPagedState)
 from .model_runner import paged_forward
-from .scheduler import (FAILED, FINISHED, PREFILL, QUEUED, RUNNING, TIMEOUT,
+from .scheduler import (BATCH, FAILED, FINISHED, PREFILL, PRIORITY_TIERS,
+                        QUEUED, RUNNING, STANDARD, TIER_RANK, TIMEOUT,
                         Request, Scheduler)
 
 PyTree = Any
@@ -192,7 +193,10 @@ class ServingEngine:
         self._shared = shared if shared is not None else SharedPagedState(
             cfg, serving, dtype=kv_dtype)
         self.scheduler = Scheduler(self.pool, serving.max_queue,
-                                   self.max_model_len, self.prefix_cache)
+                                   self.max_model_len, self.prefix_cache,
+                                   aging_s=serving.fleet.priority_aging_s,
+                                   batch_highwater=serving.fleet
+                                   .batch_highwater)
         self._slots: List[Optional[_Seq]] = [None] * self.max_batch
         self._prefilling: Optional[_Prefilling] = None
         self._warming = False      # role warms: no prefix-cache inserts
@@ -207,7 +211,7 @@ class ServingEngine:
         self.stats: Dict[str, int] = {
             "completed": 0, "failed": 0, "timeout": 0,
             "tokens_generated": 0, "prefill_tokens": 0,
-            "prefix_hit_tokens": 0}
+            "prefix_hit_tokens": 0, "preempted": 0}
 
         # ---- compiled programs (fixed shapes; ONE decode specialization) ----
         use_filters = self._use_filters
@@ -279,13 +283,17 @@ class ServingEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                temperature: float = 0.0, eos_token_id: Optional[int] = None,
                on_finish=None, top_k=None, top_p=None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               priority: str = STANDARD) -> Request:
         """Enqueue a generation request (thread-safe); returns the live
         :class:`Request` whose ``output_tokens``/``state`` the caller (or
         ``on_finish``) observes. ``deadline_s`` is a queue-wait TTL: a
         request still QUEUED that long after arrival is shed with a
         TIMEOUT result instead of waiting behind a too-big head forever
-        (admitted requests always run to completion).
+        (admitted requests always run to completion). ``priority``
+        (round 19) picks the latency/standard/batch tier — dispatch
+        order and the overload ladder's shed order; see
+        docs/SERVING.md §Priority.
 
         ``top_k``/``top_p`` (round 12) require
         ``serving.sampling_filters`` — the vectorized per-lane filter
@@ -299,12 +307,16 @@ class ServingEngine:
                 "(the nucleus filter adds a [B, V] sort to the compiled "
                 "decode step); without it use greedy/temperature or "
                 "one-shot generate()")
+        if priority not in TIER_RANK:
+            raise ValueError(f"unknown priority tier {priority!r}; pick "
+                             f"one of {PRIORITY_TIERS}")
         req = Request(prompt=[int(t) for t in prompt],
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature),
                       top_k=int(top_k) if top_k is not None else None,
                       top_p=float(top_p) if top_p is not None else None,
-                      eos_token_id=eos_token_id, on_finish=on_finish)
+                      eos_token_id=eos_token_id, on_finish=on_finish,
+                      priority=priority)
         if deadline_s is not None:
             req.deadline_ts = req.arrival_ts + float(deadline_s)
         return self.scheduler.submit(req)
@@ -363,6 +375,41 @@ class ServingEngine:
     def _collect_held(self, blocks, reqs) -> None:
         """Subclass hook: detach role-specific block holders (runs under
         the engine lock inside :meth:`held_state`)."""
+
+    def preempt_request(self, req: Request, timeout: float = 1.0) -> bool:
+        """Evict ONE running decode lane mid-generation (round 19 tier
+        preemption): under the engine lock the lane's blocks return to
+        the pool, the slot frees, and the request reverts to QUEUED with
+        its emitted tokens intact — the fleet's exactly-once requeue
+        path resumes it from prompt + emitted, exactly the death-path
+        contract (tokens decoded but never synced are dropped and
+        regenerated identically under greedy). Only a RUNNING lane is
+        preemptible: an in-flight prefill is about to finish paying for
+        its blocks and evicting it frees no lane. Returns False when the
+        request holds no lane here or the lock cannot be taken within
+        ``timeout`` (a step in flight — the caller retries next poll)."""
+        if not self._lock.acquire(timeout=timeout):
+            return False
+        try:
+            for i, s in enumerate(self._slots):
+                if s is not None and s.req is req:
+                    self._slots[i] = None
+                    self.pool.release(s.blocks)
+                    req.state = QUEUED
+                    self.stats["preempted"] += 1
+                    return True
+            return False
+        finally:
+            self._lock.release()
+
+    def cancel_request(self, req: Request, timeout: float = 1.0) -> bool:
+        """Withdraw a request wholesale (the process fleet's ``cancel``
+        command): drop it from the scheduler queue if still queued, else
+        evict its running lane. Never concludes the request — the hub
+        owns its ledger and requeues it elsewhere."""
+        if self.scheduler.withdraw(req):
+            return True
+        return self.preempt_request(req, timeout=timeout)
 
     def step(self) -> int:
         """One loop iteration: admit (whole prefill, or START a chunked

@@ -71,10 +71,10 @@ in-process replicas, documented in docs/SERVING.md).
 
 from __future__ import annotations
 
+import json
 import tempfile
 import threading
 import time
-from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -83,15 +83,20 @@ from ..runtime.straggler import (STEP_MS_GAUGE, STRAGGLER_FLAG, StepClock,
                                  StragglerDetector)
 from ..testing import chaos
 from ..utils.logging import log_dist, logger
+from .autoscale import (AUTOSCALER_RANK, SCALE_DOWN, SCALE_UP,
+                        AutoscalePolicy, Observation, ScaleEvent)
 from .engine import ServingEngine, resolve_kv_dtype
 from .kv_cache import SharedPagedState
-from .scheduler import (FAILED, FINISHED, QUEUED, RUNNING, TIMEOUT,
-                        check_admissible)
+from .scheduler import (BATCH, FAILED, FINISHED, LATENCY, PRIORITY_TIERS,
+                        QUEUED, RUNNING, SHED, STANDARD, TIER_RANK, TIMEOUT,
+                        TieredQueue, admit_or_shed, check_admissible)
 
 PyTree = Any
 
-#: replica lifecycle states
-LIVE, DOWN, BLACKLISTED = "LIVE", "DOWN", "BLACKLISTED"
+#: replica lifecycle states. RETIRED (round 19) concludes a scale-down
+#: drain: the replica finished its lanes and left cleanly (EXIT stamp) —
+#: unlike DOWN it is not a failure and earns no strike.
+LIVE, DOWN, BLACKLISTED, RETIRED = "LIVE", "DOWN", "BLACKLISTED", "RETIRED"
 
 
 @dataclass
@@ -111,9 +116,15 @@ class FleetRequest:
     on_token: Optional[Callable[["FleetRequest", int], None]] = None
     on_finish: Optional[Callable[["FleetRequest"], None]] = None
     rid: int = 0
+    #: priority tier (round 19): latency | standard | batch — dispatch
+    #: order, the overload ladder's shed order, and preemption standing
+    priority: str = STANDARD
     state: str = QUEUED
     output_tokens: List[int] = field(default_factory=list)
     retries: int = 0
+    #: times a deadline-pressured latency request evicted this one's
+    #: lane (requeued token-exact; does NOT charge the retry budget)
+    preemptions: int = 0
     replica: Optional[int] = None      # current / last assignment
     #: disagg: prompt tokens the last (possibly dead) prefill leg got
     #: into the pool — requeue carries it for the death ledger
@@ -126,7 +137,7 @@ class FleetRequest:
 
     @property
     def done(self) -> bool:
-        return self.state in (FINISHED, FAILED, TIMEOUT)
+        return self.state in (FINISHED, FAILED, TIMEOUT, SHED)
 
     @property
     def remaining(self) -> int:
@@ -172,6 +183,11 @@ class _Replica:
         self.strikes = strikes
         self.state = LIVE
         self.warming = False           # silence-exempt during warmup()
+        #: scale-down in flight (round 19): dispatch skips a draining
+        #: replica; its lanes finish, then the supervisor RETIREs it.
+        #: State stays LIVE so death supervision still covers the drain
+        #: window — a draining replica that dies requeues exactly-once.
+        self.draining = False
         self.step_clock = StepClock()  # rolling per-iteration wall gauge
         self.engine: Optional[ServingEngine] = None
         self.thread: Optional[threading.Thread] = None
@@ -253,9 +269,24 @@ class ServingFleet:
             self.n_replicas = max(1, int(self.fcfg.replicas))
             self._shared = None
             self._handoff = None
+        # traffic-shaped autoscaling (round 19, serving/autoscale.py):
+        # plain replicas only — disagg role counts are a placement
+        # decision the queue-depth trigger cannot make
+        self.autoscale: Optional[AutoscalePolicy] = None
+        if self.fcfg.autoscale.enabled:
+            if self.disagg:
+                raise ValueError(
+                    "serving.fleet.autoscale does not apply to "
+                    "disaggregated fleets (role counts are a placement "
+                    "decision) — unset prefill/decode_replicas")
+            self.autoscale = AutoscalePolicy(self.fcfg.autoscale)
+            self.n_replicas = min(max(self.n_replicas,
+                                      self.autoscale.min_replicas),
+                                  self.autoscale.max_replicas)
         self.heartbeat_dir = (heartbeat_dir or self.fcfg.heartbeat_dir
                               or tempfile.mkdtemp(prefix="dstpu-fleet-hb-"))
-        self._queue: deque = deque()             # guarded by _qlock
+        self._queue = TieredQueue(                # guarded by _qlock
+            aging_s=float(self.fcfg.priority_aging_s))
         self._qlock = threading.Lock()
         self._stats_lock = threading.Lock()      # counters bumped from N
         #                                          workers + supervisor
@@ -274,10 +305,17 @@ class ServingFleet:
         #: heartbeat record), strikes, detected_ts, action,
         #: restarted_ts} — the attribution trail tests and the bench read
         self.deaths: List[dict] = []
+        #: capacity ledger (round 19), the death-ledger idiom applied to
+        #: scale events: every autoscaler verdict (up / up_failed / down)
+        #: with its trigger, timestamps and queue/live evidence — what
+        #: the bench records and the autoscaler heartbeat rank mirrors
+        self.scale_events: List[ScaleEvent] = []
+        self._as_writer: Optional[hb.HeartbeatWriter] = None
         self.stats: Dict[str, int] = {
             "submitted": 0, "completed": 0, "failed": 0, "timeout": 0,
             "requeues": 0, "deaths": 0, "restarts": 0, "paroles": 0,
-            "blacklisted": 0, "tokens_emitted": 0}
+            "blacklisted": 0, "tokens_emitted": 0, "shed": 0,
+            "preempted": 0, "scale_ups": 0, "scale_downs": 0}
         # run-scoped channel: stale records from a previous fleet in a
         # reused dir must not trip silence at t=0 (PR-6 contract)
         hb.clear_channel(self.heartbeat_dir)
@@ -295,6 +333,17 @@ class ServingFleet:
         self._started = True
         for rep in self._replicas:
             self._launch(rep)
+        if self.autoscale is not None:
+            # the autoscaler's own heartbeat rank: scale events are
+            # operator evidence in the SAME channel `dstpu health`
+            # reads; refreshed every supervisor poll so the record
+            # never reads as silent while the fleet is supervised
+            self._as_writer = hb.HeartbeatWriter(
+                self.heartbeat_dir, rank=AUTOSCALER_RANK,
+                host="autoscaler",
+                min_interval=float(self.fcfg.heartbeat_interval),
+                refresh_interval=0.0)
+            self._stamp_autoscaler(force=True)
         self.supervisor.start()
         return self
 
@@ -315,6 +364,8 @@ class ServingFleet:
                 t.join(max(0.0, deadline - time.monotonic()))
             if rep.writer is not None:
                 rep.writer.stamp_terminal(hb.PHASE_EXIT, lock_timeout=1.0)
+        if self._as_writer is not None:
+            self._as_writer.stamp_terminal(hb.PHASE_EXIT, lock_timeout=1.0)
         if self.disagg:
             # items still crossing the role boundary return their blocks
             # (their requests are left un-concluded, same as the queue)
@@ -332,12 +383,22 @@ class ServingFleet:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                temperature: float = 0.0, eos_token_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               on_token=None, on_finish=None) -> FleetRequest:
+               on_token=None, on_finish=None,
+               priority: str = STANDARD) -> FleetRequest:
         """Enqueue onto the SHARED fleet queue (thread-safe, bounded —
         raises on a full queue or an inadmissible request, the caller
         must know synchronously). ``deadline_s`` defaults to
-        ``fleet.default_deadline_s`` (0 = wait forever)."""
+        ``fleet.default_deadline_s`` (0 = wait forever). ``priority``
+        (round 19) picks the latency/standard/batch tier; at a hard-full
+        queue a higher-tier arrival sheds the youngest lowest-tier
+        queued request (victim concludes SHED, callback fires) and a
+        rejection is always the machine-readable
+        :class:`~.scheduler.AdmissionRejected` — never a hang, never a
+        silent drop (docs/SERVING.md §Priority)."""
         chaos.failpoint("serve.enqueue")
+        if priority not in TIER_RANK:
+            raise ValueError(f"unknown priority tier {priority!r}; pick "
+                             f"one of {PRIORITY_TIERS}")
         prompt = [int(t) for t in prompt]
         # eager admissibility — the SAME predicate every replica's
         # scheduler applies (shared pool geometry): a request no replica
@@ -352,20 +413,25 @@ class ServingFleet:
         if deadline_s is None and self.fcfg.default_deadline_s > 0:
             deadline_s = self.fcfg.default_deadline_s
         with self._qlock:
-            if len(self._queue) >= int(self.fcfg.max_queue):
-                raise RuntimeError(
-                    f"fleet queue full ({self.fcfg.max_queue}); apply "
-                    "backpressure upstream")
             self._rid += 1
             req = FleetRequest(
                 prompt=prompt, max_new_tokens=int(max_new_tokens),
                 temperature=float(temperature), eos_token_id=eos_token_id,
-                on_token=on_token, on_finish=on_finish, rid=self._rid)
+                on_token=on_token, on_finish=on_finish, rid=self._rid,
+                priority=priority)
             if deadline_s is not None:
                 req.deadline_ts = req.arrival_ts + float(deadline_s)
-            self._queue.append(req)
+            # the round-19 overload ladder (scheduler.admit_or_shed):
+            # raises AdmissionRejected before touching fleet state
+            victim = admit_or_shed(self._queue, req,
+                                   int(self.fcfg.max_queue),
+                                   float(self.fcfg.batch_highwater))
             self._outstanding[req.rid] = req
         self._bump("submitted")
+        if victim is not None:
+            self._conclude(victim, SHED, json.dumps(
+                {"error": "shed", "reason": "displaced_by_tier",
+                 "tier": victim.priority}, sort_keys=True))
         return req
 
     @property
@@ -597,11 +663,15 @@ class ServingFleet:
         replica while another has a free lane). Expired requests are shed
         here with TIMEOUT. Caller holds rep.lock. (Disagg: prefill-role
         replicas dispatch one request at a time — ``wants_dispatch`` —
-        and decode-role replicas never dispatch from here at all.)"""
+        and decode-role replicas never dispatch from here at all.)
+        A DRAINING replica (scale-down in flight) admits nothing — its
+        lanes finish, then the supervisor retires it."""
+        if rep.draining:
+            return
         eng = rep.engine
         while eng.wants_dispatch:
             with self._qlock:
-                req = self._queue.popleft() if self._queue else None
+                req = self._queue.popnext()
             if req is None:
                 return
             if req.expired():
@@ -621,7 +691,7 @@ class ServingFleet:
                                 req.remaining,
                                 temperature=req.temperature,
                                 eos_token_id=req.eos_token_id,
-                                deadline_s=dl)
+                                deadline_s=dl, priority=req.priority)
             except BaseException:
                 # an exploding enqueue (chaos serve.enqueue, engine-side
                 # validation) kills THIS replica, but the popped request
@@ -753,25 +823,33 @@ class ServingFleet:
         with self._qlock:
             self._quarantine.extend(keep)
 
+    def _sync_one(self, req: FleetRequest, er) -> None:
+        """Emit one request's newly generated tokens (the exactly-once
+        cursor walk). Caller holds the owning replica's lock — worker
+        sync, supervisor teardown and lane preemption all serialize
+        here."""
+        toks = er.output_tokens
+        while req._synced < len(toks):
+            tok = int(toks[req._synced])
+            req._synced += 1
+            req.output_tokens.append(tok)
+            self._bump("tokens_emitted")
+            if req.on_token is not None:
+                try:
+                    req.on_token(req, tok)
+                except Exception:
+                    logger.exception("fleet: on_token callback for "
+                                     "request %d raised", req.rid)
+
     def _sync(self, rep: _Replica) -> None:
-        """Emit newly generated tokens (exactly once — this is the only
-        place fleet ``output_tokens`` grows) and conclude finished engine
-        requests. Caller holds rep.lock; the supervisor flips state to
-        DOWN under the same lock, so emission never races a requeue."""
+        """Emit newly generated tokens (exactly once — ``_sync_one`` is
+        the only place fleet ``output_tokens`` grows) and conclude
+        finished engine requests. Caller holds rep.lock; the supervisor
+        flips state to DOWN under the same lock, so emission never races
+        a requeue."""
         for rid in list(rep.inflight):
             req, er = rep.inflight[rid]
-            toks = er.output_tokens
-            while req._synced < len(toks):
-                tok = int(toks[req._synced])
-                req._synced += 1
-                req.output_tokens.append(tok)
-                self._bump("tokens_emitted")
-                if req.on_token is not None:
-                    try:
-                        req.on_token(req, tok)
-                    except Exception:
-                        logger.exception("fleet: on_token callback for "
-                                         "request %d raised", req.rid)
+            self._sync_one(req, er)
             if er.done:
                 del rep.inflight[rid]
                 if self.disagg:
@@ -818,7 +896,7 @@ class ServingFleet:
                   error: Optional[str] = None) -> None:
         if req._finish(state, error):
             self._bump({FINISHED: "completed", FAILED: "failed",
-                        TIMEOUT: "timeout"}[state])
+                        TIMEOUT: "timeout", SHED: "shed"}[state])
         with self._qlock:
             self._outstanding.pop(req.rid, None)
 
@@ -897,6 +975,14 @@ class ServingFleet:
         # FIFO standing preserved across the teardown
         for req, er in reversed(inflight):
             self._requeue(req, er, from_idx=rep.idx)
+        if rep.draining:
+            # the replica was already being scaled down: its death just
+            # ends the drain early — lanes requeued exactly-once above,
+            # and the autoscaler wanted the capacity gone, so no strike
+            # toward blacklist and no replacement
+            death["action"] = "retired"
+            self._note_drained(rep, clean=False)
+            return
         blacklist_after = int(self.fcfg.blacklist_after)
         if blacklist_after > 0 and rep.strikes >= blacklist_after:
             rep.state = BLACKLISTED
@@ -935,16 +1021,20 @@ class ServingFleet:
         self._replica_down(rep, "straggler", evidence)
 
     def _requeue(self, req: FleetRequest, er,
-                 from_idx: Optional[int] = None) -> None:
+                 from_idx: Optional[int] = None,
+                 charge_retry: bool = True) -> None:
         """Exactly-once requeue: conclude what the dead replica already
         concluded, finish requests whose budget is spent, retry-budget
-        the rest back onto the queue HEAD (they were admitted first —
-        FIFO standing is preserved). ``from_idx`` names the dying
-        replica (None for orphan retries): a disagg request whose owner
-        moved past it — pushed into the handoff, or already popped by a
-        decode replica — is NOT requeued. ``serve.requeue`` crashes here
-        park the request on the orphan list for the next supervisor
-        poll."""
+        the rest back onto the queue HEAD of their tier (they were
+        admitted first — FIFO standing is preserved). ``from_idx`` names
+        the dying replica (None for orphan retries): a disagg request
+        whose owner moved past it — pushed into the handoff, or already
+        popped by a decode replica — is NOT requeued.
+        ``charge_retry=False`` is the preemption path (round 19): a
+        batch lane evicted for a pressured latency request lost nothing
+        to a failure, so the eviction must not march it toward a FAILED
+        verdict. ``serve.requeue`` crashes here park the request on the
+        orphan list for the next supervisor poll."""
         try:
             chaos.failpoint("serve.requeue")
             if self.disagg and er is not None:
@@ -978,7 +1068,8 @@ class ServingFleet:
             if req.expired():
                 self._conclude(req, TIMEOUT, "deadline exceeded at requeue")
                 return
-            req.retries += 1
+            if charge_retry:
+                req.retries += 1
             if req.retries > int(self.fcfg.retry_budget):
                 self._conclude(
                     req, FAILED,
@@ -1007,10 +1098,7 @@ class ServingFleet:
         # from the queue without ever concluding it
         now = time.monotonic()
         with self._qlock:
-            expired = [r for r in self._queue if r.expired(now)]
-            if expired:
-                self._queue = deque(r for r in self._queue
-                                    if not r.expired(now))
+            expired = self._queue.remove_expired(now)
         for req in expired:
             self._conclude(req, TIMEOUT, "deadline exceeded while queued")
 
@@ -1039,6 +1127,235 @@ class ServingFleet:
         victim = min(candidates, key=lambda r: (r.strikes, r.idx))
         self._restart(victim.idx, victim.generation + 1, victim.strikes,
                       parole=True)
+
+    # ------------------------------------------------- traffic shaping (round
+    # 19: autoscaling + preemption; the POLICY lives in serving/autoscale.py,
+    # these are the mechanisms the supervisor drives each poll)
+
+    def _autoscale_tick(self) -> None:
+        """Feed this poll's gauges — the same numbers the replicas stamp
+        into their SERVE heartbeats — through the AutoscalePolicy and
+        perform its verdict. Also completes any drain in flight."""
+        if self.autoscale is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            reps = list(self._replicas)
+        serving = [r for r in reps if r.state == LIVE
+                   and not r.draining and not r.warming]
+        warming = sum(1 for r in reps if r.state == LIVE and r.warming)
+        draining = [r for r in reps if r.state == LIVE and r.draining]
+        for rep in draining:
+            self._finish_drain(rep)
+        with self._qlock:
+            qdepth = len(self._queue)
+            pressured = self._queue.pressured(
+                float(self.fcfg.autoscale.pressure_s), now)
+        active = sum(r.engine.active for r in serving
+                     if r.engine is not None)
+        obs = Observation(
+            queue_depth=qdepth, pressured=pressured, live=len(serving),
+            warming=warming, draining=len(draining), active_lanes=active,
+            total_lanes=len(serving) * int(self.scfg.max_batch))
+        verdict = self.autoscale.observe(obs, now)
+        if verdict == SCALE_UP:
+            self._scale_up(self.autoscale.describe(obs), obs)
+        elif verdict == SCALE_DOWN:
+            self._scale_down(self.autoscale.describe(obs), obs)
+
+    def _scale_up(self, reason: str, obs: Observation) -> None:
+        """Append a NEW replica slot and launch it WARMED (the restart
+        path's warm=True): it compiles off-path and only then starts its
+        worker — scaled-up capacity never serves cold, and its compile
+        cannot read as heartbeat silence. The ``serve.scale_up``
+        failpoint crashes inside the spawn: the slot rolls back and the
+        event records ``up_failed`` — a failed spawn leaves the fleet
+        exactly as it was (no phantom replica) and still starts the
+        cooldown (the overload that caused it is still being answered)."""
+        with self._lock:
+            idx = len(self._replicas)
+            rep = _Replica(idx)
+            self._replicas.append(rep)
+        event = ScaleEvent(action=SCALE_UP, replica=idx, reason=reason,
+                           ts=time.monotonic(), queue=obs.queue_depth,
+                           live=obs.live)
+        try:
+            chaos.failpoint("serve.scale_up", key=str(idx))
+            self._launch(rep, warm=True)
+        except Exception as e:
+            with self._lock:
+                if self._replicas and self._replicas[-1] is rep:
+                    self._replicas.pop()
+            event.action = "up_failed"
+            event.error = repr(e)
+            self.scale_events.append(event)
+            self._stamp_autoscaler(force=True)
+            logger.warning("fleet: scale-up of replica %d failed: %s",
+                           idx, e)
+            return
+        self._bump("scale_ups")
+        self.scale_events.append(event)
+        self._stamp_autoscaler(force=True)
+        logger.warning("fleet: scaled UP to replica %d (%s)", idx, reason)
+
+    def _scale_down(self, reason: str, obs: Observation) -> None:
+        """Start draining the NEWEST serving replica (LIFO keeps the
+        original fleet's indices stable): admission stops now, its lanes
+        finish, and ``_finish_drain`` retires it — the straggler-drain
+        discipline without the strike. The event is recorded at
+        initiation (``drained_ts`` lands at completion), so `dstpu
+        health` shows the drain while it is in flight."""
+        with self._lock:
+            cands = [r for r in self._replicas if r.state == LIVE
+                     and not r.draining and not r.warming]
+        if len(cands) <= self.autoscale.min_replicas:
+            return
+        rep = max(cands, key=lambda r: r.idx)
+        rep.draining = True
+        self.scale_events.append(ScaleEvent(
+            action=SCALE_DOWN, replica=rep.idx, reason=reason,
+            ts=time.monotonic(), queue=obs.queue_depth, live=obs.live))
+        self._stamp_autoscaler(force=True)
+        logger.warning("fleet: scaling DOWN replica %d (%s) — draining",
+                       rep.idx, reason)
+
+    def _finish_drain(self, rep: _Replica) -> None:
+        """Retire a draining replica once its lanes emptied: state flips
+        to RETIRED under the replica lock (the worker exits at its next
+        state check; a step cannot be in flight for an idle engine) and
+        the EXIT terminal stamp — not STALLED — records a conclusion,
+        not a failure. A still-busy or lock-contended drain just waits
+        for the next poll; a draining replica that DIES instead goes
+        through ``_replica_down`` (exactly-once requeue, no restart)."""
+        if rep.inflight or (rep.engine is not None
+                            and rep.engine.has_work):
+            return
+        if not rep.lock.acquire(timeout=1.0):
+            return
+        try:
+            if rep.state != LIVE or not rep.draining:
+                return
+            if rep.inflight or rep.engine.has_work:
+                return
+            rep.state = RETIRED
+        finally:
+            rep.lock.release()
+        if rep.writer is not None:
+            rep.writer.stamp_terminal(hb.PHASE_EXIT, lock_timeout=1.0)
+        self._note_drained(rep, clean=True)
+        logger.warning("fleet: replica %d RETIRED (drain complete)",
+                       rep.idx)
+
+    def _note_drained(self, rep: _Replica, clean: bool) -> None:
+        """Conclude the replica's scale-down event in the capacity
+        ledger (``clean=False``: the drain ended by death — its lanes
+        requeued exactly-once rather than finishing in place)."""
+        self._bump("scale_downs")
+        for ev in reversed(self.scale_events):
+            if ev.action == SCALE_DOWN and ev.replica == rep.idx \
+                    and ev.drained_ts is None:
+                ev.drained_ts = time.monotonic()
+                if not clean:
+                    ev.error = "drain ended by replica death"
+                break
+        self._stamp_autoscaler(force=True)
+
+    def _stamp_autoscaler(self, force: bool = False) -> None:
+        """The autoscaler's heartbeat record: refreshed every supervisor
+        poll (so it never reads as silent while supervised) and forced
+        on every scale event — `dstpu health` shows the last verdict in
+        the gauges column alongside the replicas it acted on."""
+        if self._as_writer is None:
+            return
+        try:
+            with self._qlock:
+                qdepth = len(self._queue)
+            with self._lock:
+                live = sum(1 for r in self._replicas
+                           if r.state == LIVE and not r.draining)
+            gauges = {"role": "AUTOSCALER", "queue": qdepth, "live": live,
+                      "events": len(self.scale_events)}
+            if self.scale_events:
+                ev = self.scale_events[-1]
+                gauges["event"] = f"{ev.action}@r{ev.replica}"
+            self._as_writer.write(hb.PHASE_SERVE, len(self.scale_events),
+                                  force=force, extra=gauges)
+        except Exception:
+            pass                        # diagnostics must not kill a poll
+
+    def _maybe_preempt(self) -> None:
+        """Deadline-pressured latency admission (round 19): when a
+        latency-tier request is queued within ``preempt_pressure_s`` of
+        its deadline and NO serving replica has a free lane, evict the
+        youngest RUNNING batch-tier lane and requeue it through the
+        exactly-once token-exact path (emitted prefix carried, no
+        retry-budget charge) — the freed lane admits the pressured
+        request at the owner's next dispatch. At most one eviction per
+        poll bounds the churn. The ``serve.preempt`` failpoint fires in
+        the window between eviction and requeue: a crash there parks the
+        victim on the orphan list — deferred, never lost, never
+        double-emitted (its lane is gone and its cursor was synced under
+        the replica lock)."""
+        window = float(self.fcfg.preempt_pressure_s)
+        if window <= 0 or self.disagg:
+            return
+        now = time.monotonic()
+        with self._qlock:
+            pressured = next(
+                (r for r in self._queue
+                 if r.priority == LATENCY and r.deadline_ts is not None
+                 and 0.0 <= (r.deadline_ts - now) < window), None)
+        if pressured is None:
+            return
+        with self._lock:
+            reps = [r for r in self._replicas
+                    if r.state == LIVE and not r.draining]
+        if any(r.engine is not None and r.engine.wants_dispatch
+               for r in reps):
+            return                       # a free lane will serve it
+        for rep in reps:
+            if not rep.lock.acquire(timeout=1.0):
+                continue
+            try:
+                if rep.state != LIVE:
+                    continue
+                victim = None
+                for freq, er in rep.inflight.values():
+                    if freq.priority == BATCH and er.state == RUNNING \
+                            and (victim is None
+                                 or freq.arrival_ts > victim[0].arrival_ts):
+                        victim = (freq, er)
+                if victim is None:
+                    continue
+                freq, er = victim
+                # sync BEFORE evicting: tokens the engine already
+                # generated are emitted (the healthy-replica economy the
+                # death path cannot have), then the eviction drops only
+                # lane state — the requeue resumes from prompt+emitted
+                self._sync_one(freq, er)
+                if not rep.engine.preempt_request(er, timeout=1.0):
+                    continue
+                rep.inflight.pop(freq.rid, None)
+                freq.preemptions += 1
+                self._bump("preempted")
+                logger.warning(
+                    "fleet: preempting batch request %d on replica %d "
+                    "for pressured latency request %d", freq.rid,
+                    rep.idx, pressured.rid)
+                try:
+                    chaos.failpoint("serve.preempt")
+                except chaos.ChaosError as e:
+                    logger.warning(
+                        "fleet: preemption requeue of request %d failed "
+                        "(%s) — orphaned for retry", freq.rid, e)
+                    with self._qlock:
+                        self._orphans.append(freq)
+                    return
+                self._requeue(freq, None, from_idx=rep.idx,
+                              charge_retry=False)
+                return
+            finally:
+                rep.lock.release()
 
 
 class FleetSupervisor:
@@ -1123,6 +1440,9 @@ class FleetSupervisor:
             self._check_stragglers(reps, records)
         fleet._retry_orphans()
         fleet._shed_expired()
+        fleet._maybe_preempt()
+        fleet._autoscale_tick()
+        fleet._stamp_autoscaler()
         if fleet.disagg:
             # handoff deadlines must hold even with every decode replica
             # down, and dead replicas' shared-pool blocks release once
@@ -1139,7 +1459,7 @@ class FleetSupervisor:
         drained through the replica-death path. Warming replicas are
         excluded — their frozen pre-warm gauge measures nothing."""
         live = {r.idx: r for r in reps
-                if r.state == LIVE and not r.warming}
+                if r.state == LIVE and not r.warming and not r.draining}
         snapshot = {idx: rec for idx, rec in records.items()
                     if idx in live}
         for idx in self._straggler.observe(snapshot):

@@ -53,16 +53,18 @@ import sys
 import tempfile
 import threading
 import time
-from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..runtime import heartbeat as hb
 from ..runtime.fabric import HubConn, read_frame
 from ..testing import chaos
 from ..utils.logging import log_dist, logger
-from .fleet import BLACKLISTED, DOWN, LIVE, FleetRequest
-from .scheduler import (FAILED, FINISHED, QUEUED, RUNNING, TIMEOUT,
-                        check_admissible)
+from .autoscale import (AUTOSCALER_RANK, SCALE_DOWN, SCALE_UP,
+                        AutoscalePolicy, Observation, ScaleEvent)
+from .fleet import BLACKLISTED, DOWN, LIVE, RETIRED, FleetRequest
+from .scheduler import (BATCH, FAILED, FINISHED, LATENCY, PRIORITY_TIERS,
+                        QUEUED, RUNNING, SHED, STANDARD, TIER_RANK, TIMEOUT,
+                        TieredQueue, admit_or_shed, check_admissible)
 
 PyTree = Any
 
@@ -78,12 +80,14 @@ class _Proc:
         self.strikes = strikes
         self.state = LIVE
         self.ready = False             # worker warmed + said hello
+        self.draining = False          # scale-down in flight (round 19)
         self.proc: Optional[subprocess.Popen] = None
         self.conn: Optional[HubConn] = None
         self.pid: Optional[int] = None
         self.inflight: Dict[int, FleetRequest] = {}
         self.error: Optional[str] = None
         self.started_ts = time.monotonic()
+        self.retired_ts: Optional[float] = None
 
 
 class ProcessFleet:
@@ -113,13 +117,23 @@ class ProcessFleet:
                 "in-process KV pool (the zero-copy handoff cannot cross "
                 "a process boundary)")
         self.n_replicas = max(1, int(self.fcfg.replicas))
+        # traffic-shaped autoscaling (round 19): the SAME policy the
+        # thread fleet feeds — disagg is already refused above, so the
+        # plain-replicas precondition holds by construction
+        self.autoscale: Optional[AutoscalePolicy] = None
+        if self.fcfg.autoscale.enabled:
+            self.autoscale = AutoscalePolicy(self.fcfg.autoscale)
+            self.n_replicas = min(max(self.n_replicas,
+                                      self.autoscale.min_replicas),
+                                  self.autoscale.max_replicas)
         self.heartbeat_dir = (heartbeat_dir or self.fcfg.heartbeat_dir
                               or tempfile.mkdtemp(prefix="dstpu-pfleet-hb-"))
         self.workdir = workdir or tempfile.mkdtemp(prefix="dstpu-pfleet-")
         self.log_dir = log_dir
         self._env_first = dict(env_first or {})
         self._env_first_spawned: set = set()
-        self._queue: deque = deque()             # guarded by _qlock
+        self._queue = TieredQueue(                # guarded by _qlock
+            aging_s=float(self.fcfg.priority_aging_s))
         self._qlock = threading.Lock()
         self._stats_lock = threading.Lock()
         self._orphans: List[FleetRequest] = []
@@ -140,10 +154,15 @@ class ProcessFleet:
         self._poll_t: Optional[threading.Thread] = None
         self._logs: Dict[int, Any] = {}
         self.deaths: List[dict] = []
+        #: capacity ledger (round 19), mirroring ServingFleet: every
+        #: autoscaler verdict with its trigger and queue/live evidence
+        self.scale_events: List[ScaleEvent] = []
+        self._as_writer: Optional[hb.HeartbeatWriter] = None
         self.stats: Dict[str, int] = {
             "submitted": 0, "completed": 0, "failed": 0, "timeout": 0,
             "requeues": 0, "deaths": 0, "restarts": 0, "paroles": 0,
-            "blacklisted": 0, "tokens_emitted": 0}
+            "blacklisted": 0, "tokens_emitted": 0, "shed": 0,
+            "preempted": 0, "scale_ups": 0, "scale_downs": 0}
         hb.clear_channel(self.heartbeat_dir)
         self._stage_artifacts(params)
         log_dist(
@@ -222,6 +241,16 @@ class ProcessFleet:
         self._accept_t.start()
         for rep in self._replicas:
             self._spawn(rep)
+        if self.autoscale is not None:
+            # the autoscaler's own heartbeat rank — scale events are
+            # operator evidence in the SAME channel `dstpu health`
+            # reads; refreshed every supervisor poll
+            self._as_writer = hb.HeartbeatWriter(
+                self.heartbeat_dir, rank=AUTOSCALER_RANK,
+                host="autoscaler",
+                min_interval=float(self.fcfg.heartbeat_interval),
+                refresh_interval=0.0)
+            self._stamp_autoscaler(force=True)
         self._poll_t = threading.Thread(target=self._poll_loop, daemon=True)
         self._poll_t.start()
         return self
@@ -259,6 +288,8 @@ class ProcessFleet:
                 pass
         if self._poll_t is not None:
             self._poll_t.join(2.0)
+        if self._as_writer is not None:
+            self._as_writer.stamp_terminal(hb.PHASE_EXIT, lock_timeout=1.0)
         for f in self._logs.values():
             try:
                 f.close()
@@ -278,8 +309,12 @@ class ProcessFleet:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                temperature: float = 0.0, eos_token_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               on_token=None, on_finish=None) -> FleetRequest:
+               on_token=None, on_finish=None,
+               priority: str = STANDARD) -> FleetRequest:
         chaos.failpoint("serve.enqueue")
+        if priority not in TIER_RANK:
+            raise ValueError(f"unknown priority tier {priority!r}; pick "
+                             f"one of {PRIORITY_TIERS}")
         prompt = [int(t) for t in prompt]
         bs = int(self.scfg.block_size)
         check_admissible(
@@ -290,20 +325,25 @@ class ProcessFleet:
         if deadline_s is None and self.fcfg.default_deadline_s > 0:
             deadline_s = self.fcfg.default_deadline_s
         with self._qlock:
-            if len(self._queue) >= int(self.fcfg.max_queue):
-                raise RuntimeError(
-                    f"fleet queue full ({self.fcfg.max_queue}); apply "
-                    "backpressure upstream")
             self._rid += 1
             req = FleetRequest(
                 prompt=prompt, max_new_tokens=int(max_new_tokens),
                 temperature=float(temperature), eos_token_id=eos_token_id,
-                on_token=on_token, on_finish=on_finish, rid=self._rid)
+                on_token=on_token, on_finish=on_finish, rid=self._rid,
+                priority=priority)
             if deadline_s is not None:
                 req.deadline_ts = req.arrival_ts + float(deadline_s)
-            self._queue.append(req)
+            # the round-19 overload ladder (scheduler.admit_or_shed):
+            # raises AdmissionRejected before touching fleet state
+            victim = admit_or_shed(self._queue, req,
+                                   int(self.fcfg.max_queue),
+                                   float(self.fcfg.batch_highwater))
             self._outstanding[req.rid] = req
         self._bump("submitted")
+        if victim is not None:
+            self._conclude(victim, SHED, json.dumps(
+                {"error": "shed", "reason": "displaced_by_tier",
+                 "tier": victim.priority}, sort_keys=True))
         return req
 
     @property
@@ -388,7 +428,21 @@ class ProcessFleet:
                 self._epochs[idx] += 1
                 epoch = self._epochs[idx]
                 rep = self._replicas[idx]
-                old = rep.conn
+                dead = rep.state != LIVE
+                old = rep.conn if not dead else None
+            if dead:
+                # a RETIRED (or verdicted) worker redialing in: answer
+                # with the stop its teardown may have missed — the epoch
+                # bump above already fences anything it frames meanwhile
+                conn = HubConn(sock, ident=f"replica-{idx}", gen=epoch)
+                conn.welcome()
+                try:
+                    conn.send({"cmd": "stop"})
+                except OSError:
+                    pass
+                conn.close()
+                return
+            with self._lock:
                 conn = HubConn(sock, ident=f"replica-{idx}", gen=epoch)
                 rep.conn = conn
                 if meta.get("pid") is not None:
@@ -521,7 +575,26 @@ class ProcessFleet:
         stale = ({int(rec["rank"]) for rec in hb.stale_ranks(
                       self.heartbeat_dir, timeout, records=records)}
                  if timeout > 0 else set())
+        now = time.monotonic()
         for rep in reps:
+            if rep.state == RETIRED and rep.proc is not None:
+                # reap the retired worker (its stop command exits rc 0
+                # and it stamps its own EXIT). A worker that never got
+                # the stop — link down at drain time — is killed after a
+                # grace window; the hub stamps EXIT on its behalf (a
+                # RETIRED replica concluded, it did not fail).
+                if rep.proc.poll() is None and rep.retired_ts is not None \
+                        and now - rep.retired_ts > 5.0:
+                    rep.proc.kill()
+                    rep.retired_ts = None
+                    try:
+                        w = hb.HeartbeatWriter(
+                            self.heartbeat_dir, rank=rep.idx,
+                            refresh_interval=0)
+                        w.stamp_terminal(hb.PHASE_EXIT, lock_timeout=1.0)
+                    except Exception:
+                        pass
+                continue
             if rep.state != LIVE or rep.proc is None:
                 continue
             rc = rep.proc.poll()
@@ -539,6 +612,9 @@ class ProcessFleet:
         self._retry_orphans()
         self._shed_expired()
         self._maybe_parole()
+        self._maybe_preempt()
+        self._autoscale_tick()
+        self._stamp_autoscaler()
         self._dispatch_all()
         return verdicts
 
@@ -584,6 +660,15 @@ class ProcessFleet:
             rep.idx, reason, rep.strikes, pid)
         for req in reversed(inflight):
             self._requeue(req)
+        if rep.draining:
+            # the replica was already being scaled down: its death just
+            # ends the drain early — lanes requeued exactly-once above,
+            # and the autoscaler wanted the capacity gone, so no strike
+            # toward blacklist and no replacement
+            rep.state = RETIRED
+            death["action"] = "retired"
+            self._note_drained(rep, clean=False)
+            return death
         blacklist_after = int(self.fcfg.blacklist_after)
         if blacklist_after > 0 and rep.strikes >= blacklist_after:
             rep.state = BLACKLISTED
@@ -599,10 +684,13 @@ class ProcessFleet:
         death["restarted_ts"] = time.monotonic()
         return death
 
-    def _requeue(self, req: FleetRequest) -> None:
+    def _requeue(self, req: FleetRequest, charge_retry: bool = True) -> None:
         """ServingFleet._requeue, minus the disagg arm: conclude spent /
         finished / expired requests, retry-budget the rest back onto the
-        queue HEAD. A ``serve.requeue`` crash parks on the orphan list."""
+        queue HEAD (of the request's own tier). A ``serve.requeue`` crash
+        parks on the orphan list. ``charge_retry=False`` is the
+        preemption path: the fleet evicted a healthy victim for capacity
+        reasons, so the victim's failure budget is untouched."""
         try:
             chaos.failpoint("serve.requeue")
             if req.done:
@@ -615,7 +703,8 @@ class ProcessFleet:
             if req.expired():
                 self._conclude(req, TIMEOUT, "deadline exceeded at requeue")
                 return
-            req.retries += 1
+            if charge_retry:
+                req.retries += 1
             if req.retries > int(self.fcfg.retry_budget):
                 self._conclude(
                     req, FAILED,
@@ -641,10 +730,7 @@ class ProcessFleet:
     def _shed_expired(self) -> None:
         now = time.monotonic()
         with self._qlock:
-            expired = [r for r in self._queue if r.expired(now)]
-            if expired:
-                self._queue = deque(r for r in self._queue
-                                    if not r.expired(now))
+            expired = self._queue.remove_expired(now)
         for req in expired:
             self._conclude(req, TIMEOUT, "deadline exceeded while queued")
 
@@ -671,17 +757,248 @@ class ProcessFleet:
         rep = min(black, key=lambda r: r.strikes)
         self._restart(rep.idx, rep.generation + 1, rep.strikes, parole=True)
 
+    # ------------------------------------------------- traffic shaping (round
+    # 19: autoscaling + preemption — the process-placement mechanisms for
+    # the one policy in serving/autoscale.py; mirrors ServingFleet)
+
+    def _autoscale_tick(self) -> None:
+        """Feed this poll's gauges through the AutoscalePolicy and
+        perform its verdict; also completes any drain in flight. A
+        spawned-but-not-ready worker counts as WARMING (it is compiling
+        off-path), so the policy stays silent until it lands."""
+        if self.autoscale is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            reps = list(self._replicas)
+            serving = [r for r in reps if r.state == LIVE and r.ready
+                       and not r.draining]
+            warming = sum(1 for r in reps
+                          if r.state == LIVE and not r.ready)
+            draining = [r for r in reps if r.state == LIVE and r.draining]
+        for rep in draining:
+            self._finish_drain(rep)
+        with self._qlock:
+            qdepth = len(self._queue)
+            pressured = self._queue.pressured(
+                float(self.fcfg.autoscale.pressure_s), now)
+        active = sum(len(r.inflight) for r in serving)
+        obs = Observation(
+            queue_depth=qdepth, pressured=pressured, live=len(serving),
+            warming=warming, draining=len(draining), active_lanes=active,
+            total_lanes=len(serving) * int(self.scfg.max_batch))
+        verdict = self.autoscale.observe(obs, now)
+        if verdict == SCALE_UP:
+            self._scale_up(self.autoscale.describe(obs), obs)
+        elif verdict == SCALE_DOWN:
+            self._scale_down(self.autoscale.describe(obs), obs)
+
+    def _scale_up(self, reason: str, obs: Observation) -> None:
+        """Append a NEW replica slot — the replica list, the epoch fence
+        table, and ``n_replicas`` (the hello-bound check) grow together
+        under the list lock — and spawn its worker, which warms itself
+        before saying ready (scaled-up capacity never serves cold). A
+        ``serve.scale_up`` crash rolls the slot back and records
+        ``up_failed``: a failed spawn leaves the fleet exactly as it
+        was, and the policy's cooldown still debounces the retry."""
+        with self._lock:
+            idx = len(self._replicas)
+            rep = _Proc(idx)
+            self._replicas.append(rep)
+            self._epochs.append(0)
+            self.n_replicas += 1
+        event = ScaleEvent(action=SCALE_UP, replica=idx, reason=reason,
+                           ts=time.monotonic(), queue=obs.queue_depth,
+                           live=obs.live)
+        try:
+            chaos.failpoint("serve.scale_up", key=str(idx))
+            self._spawn(rep)
+        except Exception as e:
+            with self._lock:
+                if self._replicas and self._replicas[-1] is rep:
+                    self._replicas.pop()
+                    self._epochs.pop()
+                    self.n_replicas -= 1
+            event.action = "up_failed"
+            event.error = repr(e)
+            self.scale_events.append(event)
+            self._stamp_autoscaler(force=True)
+            logger.warning("fleet: scale-up of replica process %d "
+                           "failed: %s", idx, e)
+            return
+        self._bump("scale_ups")
+        self.scale_events.append(event)
+        self._stamp_autoscaler(force=True)
+        logger.warning("fleet: scaled UP to replica process %d (%s)",
+                       idx, reason)
+
+    def _scale_down(self, reason: str, obs: Observation) -> None:
+        """Start draining the NEWEST serving replica (LIFO keeps the
+        original fleet's indices stable): dispatch skips it from now on,
+        its in-flight lanes finish, and ``_finish_drain`` retires the
+        process. The event is recorded at initiation (``drained_ts``
+        lands at completion) so `dstpu health` shows the drain in
+        flight."""
+        with self._lock:
+            cands = [r for r in self._replicas if r.state == LIVE
+                     and r.ready and not r.draining]
+        if len(cands) <= self.autoscale.min_replicas:
+            return
+        rep = max(cands, key=lambda r: r.idx)
+        rep.draining = True
+        self.scale_events.append(ScaleEvent(
+            action=SCALE_DOWN, replica=rep.idx, reason=reason,
+            ts=time.monotonic(), queue=obs.queue_depth, live=obs.live))
+        self._stamp_autoscaler(force=True)
+        logger.warning("fleet: scaling DOWN replica process %d (%s) — "
+                       "draining", rep.idx, reason)
+
+    def _finish_drain(self, rep: _Proc) -> None:
+        """Retire a draining replica once its lanes emptied: flip to
+        RETIRED *before* sending the stop command — the poll's
+        process-exit check skips non-LIVE replicas, so the worker's
+        clean rc-0 exit reads as the conclusion it is, not a death. The
+        worker stamps its own EXIT terminal on the way out; the epoch
+        bump fences any frame its dying connection still emits. A
+        draining replica that DIES instead goes through
+        ``_replica_down`` (exactly-once requeue, action 'retired')."""
+        if rep.inflight:
+            return
+        with self._lock:
+            if rep.state != LIVE or not rep.draining:
+                return
+            if rep.inflight:
+                return
+            rep.state = RETIRED
+            rep.retired_ts = time.monotonic()
+            self._epochs[rep.idx] += 1
+            conn, rep.conn = rep.conn, None
+        if conn is not None:
+            try:
+                conn.send({"cmd": "stop"})
+            except OSError:
+                pass                    # redial lands on the stop answer
+            conn.close()
+        self._note_drained(rep, clean=True)
+        logger.warning("fleet: replica process %d RETIRED (drain "
+                       "complete)", rep.idx)
+
+    def _note_drained(self, rep: _Proc, clean: bool) -> None:
+        """Conclude the replica's scale-down event in the capacity
+        ledger (``clean=False``: the drain ended by death — its lanes
+        requeued exactly-once rather than finishing in place)."""
+        self._bump("scale_downs")
+        for ev in reversed(self.scale_events):
+            if ev.action == SCALE_DOWN and ev.replica == rep.idx \
+                    and ev.drained_ts is None:
+                ev.drained_ts = time.monotonic()
+                if not clean:
+                    ev.error = "drain ended by replica death"
+                break
+        self._stamp_autoscaler(force=True)
+
+    def _stamp_autoscaler(self, force: bool = False) -> None:
+        """The autoscaler's heartbeat record: refreshed every supervisor
+        poll (never reads as silent while supervised), forced on every
+        scale event — `dstpu health` shows the last verdict alongside
+        the replica processes it acted on."""
+        if self._as_writer is None:
+            return
+        try:
+            with self._qlock:
+                qdepth = len(self._queue)
+            with self._lock:
+                live = sum(1 for r in self._replicas
+                           if r.state == LIVE and not r.draining)
+            gauges = {"role": "AUTOSCALER", "queue": qdepth, "live": live,
+                      "events": len(self.scale_events)}
+            if self.scale_events:
+                ev = self.scale_events[-1]
+                gauges["event"] = f"{ev.action}@r{ev.replica}"
+            self._as_writer.write(hb.PHASE_SERVE, len(self.scale_events),
+                                  force=force, extra=gauges)
+        except Exception:
+            pass                        # diagnostics must not kill a poll
+
+    def _maybe_preempt(self) -> None:
+        """Deadline-pressured latency admission, process placement: when
+        a latency-tier request is queued within ``preempt_pressure_s``
+        of its deadline and no serving replica has a free lane, tell the
+        youngest batch-tier victim's worker to ``cancel`` the lane and
+        requeue the victim hub-side. Exactly-once holds by the existing
+        ledger arithmetic: every prog frame already synced the emitted
+        prefix cumulatively, frames the dying leg still sends before the
+        cancel lands only extend that prefix idempotently, and once the
+        victim is requeued (``replica = None``) the stale-frame guard in
+        ``_apply_tokens`` drops anything late. ``serve.preempt`` fires
+        between the lane eviction and the requeue: a crash there parks
+        the victim on the orphan list — deferred, never lost. At most
+        one eviction per poll bounds the churn."""
+        window = float(self.fcfg.preempt_pressure_s)
+        if window <= 0:
+            return
+        now = time.monotonic()
+        with self._qlock:
+            pressured = next(
+                (r for r in self._queue
+                 if r.priority == LATENCY and r.deadline_ts is not None
+                 and 0.0 <= (r.deadline_ts - now) < window), None)
+        if pressured is None:
+            return
+        cap = int(self.scfg.max_batch)
+        with self._lock:
+            reps = [r for r in self._replicas
+                    if r.state == LIVE and r.ready and not r.draining
+                    and r.conn is not None]
+        if any(len(r.inflight) < cap for r in reps):
+            return                       # a free lane will serve it
+        victim_rep, victim = None, None
+        for rep in reps:
+            for req in rep.inflight.values():
+                if req.priority == BATCH and not req.done \
+                        and (victim is None
+                             or req.arrival_ts > victim.arrival_ts):
+                    victim_rep, victim = rep, req
+        if victim is None:
+            return
+        with self._lock:
+            conn = victim_rep.conn
+        try:
+            if conn is None:
+                raise OSError("no connection")
+            conn.send({"cmd": "cancel", "rid": victim.rid})
+        except OSError:
+            return                       # link down: the poll verdict owns it
+        victim_rep.inflight.pop(victim.rid, None)
+        victim.preemptions += 1
+        self._bump("preempted")
+        logger.warning(
+            "fleet: preempting batch request %d on replica process %d "
+            "for pressured latency request %d", victim.rid,
+            victim_rep.idx, pressured.rid)
+        try:
+            chaos.failpoint("serve.preempt")
+        except chaos.ChaosError as e:
+            logger.warning(
+                "fleet: preemption requeue of request %d failed (%s) — "
+                "orphaned for retry", victim.rid, e)
+            with self._qlock:
+                self._orphans.append(victim)
+            return
+        self._requeue(victim, charge_retry=False)
+
     # --------------------------------------------------------------- dispatch
 
     def _dispatch_all(self) -> None:
         with self._lock:
             reps = [r for r in self._replicas
-                    if r.state == LIVE and r.ready and r.conn is not None]
+                    if r.state == LIVE and r.ready and not r.draining
+                    and r.conn is not None]
         cap = int(self.scfg.max_batch)
         for rep in reps:
             while len(rep.inflight) < cap:
                 with self._qlock:
-                    req = self._queue.popleft() if self._queue else None
+                    req = self._queue.popnext()
                 if req is None:
                     break
                 if req.done:
@@ -726,7 +1043,7 @@ class ProcessFleet:
         with self._qlock:
             self._outstanding.pop(req.rid, None)
         self._bump({FINISHED: "completed", FAILED: "failed",
-                    TIMEOUT: "timeout"}[state])
+                    TIMEOUT: "timeout", SHED: "shed"}[state])
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:

@@ -203,6 +203,19 @@ def run_worker(args) -> int:
                 if cmd == "ack":
                     pending_done.pop(int(meta["rid"]), None)
                     continue
+                if cmd == "cancel":
+                    # hub-side preemption (round 19): withdraw the lane
+                    # or queued entry and FORGET the rid — the hub
+                    # already owns the emitted ledger and requeues the
+                    # request elsewhere; any frame this leg still sends
+                    # for the rid is dropped by the hub's replica guard
+                    rid = int(meta["rid"])
+                    pair = inflight.pop(rid, None)
+                    reported.pop(rid, None)
+                    pending_done.pop(rid, None)
+                    if pair is not None and not pair[0].done:
+                        eng.cancel_request(pair[0], timeout=5.0)
+                    continue
                 if cmd == "serve":
                     rid = int(meta["rid"])
                     if rid in inflight or rid in pending_done:

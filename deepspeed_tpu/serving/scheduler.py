@@ -1,4 +1,4 @@
-"""Continuous-batching request scheduler: admission control + FIFO queue.
+"""Continuous-batching request scheduler: admission + tiered FIFO queue.
 
 The serving loop (serving/engine.py) is a fixed-shape decode step over
 ``max_batch`` lanes; this module decides WHICH requests occupy those
@@ -25,6 +25,21 @@ lanes. Design contract:
 * **In-flight batching.** ``next_admission`` is consulted every loop
   iteration, so new prefills enter as soon as finishing sequences return
   their blocks — no batch drain barrier.
+* **Priority tiers (round 19).** ``submit(priority=)`` picks one of
+  latency / standard / batch. :class:`TieredQueue` serves the highest
+  tier first, strict FIFO *within* a tier, with one starvation bound: a
+  tier head that has waited longer than ``aging_s`` is served as if it
+  were latency-tier (the aging floor — batch work is deferrable, not
+  droppable). All-default traffic lives in one tier and degenerates to
+  exactly the old FIFO, so every strict-FIFO pin still holds.
+* **Overload ladder (round 19).** Backpressure escalates, never hangs and
+  never silently drops: (1) expired queued requests are shed with
+  TIMEOUT (round 11); (2) past ``batch_highwater`` of ``max_queue`` new
+  batch-tier submissions get a machine-readable
+  :class:`AdmissionRejected`; (3) at a hard-full queue a higher-tier
+  arrival SHEDs the youngest queued request of the lowest tier below it
+  (victim concludes ``SHED``, callback fires) — and when no lower-tier
+  victim exists the arrival itself is rejected machine-readably.
 
 Failpoints (testing/chaos.py): ``serve.enqueue`` fires in :meth:`submit`
 (a rejected/exploding enqueue must surface to the caller, not wedge the
@@ -35,11 +50,12 @@ it exactly like a genuinely full pool: the request stays queued).
 from __future__ import annotations
 
 import itertools
+import json
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional
 
 from ..testing import chaos
 from ..utils.logging import logger
@@ -51,11 +67,36 @@ from .kv_cache import BlockPool, PrefixCache
 #: window between a finished prefill and its installation into a decode
 #: lane: the request's blocks sit in the block-handoff queue
 #: (serving/disagg.py) with its sampler state (first token, table).
-QUEUED, PREFILL, RUNNING, FINISHED, FAILED, TIMEOUT, HANDOFF = (
+#: SHED (round 19) is the overload ladder's terminal: a queued request
+#: evicted to admit a higher-tier arrival at a hard-full queue — like
+#: TIMEOUT it only ever applies to a QUEUED request and its callback
+#: fires with a machine-readable error.
+QUEUED, PREFILL, RUNNING, FINISHED, FAILED, TIMEOUT, HANDOFF, SHED = (
     "QUEUED", "PREFILL", "RUNNING", "FINISHED", "FAILED", "TIMEOUT",
-    "HANDOFF")
+    "HANDOFF", "SHED")
+
+#: priority tiers (round 19), highest first. Rank 0 dispatches first.
+LATENCY, STANDARD, BATCH = "latency", "standard", "batch"
+PRIORITY_TIERS = (LATENCY, STANDARD, BATCH)
+TIER_RANK = {LATENCY: 0, STANDARD: 1, BATCH: 2}
 
 _rid = itertools.count()
+
+
+class AdmissionRejected(RuntimeError):
+    """Machine-readable admission rejection (round 19 overload ladder).
+
+    Subclasses RuntimeError so callers catching the round-8 full-queue
+    error keep working; ``info`` carries the structured verdict a client
+    can branch on (retry-after vs downgrade-tier vs give-up) and the
+    message embeds it as JSON — never a hang, never a silent drop."""
+
+    def __init__(self, reason: str, tier: str, queue: int, max_queue: int):
+        self.info = {"error": "admission_rejected", "reason": reason,
+                     "tier": tier, "queue": queue, "max_queue": max_queue}
+        super().__init__(
+            f"serving queue full ({queue}/{max_queue}): "
+            + json.dumps(self.info, sort_keys=True))
 
 
 def check_admissible(prompt_tokens: int, max_new_tokens: int,
@@ -98,6 +139,8 @@ class Request:
     #: absolute monotonic deadline; a still-QUEUED request past it is shed
     #: with TIMEOUT at the next admission pass (None = wait forever)
     deadline_ts: Optional[float] = None
+    #: priority tier (round 19): latency | standard | batch
+    priority: str = STANDARD
     rid: int = field(default_factory=lambda: next(_rid))
     # -- filled by the engine -------------------------------------------------
     state: str = QUEUED
@@ -119,7 +162,7 @@ class Request:
 
     @property
     def done(self) -> bool:
-        return self.state in (FINISHED, FAILED, TIMEOUT)
+        return self.state in (FINISHED, FAILED, TIMEOUT, SHED)
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline_ts is None:
@@ -139,6 +182,135 @@ class Request:
                                  "%d raised", self.rid)
 
 
+class TieredQueue:
+    """Priority-tiered FIFO queue (round 19): one deque per tier, highest
+    tier dispatched first, strict FIFO within a tier, and an aging floor
+    — a tier head that has waited longer than ``aging_s`` seconds is
+    served as if it were top-tier, so batch work is deferred, never
+    starved. NOT internally locked: every caller (engine Scheduler,
+    ServingFleet, ProcessFleet) already serializes queue access under its
+    own lock, and a second lock here would only add ordering hazards
+    (graftlint TPU017). With all traffic in one tier this is exactly a
+    deque — the strict-FIFO contract the round-8/11 tests pin."""
+
+    def __init__(self, aging_s: float = 30.0):
+        self.aging_s = float(aging_s)
+        self._tiers: Dict[str, deque] = {t: deque() for t in PRIORITY_TIERS}
+
+    @staticmethod
+    def _tier(req) -> str:
+        t = getattr(req, "priority", STANDARD)
+        return t if t in TIER_RANK else STANDARD
+
+    def append(self, req) -> None:
+        self._tiers[self._tier(req)].append(req)
+
+    def appendleft(self, req) -> None:
+        """Front of the request's OWN tier (requeue-after-death /
+        preemption): it resumes ahead of its peers, not ahead of higher
+        tiers — preempting batch work must not promote it."""
+        self._tiers[self._tier(req)].appendleft(req)
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._tiers.values())
+
+    def __iter__(self) -> Iterator:
+        for t in PRIORITY_TIERS:
+            yield from self._tiers[t]
+
+    def peeknext(self, now: Optional[float] = None):
+        """The ONE logical head: among the three tier heads, the best
+        (effective-rank, arrival) pair. Effective rank is the tier rank
+        unless the head has aged past ``aging_s`` — then it competes at
+        rank 0. Strict head-blocking admission applies to THIS head only
+        (the round-8 fairness pin, per tier)."""
+        if now is None:
+            now = time.monotonic()
+        best_key, best = None, None
+        for tier in PRIORITY_TIERS:
+            q = self._tiers[tier]
+            if not q:
+                continue
+            head = q[0]
+            rank = TIER_RANK[tier]
+            if rank and self.aging_s > 0 and \
+                    (now - head.arrival_ts) > self.aging_s:
+                rank = 0
+            key = (rank, head.arrival_ts, TIER_RANK[tier])
+            if best_key is None or key < best_key:
+                best_key, best = key, head
+        return best
+
+    def popnext(self, now: Optional[float] = None):
+        head = self.peeknext(now)
+        if head is not None:
+            self._tiers[self._tier(head)].popleft()
+        return head
+
+    def remove(self, req) -> bool:
+        """Remove a specific request (admission pop after a peek, or a
+        shed): True iff it was queued."""
+        q = self._tiers[self._tier(req)]
+        try:
+            q.remove(req)
+            return True
+        except ValueError:
+            return False
+
+    def remove_expired(self, now: float) -> List:
+        """Extract every queued request past its deadline (the caller
+        concludes them with TIMEOUT outside its lock)."""
+        expired: List = []
+        for tier, q in self._tiers.items():
+            if any(r.expired(now) for r in q):
+                expired.extend(r for r in q if r.expired(now))
+                self._tiers[tier] = deque(r for r in q if not r.expired(now))
+        return expired
+
+    def shed_victim(self, arriving_rank: int):
+        """The overload ladder's hard-full rung: extract the YOUNGEST
+        queued request of the LOWEST tier strictly below ``arriving_rank``
+        (None when no lower tier has anything — the arrival itself must
+        then be rejected). Youngest-first minimizes wasted queue wait."""
+        for tier in reversed(PRIORITY_TIERS):
+            if TIER_RANK[tier] <= arriving_rank:
+                return None
+            q = self._tiers[tier]
+            if q:
+                return q.pop()
+        return None
+
+    def pressured(self, window_s: float, now: float) -> int:
+        """Deadline pressure: queued requests whose remaining TTL is
+        inside ``window_s`` (the autoscaler's second trigger). 0 when the
+        window is off."""
+        if window_s <= 0:
+            return 0
+        return sum(1 for q in self._tiers.values() for r in q
+                   if r.deadline_ts is not None
+                   and (r.deadline_ts - now) < window_s)
+
+
+def admit_or_shed(tq: TieredQueue, req, max_queue: int,
+                  batch_highwater: float = 1.0):
+    """THE shared admission ladder (engine Scheduler + both fleet
+    placements; caller holds its own queue lock). Appends ``req`` and
+    returns the shed victim to conclude (outside the lock), or raises
+    :class:`AdmissionRejected` — never a hang, never a silent drop."""
+    tier = TieredQueue._tier(req)
+    depth = len(tq)
+    if depth >= max_queue:
+        victim = tq.shed_victim(TIER_RANK[tier])
+        if victim is None:
+            raise AdmissionRejected("queue_full", tier, depth, max_queue)
+        tq.append(req)
+        return victim
+    if tier == BATCH and depth >= batch_highwater * max_queue:
+        raise AdmissionRejected("batch_highwater", tier, depth, max_queue)
+    tq.append(req)
+    return None
+
+
 class Scheduler:
     """FIFO queue + block-budget admission over a shared :class:`BlockPool`.
 
@@ -149,31 +321,39 @@ class Scheduler:
 
     def __init__(self, pool: BlockPool, max_queue: int = 4096,
                  max_model_len: Optional[int] = None,
-                 prefix_cache: Optional[PrefixCache] = None):
+                 prefix_cache: Optional[PrefixCache] = None,
+                 aging_s: float = 30.0, batch_highwater: float = 1.0):
         self.pool = pool
         self.prefix_cache = prefix_cache
         self.max_queue = int(max_queue)
         self.max_model_len = max_model_len
-        self._queue: deque = deque()
+        self._queue = TieredQueue(aging_s=aging_s)
+        self.batch_highwater = float(batch_highwater)
         self._lock = threading.Lock()
         self.timed_out = 0           # requests shed past their deadline
+        self.shed = 0                # requests shed by the overload ladder
 
     # ------------------------------------------------------------ queue side
 
     def submit(self, req: Request) -> Request:
         """Enqueue; raises on a full queue or an over-long request (the
         caller must know synchronously — a silently dropped request is a
-        hung client)."""
+        hung client). At a hard-full queue the round-19 ladder applies:
+        a higher-tier arrival sheds the youngest lowest-tier queued
+        request instead of being rejected (see :func:`admit_or_shed`)."""
         chaos.failpoint("serve.enqueue")
         check_admissible(len(req.prompt), req.max_new_tokens,
                          self.pool.block_size, self.pool.num_blocks,
                          self.max_model_len, label=f"request {req.rid}")
         with self._lock:
-            if len(self._queue) >= self.max_queue:
-                raise RuntimeError(
-                    f"serving queue full ({self.max_queue}); apply "
-                    "backpressure upstream")
-            self._queue.append(req)
+            victim = admit_or_shed(self._queue, req, self.max_queue,
+                                   self.batch_highwater)
+            if victim is not None:
+                self.shed += 1
+        if victim is not None:
+            victim._finish(SHED, error=json.dumps(
+                {"error": "shed", "reason": "displaced_by_tier",
+                 "tier": TieredQueue._tier(victim)}, sort_keys=True))
         return req
 
     def __len__(self) -> int:
@@ -201,11 +381,8 @@ class Scheduler:
         queue lock so an on_finish that resubmits cannot deadlock."""
         now = time.monotonic()
         with self._lock:
-            expired = [r for r in self._queue if r.expired(now)]
-            if expired:
-                self._queue = deque(r for r in self._queue
-                                    if not r.expired(now))
-                self.timed_out += len(expired)
+            expired = self._queue.remove_expired(now)
+            self.timed_out += len(expired)
         for req in expired:
             logger.warning("serving: request %d shed past its deadline "
                            "after %.2fs queued", req.rid,
@@ -222,9 +399,9 @@ class Scheduler:
         prefix-cache eviction before giving up — cached-but-unused
         blocks must never starve admissions."""
         with self._lock:
-            if not self._queue:
+            head = self._queue.peeknext()
+            if head is None:
                 return None
-            head = self._queue[0]
             hit_tokens, hit_key = ((0, None) if self.prefix_cache is None
                                    else self.prefix_cache.peek(head.prompt))
             # budget NET of the prefix hit, and the make-room eviction
@@ -235,8 +412,14 @@ class Scheduler:
                 self.prefix_cache.evict(need, protect=hit_key)
             if need > self.pool.free_count:
                 return None
-            self._queue.popleft()
+            self._queue.remove(head)
             return head
+
+    def withdraw(self, req: Request) -> bool:
+        """Remove a still-queued request without concluding it (the
+        process-fleet cancel path); True iff it was queued here."""
+        with self._lock:
+            return self._queue.remove(req)
 
     def requeue_front(self, req: Request) -> None:
         """Put an admission back at the HEAD (transient allocation failure

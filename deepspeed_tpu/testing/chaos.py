@@ -155,6 +155,10 @@ FAILPOINTS: Dict[str, str] = {
     "serve.replica_kill": "fleet replica worker, hard kill",
     "serve.replica_slow": "fleet replica worker, injected delay",
     "serve.requeue": "fleet, in-flight requeue after replica death",
+    "serve.scale_up": "fleet autoscaler, between slot append and warmed "
+                      "spawn (keyed by new replica index)",
+    "serve.preempt": "fleet preemption, between lane eviction and the "
+                     "victim's requeue",
     "serve.oom": "KV block pool exhaustion",
     "net.connect": "fabric endpoint, per dial attempt (initial + redial)",
     "net.send": "fabric endpoint send, surfaced to the caller unretried",

@@ -32,6 +32,13 @@
 # every request completes token-exact or FAILED-within-retry-budget with
 # the SHARED pool's refcount accounting balanced after recovery, plus
 # handoff backpressure/deadline units and chunk-progress carry.
+# Round 19 adds the traffic-shaping matrices (tests/test_autoscale.py):
+# serve.scale_up crash -> slot rollback with the fleet unchanged,
+# scale-down-during-kill -> death concludes `retired` with exactly-once
+# token-exact requeue and no replacement, serve.preempt crash ->
+# orphan-parked victim resumed token-exact even when its old replica
+# dies in the same window, plus the overload-ladder shed/reject legs and
+# the process-placement autoscale/preempt (slow) legs.
 # Includes the `slow`-marked engine-in-child tests tier-1 skips.
 # See docs/RESILIENCE.md for the failpoint catalog and exit-code contract.
 #
@@ -52,6 +59,7 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_multinode_runner.py \
     tests/test_launcher_elastic.py \
     tests/test_fleet.py \
+    tests/test_autoscale.py \
     tests/test_straggler.py \
     tests/test_disagg.py \
     tests/test_low_precision.py \

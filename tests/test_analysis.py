@@ -1545,6 +1545,11 @@ def test_sarif_marks_suppressed_findings(tmp_path):
     assert res["suppressions"][0]["kind"] == "inSource"
 
 
+# tier-2 (round-19 budget sweep, ~5s): the cheaper tier-1 cousins are
+# test_package_is_lint_clean_against_baseline (same full-package walk,
+# gating verdict) and the per-rule SARIF shape units above;
+# scripts/tier2.sh runs this SARIF-emission twin
+@pytest.mark.slow
 def test_package_sarif_run_is_finding_free(tmp_path):
     """Tier-1 gate (CI shape): the full-package SARIF run carries no
     result without a suppression — every finding is either fixed,

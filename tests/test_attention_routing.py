@@ -143,6 +143,12 @@ def _parity_vs_reference(cfg_kw, batch, flash_spy, seed=0):
     return flash_spy.calls[-1]
 
 
+# tier-2 (round-19 budget sweep, ~5s): the cheaper tier-1 cousins are
+# test_padding_mask_routes_to_kernel (the routing verdict itself) and
+# test_softcap_gemma2_rides_kernel /
+# test_uniform_window_mistral_rides_kernel_under_scan (same
+# model-level ride, other features); scripts/tier2.sh runs this
+@pytest.mark.slow
 def test_masked_bert_rides_kernel(flash_spy):
     """BERT with real padding — the verdict's headline example."""
     rng = np.random.default_rng(10)
@@ -157,6 +163,11 @@ def test_masked_bert_rides_kernel(flash_spy):
     assert kw["mask"] is not None
 
 
+# tier-2 (round-19 budget sweep, ~7s): the cheaper tier-1 cousins are
+# test_alibi_slopes_route_to_kernel (the routing verdict) and
+# test_hf_policies.test_bloom_decode_parity (alibi model math);
+# scripts/tier2.sh runs this model-level ride
+@pytest.mark.slow
 def test_alibi_bloom_rides_kernel(flash_spy):
     """BLOOM-style alibi positions ride as slopes (no [B,H,S,S] bias)."""
     rng = np.random.default_rng(11)
@@ -395,6 +406,11 @@ def test_engine_initializes_with_sparse_attention():
 # pipelined engine: final_logit_softcap is applied (not silently dropped)
 # ---------------------------------------------------------------------------
 
+# tier-2 (round-19 budget sweep, ~5s): the cheaper tier-1 cousins are
+# test_softcap_routes_to_kernel and test_softcap_gemma2_rides_kernel
+# (the softcap feature itself); scripts/tier2.sh runs this
+# pipelined-head plumbing pin
+@pytest.mark.slow
 def test_pipelined_head_applies_final_logit_softcap():
     from deepspeed_tpu.models.pipeline import PipelinedTransformer
     from deepspeed_tpu.models.transformer import (Transformer,

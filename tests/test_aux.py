@@ -119,6 +119,11 @@ def test_compiled_cost_counts_matmul_flops():
     assert cost["flops"] == pytest.approx(expected, rel=0.1)
 
 
+# tier-2 (round-19 budget sweep, ~6s): the cheaper tier-1 cousins are
+# test_compiled_cost_counts_matmul_flops (cost engine) and
+# test_module_flops_breakdown_tree (breakdown walk);
+# scripts/tier2.sh runs this full model-profile leg
+@pytest.mark.slow
 def test_profiler_and_breakdown():
     from deepspeed_tpu.models import build_model
     model, cfg = build_model("gpt2-tiny", hidden_size=32, num_layers=2,

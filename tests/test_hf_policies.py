@@ -97,6 +97,12 @@ def test_hf_bloom_parity():
     np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
 
 
+# tier-2 (round-19 budget sweep, ~7s): the cheaper tier-1 cousins are
+# test_hf_roberta_parity + test_hf_distilbert_parity (same encoder
+# loader family) and test_attention_routing's
+# test_masked_bert_trains_through_kernel; scripts/tier2.sh runs this
+# MLM-head leg
+@pytest.mark.slow
 def test_hf_bert_parity():
     """Post-LN encoder + token types + MLM transform head."""
     hf_cfg = transformers.BertConfig(
@@ -524,6 +530,10 @@ def test_hf_llama_greedy_generate_matches():
     np.testing.assert_array_equal(ours, ref)
 
 
+# tier-2 (round-19 budget sweep, ~4s): the cheaper tier-1 cousins are
+# test_hf_llama_parity + test_hf_mistral_parity (real GQA ratios vs
+# HF); scripts/tier2.sh runs this degenerate-ratio pin
+@pytest.mark.slow
 def test_gqa_matches_mha_when_kv_heads_equal():
     """num_kv_heads == num_heads must be numerically identical to the MHA
     path (the GQA split/repeat degenerates away)."""
@@ -922,6 +932,12 @@ def test_hf_gpt_bigcode_mqa_parity_and_greedy():
                 n_positions=64, multi_query=False)))
 
 
+# tier-2 (round-19 budget sweep, ~7s): the cheaper tier-1 cousins are
+# test_hf_gpt_neox_parity (parallel residual), test_hf_llama_parity
+# (GQA de-interleave) and test_hf_gpt_bigcode_mqa_parity_and_greedy
+# (fused qkv + token-exact greedy); scripts/tier2.sh runs this
+# two-variant falcon leg
+@pytest.mark.slow
 def test_hf_falcon_parity_and_greedy():
     """Falcon (policy 20), both supported variants. 7B-style: shared-LN
     parallel residual + MQA. 40B-style: dual-LN parallel residual + GQA

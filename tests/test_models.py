@@ -24,6 +24,11 @@ def test_forward_shapes():
     assert logits.dtype == jnp.float32
 
 
+# tier-2 (round-19 budget sweep, ~8s): the scanned path gates tier-1
+# end-to-end (test_engine_trains_transformer[0],
+# test_fused_loss_encoder_no_shift); this pin of the unrolled-loop
+# twin runs in scripts/tier2.sh
+@pytest.mark.slow
 def test_scan_and_loop_agree():
     """nn.scan over layers must match the unrolled loop numerically."""
     kw = dict(hidden_size=64, num_layers=3, num_heads=4, vocab_size=128,
@@ -251,6 +256,11 @@ def test_fused_loss_untied_head_matches_dense_path():
         m3.init(jax.random.PRNGKey(0), batch)
 
 
+# tier-2 (round-19 budget sweep, ~9s): the cheaper tier-1 cousins are
+# test_engine_trains_transformer[0] (same training loop, gpt2 preset),
+# test_hf_llama_parity (llama block math) and
+# test_fused_loss_encoder_no_shift (fused CE); scripts/tier2.sh runs this
+@pytest.mark.slow
 def test_llama_preset_trains():
     """The llama-1.1b preset's block recipe (tiny-shaped here) trains
     through the engine with the fused untied-head CE."""

@@ -97,6 +97,11 @@ def test_expert_parallel_apply_matches_local():
 
 # -- transformer integration --------------------------------------------------
 
+# tier-2 (round-19 budget sweep, ~10s): the cheaper tier-1 cousins are
+# the gating units above, test_moe_layer_forward_and_params (layer
+# math) and test_moe_param_accounting; scripts/tier2.sh runs this
+# multi-step training leg
+@pytest.mark.slow
 def test_moe_transformer_trains():
     require_devices(2)
     model, cfg = build_model("gpt2-tiny", hidden_size=64, num_layers=2,

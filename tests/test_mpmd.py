@@ -285,6 +285,11 @@ def _mpmd_engine(piped, schedule="1f1b", loss_fn=causal_lm_loss,
     return engine
 
 
+# tier-2 (round-19 budget sweep, ~11s): the cheaper tier-1 cousins are
+# test_mpmd_executor_matches_autodiff (stage-graph value+grad parity,
+# both schedules) and test_mpmd_engine_loss_parity_vs_spmd_pipeline_engine
+# (model-level loss parity through the engine); scripts/tier2.sh runs this
+@pytest.mark.slow
 def test_mpmd_model_matches_plain_autodiff():
     require_devices(2)
     """pp=2 transformer through the MPMD placement: loss and every grad
@@ -316,8 +321,8 @@ def test_mpmd_engine_trains_and_8step_losses_match_plain_engine():
     identical batches (same init, same gas) step for step.
 
     slow (round-14 budget sweep, 25s): the cheaper tier-1 cousins are
-    test_mpmd_model_matches_plain_autodiff (single-step value+grad
-    parity) and test_two_process_mpmd_two_stage_run (engine e2e)."""
+    test_mpmd_engine_loss_parity_vs_spmd_pipeline_engine (single-step
+    loss parity) and test_two_process_mpmd_two_stage_run (engine e2e)."""
     kw = _tiny_kw()
     piped, cfg = build_pipelined_model("gpt2-tiny", pp=2, n_micro=4, **kw)
     engine = _mpmd_engine(piped)
@@ -389,7 +394,8 @@ def test_mpmd_model_remat_matches_plain_autodiff():
     plain autodiff.
 
     slow (round-14 budget sweep, 13s): the cheaper tier-1 cousin is
-    test_mpmd_model_matches_plain_autodiff (same parity, remat off)."""
+    test_mpmd_executor_matches_autodiff (same parity regime, remat
+    off, stage-graph level)."""
     kw = _tiny_kw(remat=True)
     plain, _ = build_model("gpt2-tiny", scan_layers=True, **kw)
     piped, cfg = build_pipelined_model("gpt2-tiny", pp=2, n_micro=4, **kw)

@@ -258,6 +258,11 @@ def test_onebit_fp16_loss_scaling_composes():
     assert np.isfinite(float(m2["loss"])) and not bool(m2["overflow"])
 
 
+# tier-2 (round-19 budget sweep, ~6s): the cheaper tier-1 cousins are
+# test_onebit_adam_warmup_matches_exact_adam (optimizer math) and
+# test_onebit_lamb_numeric_dp1 (sharded-state numerics);
+# scripts/tier2.sh runs this ZeRO-1 composition leg
+@pytest.mark.slow
 def test_onebit_zero1_composes():
     """onebit + ZeRO-1: optimizer state leaves whose dim0 divides the DP
     world are sharded across it (memory /8 on the big leaves), and the math
@@ -485,6 +490,11 @@ def test_overflow_does_not_consume_schedule_steps():
     assert seen == [0, 1, 1], seen
 
 
+# tier-2 (round-19 budget sweep, ~8s): the cheaper tier-1 cousins are
+# test_zeroone_interval_doubling + test_zeroone_differs_from_onebit
+# (phase machinery) and test_zeroone_engine_program_schedule (program
+# selection); scripts/tier2.sh runs this measured-bytes envelope leg
+@pytest.mark.slow
 def test_zeroone_local_phase_state_memory_model():
     """Post-freeze per-device state bytes must match the documented envelope
     (docs/BENCHMARKS.md 1-bit table): m_local / u / w_err are one

@@ -50,6 +50,12 @@ SERVE_CFG = {"block_size": 16, "pool_blocks": 64, "max_batch": 4,
 # the acceptance-criteria integration leg
 # ---------------------------------------------------------------------------
 
+# tier-2 (round-19 budget sweep, ~6s): the cheaper tier-1 cousins are
+# test_fifo_fairness_under_full_pool + test_admission_eviction_protects
+# _heads_own_prefix (admission/eviction ledger) and the fleet suites'
+# token-exact e2e legs (test_fleet.py, test_autoscale.py);
+# scripts/tier2.sh runs this 9-request staggered matrix
+@pytest.mark.slow
 def test_serving_integration_staggered_token_exact(tiny):
     """>= 8 concurrent requests, staggered arrivals, mixed lengths, greedy:
     token-exact vs sequential generate(), with EXACTLY ONE decode-step
