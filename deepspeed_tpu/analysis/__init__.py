@@ -14,7 +14,12 @@ lock-and-thread model (locks.py) over the same call graph to catch the
 supervision-stack deadlock shapes (lock-order inversion, blocking under
 a lock, unsynchronized shared state, unbounded blocking on exit paths);
 TPU020/TPU021 keep the chaos-failpoint catalog and the exit-code
-contract in sync with their single sources. ``--fix`` autofixes the
+contract in sync with their single sources. TPU022–TPU025 ride a
+resource-lifecycle model (resources.py) that proves every acquired
+pool block, socket, subprocess, thread, heartbeat file and ``.tmp``
+staging dir is released on every failure path — leaks on exception or
+chaos-failpoint paths, unjoined non-daemon threads, double-release and
+use-after-release. ``--fix`` autofixes the
 mechanical rules; ``--sarif`` emits SARIF 2.1.0 for CI PR annotation;
 ``--timing`` prints the per-rule runtime budget. See docs/LINT.md for
 the catalog, architecture and workflows.
@@ -28,6 +33,7 @@ Programmatic use::
 from . import rules as _rules  # noqa: F401  (registers TPU001–TPU010)
 from . import rules_collective as _rules2  # noqa: F401  (TPU011–TPU013)
 from . import rules_concurrency as _rules3  # noqa: F401  (TPU016–TPU021)
+from . import rules_resources as _rules4  # noqa: F401  (TPU022–TPU025)
 from .baseline import Baseline, DEFAULT_BASELINE
 from .callgraph import ProjectIndex
 from .cli import main

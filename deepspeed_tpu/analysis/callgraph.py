@@ -262,11 +262,30 @@ class ProjectIndex:
         if isinstance(f, ast.Attribute):
             if isinstance(f.value, ast.Name) and f.value.id == "self":
                 defs = module.scope._by_name.get(f.attr)
-                target = defs[-1] if defs else None
+                target = self._same_class_def(call, defs) if defs else None
                 return self.node_of.get(target) if target else None
             dotted = self.qualify(module, f)
             return self.resolve_dotted(dotted) if dotted else None
         return None
+
+    @staticmethod
+    def _enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+        cur = getattr(node, "_gl_parent", None)
+        while cur is not None and not isinstance(cur, ast.ClassDef):
+            cur = getattr(cur, "_gl_parent", None)
+        return cur
+
+    def _same_class_def(self, call: ast.Call,
+                        defs: List[ast.AST]) -> Optional[ast.AST]:
+        """``self.m()`` resolution among same-named defs: a method of the
+        CALLING class wins over a free function or another class's method
+        that happens to share the name."""
+        cls = self._enclosing_class(call)
+        if cls is not None:
+            same = [d for d in defs if self._enclosing_class(d) is cls]
+            if same:
+                return same[-1]
+        return defs[-1]
 
     # ------------------------------------------------------- rank guards
 

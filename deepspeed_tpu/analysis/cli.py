@@ -17,6 +17,7 @@ from typing import List, Optional
 from . import rules as _rules  # noqa: F401  (imports register TPU001–010)
 from . import rules_collective as _rules2  # noqa: F401  (TPU011–013)
 from . import rules_concurrency as _rules3  # noqa: F401  (TPU016–021)
+from . import rules_resources as _rules4  # noqa: F401  (TPU022–025)
 from .baseline import Baseline, DEFAULT_BASELINE
 from .core import RULES, Severity, lint_paths
 from .reporters import (report_json, report_rules, report_sarif,
@@ -67,10 +68,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ignore any baseline file")
     p.add_argument("--write-baseline", action="store_true",
                    help="accept current findings into the baseline and exit")
-    p.add_argument("--select", type=_parse_codes, metavar="CODES",
-                   help="run only these rules (comma-separated)")
-    p.add_argument("--ignore", type=_parse_codes, metavar="CODES",
-                   help="skip these rules")
+    p.add_argument("--select", "--rules", type=_parse_codes,
+                   metavar="CODES", dest="select",
+                   help="run only these rules (comma-separated); "
+                        "--rules is an alias for targeted runs")
+    p.add_argument("--ignore", "--exclude-rules", type=_parse_codes,
+                   metavar="CODES", dest="ignore",
+                   help="skip these rules; --exclude-rules is an alias")
     p.add_argument("--show-suppressed", action="store_true",
                    help="also print suppressed/baselined findings")
     p.add_argument("--strict", action="store_true",

@@ -58,6 +58,11 @@ class Finding:
     # (fixes.py), never serialized
     node: Optional[ast.AST] = dataclasses.field(
         default=None, repr=False, compare=False)
+    # secondary sites of a multi-site finding as (path, line, note) —
+    # the acquire behind a leak, the second witness of an inversion, an
+    # evidence chain; reporters surface them (SARIF relatedLocations)
+    related: List[Tuple[str, int, str]] = dataclasses.field(
+        default_factory=list, repr=False, compare=False)
 
     def key(self) -> Tuple[str, str, str, str]:
         """Identity used for baseline matching: stable across pure
@@ -70,7 +75,7 @@ class Finding:
         return hashlib.sha1("\x1f".join(self.key()).encode()).hexdigest()[:12]
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "rule": self.rule,
             "severity": self.severity.label,
             "path": self.path,
@@ -83,6 +88,10 @@ class Finding:
             "baselined": self.baselined,
             "fingerprint": self.fingerprint(),
         }
+        if self.related:
+            d["related"] = [{"path": p, "line": ln, "note": note}
+                            for p, ln, note in self.related]
+        return d
 
     @property
     def gating(self) -> bool:
@@ -105,7 +114,9 @@ class Rule:
         raise NotImplementedError
 
     def finding(self, module: "ModuleInfo", node: ast.AST, message: str,
-                severity: Optional[Severity] = None) -> Finding:
+                severity: Optional[Severity] = None,
+                related: Optional[List[Tuple[str, int, str]]] = None
+                ) -> Finding:
         line = getattr(node, "lineno", 1)
         return Finding(
             rule=self.code,
@@ -117,6 +128,7 @@ class Rule:
             symbol=module.enclosing_qualname(node),
             line_text=module.line_text(line),
             node=node,
+            related=list(related or []),
         )
 
 

@@ -170,14 +170,44 @@ class JitScope:
         return self.static_params.get(fn, set()) | CONVENTIONALLY_STATIC
 
     def resolve_local_def(self, node: ast.AST) -> Optional[ast.AST]:
-        """A Name/Lambda argument -> the local def it references."""
+        """A Name/Lambda argument -> the local def it references,
+        scope-aware: among same-named defs the one visible from the
+        reference wins (innermost enclosing scope outward, Python
+        name-resolution order), not whichever the module-walk met last —
+        two nested helpers both called ``body`` used to collapse onto
+        one of them."""
         if isinstance(node, ast.Lambda):
             return node
         if isinstance(node, ast.Name):
             defs = self._by_name.get(node.id)
-            if defs:
-                return defs[-1]
+            if not defs:
+                return None
+            if len(defs) == 1:
+                return defs[0]
+            return self._visible_def(node, defs)
         return None
+
+    def _visible_def(self, node: ast.AST, defs: List[ast.AST]) -> ast.AST:
+        """Pick among same-named defs by lexical scope: walk the
+        reference's enclosing-function chain innermost-out; the first
+        scope that directly owns a candidate wins. Within one scope the
+        binding live at the reference is the LAST def at or above the
+        reference line (rebinding semantics); a forward reference (a
+        closure calling a def that appears later) falls back to the
+        scope's last def."""
+        enc = self.module.enclosing_function
+        owner = {d: enc(d) for d in defs}
+        scope = enc(node)
+        ref_line = getattr(node, "lineno", 0)
+        while True:
+            cands = [d for d in defs if owner[d] is scope]
+            if cands:
+                prior = [d for d in cands if d.lineno <= ref_line]
+                pool = prior or cands
+                return max(pool, key=lambda d: d.lineno)
+            if scope is None:
+                return defs[-1]
+            scope = enc(scope)
 
     # -- analysis -------------------------------------------------------------
 

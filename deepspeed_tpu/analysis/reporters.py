@@ -99,6 +99,18 @@ def sarif_document(findings: List[Finding], stale: List[dict]) -> dict:
             }],
             "partialFingerprints": {"graftlint/v1": f.fingerprint()},
         }
+        if f.related:
+            # multi-site findings (TPU016's second nesting site, TPU018's
+            # evidence list, TPU022's escaping path, TPU024/025's release
+            # site) carry every site: the PR annotation shows the whole
+            # story, not just the anchor
+            res["relatedLocations"] = [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": rp, "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": rl},
+                },
+                "message": {"text": note},
+            } for rp, rl, note in f.related]
         if f.suppressed or f.baselined:
             res["suppressions"] = [{
                 "kind": "inSource" if f.suppressed else "external",

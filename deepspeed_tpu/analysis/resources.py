@@ -1,0 +1,908 @@
+"""Resource-lifecycle model: who acquires, who must release, on every path.
+
+Rides the project-wide :class:`callgraph.ProjectIndex` the way locks.py
+does for the lock/thread model. A catalog maps *acquire sites* to their
+*release obligations*:
+
+=============  =======================================  ====================
+kind           acquired by                              released by
+=============  =======================================  ====================
+blocks         ``pool.alloc(n)`` / ``pool.fork(b)`` /   ``pool.release(b)``
+               ``prefix_cache.match(p)`` (2nd elt)
+socket         ``socket.socket`` / ``create_connection``  ``.close()`` /
+               / ``.accept()`` (1st elt) / fabric          ``.shutdown()`` /
+               ``SocketEndpoint``/``LocalEndpoint``/       ``with``
+               ``HubConn`` construction
+popen          ``subprocess.Popen(...)``                ``.wait/kill/terminate
+                                                        /communicate``
+thread         ``threading.Thread(target=...)`` +       ``.join()`` (or
+               ``.start()`` (TPU023 only)               ``daemon=True``)
+file           ``open`` / ``os.fdopen`` / ``tempfile.*``  ``.close()`` /
+                                                          ``.cleanup()``
+heartbeat      ``HeartbeatWriter(...)``                 ``.close()`` /
+                                                        ``.stamp_terminal()``
+staging        ``os.makedirs(<tag>.tmp)``               publish (``os.replace
+                                                        /rename``) or
+                                                        quarantine/``rmtree``
+=============  =======================================  ====================
+
+Ownership-transfer exemptions are resolved interprocedurally: a resource
+stored on ``self``/a container, returned or yielded to the caller, or
+handed to a callee that provably discharges its parameter (releases it,
+stores it, re-returns it, or passes it on) is no longer this function's
+obligation.  Calls the index cannot resolve are assumed to take
+ownership — the model prefers a missed leak over a false alarm.
+
+Blind spots (documented in docs/LINT.md): aliasing through containers
+(``pools[i].alloc`` results collected into dicts), dynamically computed
+attribute names, and cross-process handles (an fd inherited by a
+``Popen`` child is invisible here).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .callgraph import FunctionNode, ProjectIndex
+
+# ------------------------------------------------------------------ catalog
+
+#: per-kind release verbs: a call ``N.<verb>()`` (or ``owner.<verb>(N)``
+#: for arg-style kinds) discharges the obligation
+RELEASE_VERBS: Dict[str, Set[str]] = {
+    "blocks": {"release"},
+    "socket": {"close", "shutdown", "detach"},
+    "popen": {"wait", "kill", "terminate", "communicate"},
+    "thread": {"join"},
+    "file": {"close", "cleanup"},
+    "heartbeat": {"close", "stamp_terminal"},
+    "staging": {"replace", "rename", "rmtree"},
+}
+
+#: attribute reads/calls that are legitimate AFTER release (TPU025)
+POST_RELEASE_OK: Dict[str, Set[str]] = {
+    "blocks": set(),
+    "socket": {"close", "fileno", "detach", "shutdown"},
+    "popen": {"poll", "wait", "kill", "terminate", "communicate",
+              "send_signal", "returncode", "pid", "stdout", "stderr",
+              "stdin", "args"},
+    "thread": {"join", "is_alive", "name", "daemon", "ident",
+               "native_id"},
+    "file": {"close", "closed", "name", "mode"},
+    "heartbeat": {"close", "stamp_terminal", "path"},
+    "staging": set(),
+}
+
+#: function-name fragments that count as staging publish/quarantine
+_STAGING_DISCHARGE_FRAGMENTS = ("quarantine", "publish", "promote")
+
+_SOCKET_CTORS = {"socket.socket", "socket.create_connection"}
+_ENDPOINT_CTOR_SUFFIXES = ("SocketEndpoint", "LocalEndpoint", "HubConn")
+_FILE_CTORS = {"open", "os.fdopen", "tempfile.TemporaryFile",
+               "tempfile.NamedTemporaryFile", "tempfile.TemporaryDirectory",
+               "tempfile.mkdtemp"}
+_POOL_ACQUIRE_ATTRS = {"alloc", "fork"}
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_LOOP = (ast.For, ast.AsyncFor, ast.While)
+
+
+def _walk_no_fn(node: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` that does not descend into nested function bodies —
+    code inside a closure/handler def runs on a different path than the
+    statement that defines it."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _FN):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class Acquire:
+    """One catalogued acquire site inside one function."""
+
+    __slots__ = ("kind", "call", "stmt", "name", "fn", "module", "how")
+
+    def __init__(self, kind: str, call: ast.Call, stmt: ast.stmt,
+                 name: Optional[str], fn: Optional[ast.AST], module,
+                 how: str):
+        self.kind = kind
+        self.call = call
+        self.stmt = stmt
+        self.name = name      # simple binding name, or None
+        self.fn = fn
+        self.module = module
+        self.how = how        # human description of the acquire
+
+
+class _Protect:
+    pass
+
+
+_PROTECT = _Protect()
+
+
+class _Break(Exception):
+    """Control-flow signal inside the forward scan: a ``break`` routes
+    the scan past the enclosing loop."""
+
+
+class ResourceModel:
+    """Project-wide resource analysis; build once per lint run via
+    :func:`get_resource_model` (cached on the index, LockModel-style)."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self._fail_memo: Dict[ast.AST, bool] = {}
+        self._discharge_memo: Dict[Tuple[int, str], bool] = {}
+
+    # ----------------------------------------------------- acquire discovery
+
+    def acquires_in(self, module) -> List[Acquire]:
+        out: List[Acquire] = []
+        for call in module.all_calls:
+            kind, how, tuple_idx = self._acquire_kind(module, call)
+            if kind is None:
+                continue
+            stmt = self._stmt_of(call)
+            if stmt is None:
+                continue
+            name = self._binding_name(module, call, stmt, kind, tuple_idx)
+            out.append(Acquire(kind, call, stmt, name,
+                               module.enclosing_function(call), module, how))
+        return out
+
+    def _acquire_kind(self, module, call: ast.Call
+                      ) -> Tuple[Optional[str], str, int]:
+        """(kind, description, tuple-unpack index) or (None, "", 0)."""
+        f = call.func
+        q = module.scope.imports.qualify(f) or ""
+        if q in _SOCKET_CTORS:
+            return "socket", q, 0
+        if q.split(".")[-1] in ("Popen",) and (
+                q in ("Popen", "subprocess.Popen")
+                or q.endswith(".subprocess.Popen")):
+            return "popen", "subprocess.Popen", 0
+        if q in ("Thread", "threading.Thread"):
+            return "thread", "threading.Thread", 0
+        if q in _FILE_CTORS:
+            return "file", q, 0
+        if q.split(".")[-1] == "HeartbeatWriter":
+            return "heartbeat", "HeartbeatWriter", 0
+        if any(q.split(".")[-1] == s for s in _ENDPOINT_CTOR_SUFFIXES):
+            return "socket", q.split(".")[-1], 0
+        if q in ("os.makedirs", "os.mkdir") and self._is_staging_arg(
+                module, call):
+            return "staging", "staging dir (<tag>.tmp)", 0
+        if isinstance(f, ast.Attribute):
+            if f.attr == "accept" and q != "os.accept":
+                return "socket", ".accept()", 0
+            if f.attr in _POOL_ACQUIRE_ATTRS and q not in ("os.fork",):
+                recv = self._expr_text(module, f.value)
+                if "pool" in recv or recv in ("self", ""):
+                    return "blocks", f".{f.attr}() on {recv or 'pool'}", 0
+            if f.attr == "match" and "prefix_cache" in self._expr_text(
+                    module, f.value):
+                return "blocks", "prefix_cache.match (forked refs)", 1
+        return None, "", 0
+
+    def _is_staging_arg(self, module, call: ast.Call) -> bool:
+        if not call.args:
+            return False
+        arg = call.args[0]
+        text = self._expr_text(module, arg)
+        if "STAGING_SUFFIX" in text or ".tmp" in text:
+            return True
+        if isinstance(arg, ast.Name):
+            fn = module.enclosing_function(call)
+            for n in module.nodes_by_fn.get(fn, ()):
+                if isinstance(n, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == arg.id
+                        for t in n.targets):
+                    rhs = self._expr_text(module, n.value)
+                    if "STAGING_SUFFIX" in rhs or ".tmp" in rhs:
+                        return True
+        return False
+
+    @staticmethod
+    def _expr_text(module, node: ast.AST) -> str:
+        try:
+            return ast.get_source_segment(module.source, node) or ""
+        except Exception:
+            return ""
+
+    # ------------------------------------------------------ binding & stmts
+
+    @staticmethod
+    def _stmt_of(node: ast.AST) -> Optional[ast.stmt]:
+        cur = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = getattr(cur, "_gl_parent", None)
+        return cur
+
+    def _binding_name(self, module, call: ast.Call, stmt: ast.stmt,
+                      kind: str, tuple_idx: int) -> Optional[str]:
+        """The simple local name the resource lands in, or None (the
+        analysis then decides between 'discarded' and 'consumed')."""
+        if kind == "staging":
+            arg = call.args[0] if call.args else None
+            return arg.id if isinstance(arg, ast.Name) else None
+        if isinstance(stmt, ast.Assign) and stmt.value is call \
+                and len(stmt.targets) == 1:
+            t = stmt.targets[0]
+            if isinstance(t, ast.Name):
+                return t.id
+            if isinstance(t, ast.Tuple) and tuple_idx < len(t.elts):
+                elt = t.elts[tuple_idx]
+                return elt.id if isinstance(elt, ast.Name) else None
+        return None
+
+    # ------------------------------------------------------- leak (TPU022)
+
+    def check_leak(self, acq: Acquire
+                   ) -> Optional[Tuple[ast.AST, str]]:
+        """None when every path discharges the acquire; else
+        ``(witness_node, why)`` — the first raise-capable site (or the
+        acquire itself) past which the resource is stranded."""
+        module, call, stmt = acq.module, acq.call, acq.stmt
+        if acq.kind == "thread":
+            return None                      # TPU023's domain
+        # acquired directly into a with-item: the runtime releases it
+        wi = self._enclosing_withitem(call)
+        if wi is not None:
+            return None
+        if acq.kind != "staging":
+            shape = self._birth_shape(module, call, stmt)
+            if shape == "transferred":
+                return None
+            if shape == "discarded":
+                return (call, "the handle is discarded at the acquire "
+                              "site — nothing can ever release it")
+            if shape == "consumed":
+                return None                  # flows into an expression the
+                #                              caller owns (conservative)
+        if acq.name is None:
+            return None
+        # releasing a constituent releases the wrapper: HubConn(sock)
+        # is discharged when the handler closes `sock`
+        names = {acq.name} | self._constituent_names(acq)
+        # lexically inside a try whose handler/finally discharges it
+        if self._guarded_by_enclosing_try(module, stmt, names, acq.kind):
+            return None
+        return self._scan_after(module, stmt, acq.name, names, acq.kind)
+
+    @staticmethod
+    def _constituent_names(acq: Acquire) -> Set[str]:
+        if acq.kind == "staging":
+            return set()
+        out: Set[str] = set()
+        for a in list(acq.call.args) + [kw.value for kw in
+                                        acq.call.keywords]:
+            if isinstance(a, ast.Name):
+                out.add(a.id)
+        return out
+
+    def _enclosing_withitem(self, call: ast.Call) -> Optional[ast.withitem]:
+        parent = getattr(call, "_gl_parent", None)
+        return parent if isinstance(parent, ast.withitem) else None
+
+    def _birth_shape(self, module, call: ast.Call, stmt: ast.stmt) -> str:
+        """How the acquire's value leaves the acquiring expression:
+        'bound' (simple name — scan forward), 'transferred' (stored on
+        self/container, returned, yielded), 'discarded' (bare Expr, or a
+        non-release method chained on the fresh handle), 'consumed'
+        (nested in a larger expression — assumed owned there)."""
+        parent = getattr(call, "_gl_parent", None)
+        if isinstance(parent, ast.Assign) and parent.value is call:
+            t = parent.targets[0] if len(parent.targets) == 1 else None
+            if isinstance(t, (ast.Attribute, ast.Subscript)):
+                return "transferred"
+            return "bound"
+        if isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom,
+                               ast.Await)):
+            return "transferred"
+        if isinstance(parent, ast.Expr):
+            return "discarded"
+        if isinstance(parent, ast.Attribute):
+            # method chained on the fresh handle: Popen(...).wait() is a
+            # release; open(...).read() never closes
+            gp = getattr(parent, "_gl_parent", None)
+            if isinstance(gp, ast.Call) and gp.func is parent:
+                kind, _, _ = self._acquire_kind(module, call)
+                if parent.attr in RELEASE_VERBS.get(kind or "", set()) or \
+                        (kind == "thread" and parent.attr == "start"):
+                    return "consumed"
+                return "discarded"
+        return "consumed"
+
+    def _guarded_by_enclosing_try(self, module, stmt: ast.stmt,
+                                  names: Set[str], kind: str) -> bool:
+        cur: Optional[ast.AST] = stmt
+        while cur is not None and not isinstance(cur, _FN):
+            parent = getattr(cur, "_gl_parent", None)
+            if isinstance(parent, ast.Try) and cur in parent.body:
+                cleanup: List[ast.AST] = list(parent.finalbody)
+                cleanup.extend(parent.handlers)
+                for region in cleanup:
+                    if self._region_discharges(module, region, names, kind):
+                        return True
+            cur = parent
+        return False
+
+    def _region_discharges(self, module, region: ast.AST, names: Set[str],
+                           kind: str) -> bool:
+        for n in ast.walk(region):
+            if self._node_discharges(module, n, names, kind):
+                return True
+        return False
+
+    # ---- the forward scan -------------------------------------------------
+
+    def _scan_after(self, module, stmt: ast.stmt, name: str,
+                    names: Set[str], kind: str
+                    ) -> Optional[Tuple[ast.AST, str]]:
+        cur: ast.AST = stmt
+        while True:
+            owner = getattr(cur, "_gl_parent", None)
+            seq = self._containing_block(owner, cur)
+            if seq is not None:
+                i = seq.index(cur)
+                try:
+                    r = self._scan_block(module, seq[i + 1:], name, names,
+                                         kind)
+                except _Break:
+                    cur = self._climb_past_loop(cur)
+                    continue
+                if r is _PROTECT:
+                    return None
+                if r is not None:
+                    return r
+                # fell off a try body: the else-block runs next
+                if isinstance(owner, ast.Try) and seq is owner.body:
+                    try:
+                        r = self._scan_block(module, owner.orelse, name,
+                                             names, kind)
+                    except _Break:
+                        cur = self._climb_past_loop(owner)
+                        continue
+                    if r is _PROTECT:
+                        return None
+                    if r is not None:
+                        return r
+            if owner is None or isinstance(owner, (ast.Module,) + _FN):
+                return (stmt, "no path from here releases or hands off "
+                              "the resource before the function ends")
+            if isinstance(owner, _LOOP) and seq is not None \
+                    and not self._has_break(owner):
+                return (stmt, "the loop iterates without releasing the "
+                              "previous iteration's resource")
+            if isinstance(owner, ast.excepthandler):
+                owner = getattr(owner, "_gl_parent", None)
+            cur = owner
+
+    @staticmethod
+    def _containing_block(owner: Optional[ast.AST], stmt: ast.AST
+                          ) -> Optional[List[ast.stmt]]:
+        if owner is None:
+            return None
+        for field in ("body", "orelse", "finalbody"):
+            seq = getattr(owner, field, None)
+            if isinstance(seq, list) and stmt in seq:
+                return seq
+        return None
+
+    @staticmethod
+    def _climb_past_loop(node: ast.AST) -> ast.AST:
+        cur = node
+        while cur is not None and not isinstance(cur, _LOOP):
+            cur = getattr(cur, "_gl_parent", None)
+        return cur
+
+    @staticmethod
+    def _has_break(loop: ast.AST) -> bool:
+        for n in ast.walk(loop):
+            if isinstance(n, ast.Break):
+                return True
+        return False
+
+    def _scan_block(self, module, stmts: List[ast.stmt], name: str,
+                    names: Set[str], kind: str):
+        """Scan statements in execution order. Returns _PROTECT when the
+        obligation is discharged, a ``(node, why)`` leak witness when a
+        raise-capable site precedes any discharge, or None (keep
+        scanning the enclosing block). Raises :class:`_Break` when an
+        unconditional ``break`` routes control past the loop."""
+        for s in stmts:
+            r = self._classify(module, s, name, names, kind)
+            if r is not None:
+                return r
+        return None
+
+    def _classify(self, module, s: ast.stmt, name: str, names: Set[str],
+                  kind: str):
+        if isinstance(s, ast.Break):
+            raise _Break()
+        if isinstance(s, (ast.Continue,)):
+            return (s, "the loop continues without releasing the resource")
+        if isinstance(s, (ast.Return, ast.Yield)) or (
+                isinstance(s, ast.Expr)
+                and isinstance(s.value, (ast.Yield, ast.YieldFrom))):
+            if self._mentions_any(s, names):
+                return _PROTECT          # ownership handed to the caller
+            return (s, "the function returns without releasing the "
+                       "resource")
+        if isinstance(s, ast.Raise):
+            return (s, "raises with the resource still held")
+        if isinstance(s, ast.Assert):
+            return (s, "a failing assert strands the resource")
+        if isinstance(s, ast.Try):
+            for region in list(s.finalbody) + list(s.handlers):
+                if self._region_discharges(module, region, names, kind):
+                    return _PROTECT
+            r = self._scan_block(module, s.body, name, names, kind)
+            if r is not None:
+                return r
+            r = self._scan_block(module, s.orelse, name, names, kind)
+            if r is not None:
+                return r
+            return self._scan_block(module, s.finalbody, name, names, kind)
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                if self._mentions_any(item.context_expr, names):
+                    return _PROTECT      # `with sock:` / `with closing(s)`
+            return self._scan_block(module, s.body, name, names, kind)
+        if isinstance(s, (ast.If,) + _LOOP):
+            # optimistic on branches: a discharge anywhere inside counts
+            if self._region_discharges(module, s, names, kind):
+                return _PROTECT
+            return self._risky_in(module, s, name, kind)
+        # simple statement: discharge first, then raise-capability
+        if self._region_discharges(module, s, names, kind):
+            return _PROTECT
+        if self._reassigns(s, name):
+            return _PROTECT              # binding reset: tracking ends
+        return self._risky_in(module, s, name, kind)
+
+    # ---- event classification --------------------------------------------
+
+    @staticmethod
+    def _mentions(node: ast.AST, name: str) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id == name:
+                return True
+        return False
+
+    @staticmethod
+    def _mentions_any(node: ast.AST, names: Set[str]) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id in names:
+                return True
+        return False
+
+    @staticmethod
+    def _reassigns(s: ast.stmt, name: str) -> bool:
+        targets: List[ast.AST] = []
+        if isinstance(s, ast.Assign):
+            targets = s.targets
+        elif isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+            targets = [s.target]
+        elif isinstance(s, ast.Delete):
+            targets = s.targets
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name) and n.id == name:
+                    return True
+        return False
+
+    def _node_discharges(self, module, n: ast.AST, names: Set[str],
+                         kind: str) -> bool:
+        """Does this single node discharge the obligation on any of
+        ``names`` (the binding plus its constituent aliases)?"""
+        if isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                and n is not None and self._mentions_any(n, names):
+            return True
+        if isinstance(n, ast.Assign):
+            if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                   for t in n.targets) and \
+                    self._mentions_any(n.value, names):
+                return True                  # stored on self / a container
+            if any(isinstance(t, (ast.Name, ast.Tuple))
+                   for t in n.targets) and \
+                    self._mentions_any(n.value, names):
+                return True                  # aliased: tracking moves on
+        if isinstance(n, ast.Call):
+            return self._call_discharges(module, n, names, kind)
+        return False
+
+    def _call_discharges(self, module, call: ast.Call, names: Set[str],
+                         kind: str) -> bool:
+        f = call.func
+        # N.release_verb()
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id in names:
+            return f.attr in RELEASE_VERBS[kind]
+        if not self._arg_mentions_any(call, names):
+            return False
+        last = ""
+        if isinstance(f, ast.Attribute):
+            last = f.attr
+        elif isinstance(f, ast.Name):
+            last = f.id
+        if kind == "staging":
+            # path strings flow through join/open constantly; only the
+            # publish/quarantine vocabulary discharges a staging dir
+            return (last in RELEASE_VERBS["staging"]
+                    or any(fr in last.lower()
+                           for fr in _STAGING_DISCHARGE_FRAGMENTS))
+        if last in RELEASE_VERBS[kind]:
+            return True                      # pool.release(blocks) style
+        # handed to a callee: ownership transfer — unless the callee is
+        # resolvable and provably does NOT discharge its parameter
+        fnode = self.index.resolve_call(module, call)
+        if fnode is None:
+            return True
+        for name in names:
+            if not self._arg_mentions(call, name):
+                continue
+            pname = self._param_for_arg(fnode, call, name)
+            if pname is None:
+                return True
+            if self._param_discharged(fnode, pname, depth=3):
+                return True
+        return False
+
+    @staticmethod
+    def _arg_mentions(call: ast.Call, name: str) -> bool:
+        for sub in list(call.args) + [kw.value for kw in call.keywords]:
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Name) and n.id == name:
+                    return True
+        return False
+
+    @staticmethod
+    def _arg_mentions_any(call: ast.Call, names: Set[str]) -> bool:
+        for sub in list(call.args) + [kw.value for kw in call.keywords]:
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Name) and n.id in names:
+                    return True
+        return False
+
+    @staticmethod
+    def _param_for_arg(fnode: FunctionNode, call: ast.Call,
+                       name: str) -> Optional[str]:
+        """Callee parameter the argument ``name`` binds to (best effort;
+        None = unknown, treated as a discharge)."""
+        fn = fnode.fn
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        params = [a.arg for a in fn.args.args]
+        offset = 1 if params and params[0] in ("self", "cls") else 0
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Name) and a.id == name:
+                j = i + offset
+                return params[j] if j < len(params) else None
+        for kw in call.keywords:
+            if isinstance(kw.value, ast.Name) and kw.value.id == name:
+                return kw.arg
+        return None                          # nested in a bigger expression
+
+    def _param_discharged(self, fnode: FunctionNode, pname: str,
+                          depth: int) -> bool:
+        """Does the callee release / store / re-return / pass on its
+        parameter? Memoized; unresolvable onward calls count as yes."""
+        key = (id(fnode.fn), pname)
+        if key in self._discharge_memo:
+            return self._discharge_memo[key]
+        self._discharge_memo[key] = True     # cycle guard: optimistic
+        module, fn = fnode.module, fnode.fn
+        result = False
+        all_verbs = set().union(*RELEASE_VERBS.values())
+        for n in module.fn_nodes(fn, subtree=True):
+            if isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if self._mentions(n, pname):
+                    result = True
+                    break
+            elif isinstance(n, ast.Assign):
+                if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in n.targets) and \
+                        self._mentions(n.value, pname):
+                    result = True
+                    break
+            elif isinstance(n, (ast.With, ast.AsyncWith)):
+                if any(self._mentions(item.context_expr, pname)
+                       for item in n.items):
+                    result = True
+                    break
+            elif isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == pname:
+                    if f.attr in all_verbs:
+                        result = True
+                        break
+                    continue
+                if not self._arg_mentions(n, pname):
+                    continue
+                callee = self.index.resolve_call(module, n)
+                if callee is None:
+                    result = True            # handed onward, unresolvable
+                    break
+                if depth <= 0:
+                    result = True
+                    break
+                nxt = self._param_for_arg(callee, n, pname)
+                if nxt is None or self._param_discharged(
+                        callee, nxt, depth - 1):
+                    result = True
+                    break
+        self._discharge_memo[key] = result
+        return result
+
+    def _risky_in(self, module, node: ast.AST, name: str, kind: str
+                  ) -> Optional[Tuple[ast.AST, str]]:
+        """First raise-capable site in the subtree, as (node, why).
+        Nested function bodies are pruned: a raise inside a closure
+        fires on the closure's path, not this one."""
+        for n in _walk_no_fn(node):
+            if isinstance(n, ast.Raise):
+                return (n, "raises with the resource still held")
+            if isinstance(n, ast.Assert):
+                return (n, "a failing assert strands the resource")
+            if isinstance(n, ast.Call):
+                why = self._call_risk(module, n, name, kind)
+                if why is not None:
+                    return (n, why)
+        return None
+
+    def _call_risk(self, module, call: ast.Call, name: str,
+                   kind: str) -> Optional[str]:
+        f = call.func
+        q = module.scope.imports.qualify(f) or ""
+        if self._is_failpoint(q):
+            return "a keyed chaos failpoint fires here with the " \
+                   "resource still held"
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == name and \
+                f.attr not in RELEASE_VERBS[kind]:
+            return (f"'{name}.{f.attr}()' can raise before the resource "
+                    "is released or handed off")
+        fnode = self.index.resolve_call(module, call)
+        if fnode is not None and self._reaches_failpoint(fnode, depth=3):
+            return (f"callee '{fnode.qualname}' reaches a chaos "
+                    "failpoint with the resource still held")
+        return None
+
+    @staticmethod
+    def _is_failpoint(q: str) -> bool:
+        return q.endswith("chaos.failpoint") or q.endswith("chaos.flag")
+
+    def _reaches_failpoint(self, fnode: FunctionNode, depth: int) -> bool:
+        fn = fnode.fn
+        if fn in self._fail_memo:
+            return self._fail_memo[fn]
+        self._fail_memo[fn] = False          # cycle guard
+        module = fnode.module
+        result = False
+        for n in module.fn_nodes(fn, subtree=False):
+            if not isinstance(n, ast.Call):
+                continue
+            q = module.scope.imports.qualify(n.func) or ""
+            if self._is_failpoint(q):
+                result = True
+                break
+            if depth > 0:
+                callee = self.index.resolve_call(module, n)
+                if callee is not None and self._reaches_failpoint(
+                        callee, depth - 1):
+                    result = True
+                    break
+        self._fail_memo[fn] = result
+        return result
+
+    # ---------------------------------------------------- threads (TPU023)
+
+    def thread_leaks(self, module
+                     ) -> Iterator[Tuple[ast.Call, str, Optional[str]]]:
+        """Non-daemon ``Thread(target=...)`` that is started but joined
+        nowhere: ``(ctor_call, description, owning_attr)``."""
+        for call in module.all_calls:
+            q = module.scope.imports.qualify(call.func) or ""
+            if q not in ("Thread", "threading.Thread"):
+                continue
+            if self._kw_true(call, "daemon"):
+                continue
+            fn = module.enclosing_function(call)
+            stmt = self._stmt_of(call)
+            name = self._binding_name(module, call, stmt, "thread", 0) \
+                if stmt is not None else None
+            # chained `Thread(...).start()` with no binding
+            parent = getattr(call, "_gl_parent", None)
+            chained_start = (isinstance(parent, ast.Attribute)
+                             and parent.attr == "start")
+            if name is None and not chained_start:
+                continue                     # consumed elsewhere: assume
+                #                              the new owner joins it
+            started, joined, daemon_later, attr = \
+                self._thread_fate(module, fn, name) if name else \
+                (True, False, False, None)
+            if chained_start:
+                started = True
+            if not started or daemon_later or joined:
+                continue
+            if attr is not None and self._attr_joined(module, attr):
+                continue
+            if attr is None and name is not None and \
+                    self._escapes(module, fn, name):
+                continue                     # handed to a ledger/supervisor
+            yield call, q, attr
+
+    @staticmethod
+    def _kw_true(call: ast.Call, kwname: str) -> bool:
+        for kw in call.keywords:
+            if kw.arg == kwname and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return False
+
+    def _thread_fate(self, module, fn, name
+                     ) -> Tuple[bool, bool, bool, Optional[str]]:
+        started = joined = daemon_later = False
+        attr: Optional[str] = None
+        for n in module.fn_nodes(fn, subtree=True):
+            if isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Attribute) and isinstance(
+                    n.func.value, ast.Name) and n.func.value.id == name:
+                if n.func.attr == "start":
+                    started = True
+                elif n.func.attr == "join":
+                    joined = True
+                elif n.func.attr == "setDaemon":
+                    daemon_later = True
+            elif isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(n.value, ast.Name) and \
+                            n.value.id == name:
+                        attr = t.attr
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr == "daemon" and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == name:
+                        daemon_later = True
+        return started, joined, daemon_later, attr
+
+    @staticmethod
+    def _attr_joined(module, attr: str) -> bool:
+        """``<anything>.<attr>.join(...)`` anywhere in the module — the
+        registered owner's teardown discharges the join obligation."""
+        for call in module.all_calls:
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr == "join" and \
+                    isinstance(f.value, ast.Attribute) and \
+                    f.value.attr == attr:
+                return True
+        return False
+
+    def _escapes(self, module, fn, name: str) -> bool:
+        """The binding leaves the function (returned, stored, passed)."""
+        for n in module.fn_nodes(fn, subtree=True):
+            if self._node_discharges(module, n, {name}, "thread"):
+                return True
+        return False
+
+    # --------------------------------------- double release / use-after-free
+
+    def release_events(self, module, fn
+                       ) -> List[Tuple[ast.stmt, ast.Call, str, str]]:
+        """Statement-level release calls in ``fn``, in source order:
+        ``(stmt, call, name, kind_hint)``. Only unconditional statements
+        (direct ``Expr`` children of a block) participate — conditional
+        releases are path-dependent and stay out of TPU024/TPU025."""
+        out: List[Tuple[ast.stmt, ast.Call, str, str]] = []
+        for n in module.nodes_by_fn.get(fn, ()):
+            if not (isinstance(n, ast.Expr)
+                    and isinstance(n.value, ast.Call)):
+                continue
+            call = n.value
+            f = call.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            # N.verb()
+            if isinstance(f.value, ast.Name):
+                kind = self._verb_kind(f.attr)
+                if kind is not None:
+                    out.append((n, call, f.value.id, kind))
+                    continue
+            # owner.release(N) — arg-style (block lists)
+            if f.attr == "release":
+                for a in call.args:
+                    if isinstance(a, ast.Name):
+                        out.append((n, call, a.id, "blocks"))
+        out.sort(key=lambda t: (t[0].lineno, t[0].col_offset))
+        return out
+
+    @staticmethod
+    def _verb_kind(attr: str) -> Optional[str]:
+        # verbs unique enough to imply a resource kind; `wait`/`join`
+        # are idempotent and excluded from the double-release check
+        if attr == "close":
+            return "socket"                  # socket/file/endpoint family
+        if attr == "cleanup":
+            return "file"
+        if attr == "stamp_terminal":
+            return "heartbeat"
+        return None
+
+    def double_releases(self, module
+                        ) -> Iterator[Tuple[ast.Call, ast.Call, str]]:
+        for fn in module.nodes_by_fn:
+            events = self.release_events(module, fn)
+            seen: Dict[Tuple[int, str], Tuple[ast.stmt, ast.Call]] = {}
+            for stmt, call, name, kind in events:
+                owner = getattr(stmt, "_gl_parent", None)
+                # key on the BLOCK (body vs orelse are different paths
+                # through the same If node), not the owning node
+                seq = self._containing_block(owner, stmt)
+                key = (id(seq) if seq is not None else id(owner), name)
+                if key in seen:
+                    prev_stmt, prev_call = seen[key]
+                    if not self._rebound_between(module, fn, prev_stmt,
+                                                 stmt, name):
+                        yield prev_call, call, name
+                        continue
+                seen[key] = (stmt, call)
+        return
+
+    def _rebound_between(self, module, fn, a: ast.stmt, b: ast.stmt,
+                         name: str) -> bool:
+        for n in module.nodes_by_fn.get(fn, ()):
+            ln = getattr(n, "lineno", None)
+            if ln is None or not (a.lineno < ln <= b.lineno):
+                continue
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                              ast.Delete)) and self._reassigns(n, name):
+                return True
+        return False
+
+    def use_after_release(self, module
+                          ) -> Iterator[Tuple[ast.Call, ast.AST, str, str]]:
+        """``(release_call, use_node, name, verb)`` for a touch of the
+        handle after an unconditional release in the same block."""
+        for fn in module.nodes_by_fn:
+            for stmt, call, name, kind in self.release_events(module, fn):
+                owner = getattr(stmt, "_gl_parent", None)
+                seq = self._containing_block(owner, stmt)
+                if seq is None:
+                    continue
+                post_ok = POST_RELEASE_OK.get(kind, set()) \
+                    | RELEASE_VERBS.get(kind, set())
+                for sib in seq[seq.index(stmt) + 1:]:
+                    if self._reassigns(sib, name):
+                        break
+                    use = self._first_active_use(sib, name, post_ok)
+                    if use is not None:
+                        yield call, use, name, use.func.attr
+                        break
+
+    @staticmethod
+    def _first_active_use(node: ast.AST, name: str,
+                          post_ok: Set[str]) -> Optional[ast.Call]:
+        for n in _walk_no_fn(node):
+            if isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Attribute) and isinstance(
+                    n.func.value, ast.Name) and \
+                    n.func.value.id == name and \
+                    n.func.attr not in post_ok:
+                return n
+        return None
+
+
+def get_resource_model(index: ProjectIndex) -> ResourceModel:
+    model = getattr(index, "_gl_resource_model", None)
+    if model is None:
+        model = ResourceModel(index)
+        index._gl_resource_model = model
+    return model

@@ -75,7 +75,10 @@ class LockOrderRule(Rule):
                 f"{m2.rel_path}:{node2.lineno} holds {model.short(b)} "
                 f"and takes {model.short(a)} ({detail2}) — interleaved, "
                 f"the two threads deadlock; swap the nesting or bound "
-                f"one acquisition with a timeout")
+                f"one acquisition with a timeout",
+                related=[(m2.rel_path, node2.lineno,
+                          f"opposite nesting: {qual2} holds "
+                          f"{model.short(b)} and takes {model.short(a)}")])
 
 
 @register
@@ -267,7 +270,10 @@ class SharedStateRule(Rule):
                 f"and {kind} at {_where(r)} with no common lock: threads "
                 f"from different entries race on it — guard both sides "
                 f"with one lock, or suppress with a justification if the "
-                f"race is benign")
+                f"race is benign",
+                related=[(r["module"].rel_path, r["node"].lineno,
+                          f"racing {kind} of {model.short(cid)}.{attr} "
+                          f"(no common lock)")])
 
 
 @register

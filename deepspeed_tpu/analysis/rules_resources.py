@@ -1,0 +1,176 @@
+"""graftlint rules TPU022–TPU025: resource-lifecycle safety.
+
+The four rules consume the resource model (resources.py) the way
+TPU016–TPU019 consume the lock model: the model catalogs acquire sites
+(pool blocks, sockets/endpoints, Popen handles, threads, file handles,
+heartbeat writers, checkpoint staging dirs), resolves ownership-transfer
+exemptions interprocedurally, and the rules pattern-match the four leak
+shapes the chaos matrix only samples:
+
+TPU022  leak-on-exception-path — an acquire whose release is not
+        dominated by ``with``/``try-finally``/ownership transfer, so a
+        mid-body raise (every keyed chaos failpoint counts) strands it;
+TPU023  unjoined non-daemon thread (blocks interpreter shutdown);
+TPU024  double-release of the same handle on one straight-line path;
+TPU025  use of a handle after its release/close/kill on the same path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .core import Finding, ModuleInfo, Rule, Severity, register
+from .resources import ResourceModel, get_resource_model
+
+
+def _rmodel(module: ModuleInfo) -> Optional[ResourceModel]:
+    if module.project is None:
+        return None
+    return get_resource_model(module.project)
+
+
+@register
+class ResourceLeakRule(Rule):
+    """TPU022 — resource leaked on an exception (or fall-through) path.
+
+    Every catalogued acquire must be *dominated* by a discharge: a
+    ``with`` block, a ``try`` whose handler/finally releases it, an
+    ownership transfer (stored on ``self``/a container, returned,
+    yielded, handed to a callee that provably discharges its parameter),
+    or a plain release before the first raise-capable site. Raise-capable
+    means a ``raise``/``assert``, a keyed chaos failpoint (the TPU020
+    catalog enumerates exactly the sites the chaos matrix can fire), a
+    call that transitively reaches one, or a method call on the fresh
+    handle itself. An acquire whose handle is discarded outright
+    (``open(p).read()``, a bare ``Popen(...)``) is the degenerate case.
+
+    The fix is one of: move the acquire into a ``with``, wrap the risky
+    region in ``try/except``+release, or transfer ownership *before*
+    the risky call — never a baseline entry: the gate stays at zero.
+    """
+
+    code = "TPU022"
+    name = "resource-leak-on-exception-path"
+    severity = Severity.WARNING
+    summary = "acquired resource not released on every failure path"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        model = _rmodel(module)
+        if model is None:
+            return
+        for acq in model.acquires_in(module):
+            leak = model.check_leak(acq)
+            if leak is None:
+                continue
+            witness, why = leak
+            handle = f"'{acq.name}'" if acq.name else "the handle"
+            msg = (f"{acq.kind} resource from {acq.how} leaks: {why} "
+                   f"— release {handle} in a finally/handler, use "
+                   f"'with', or transfer ownership before the risky "
+                   f"region")
+            related = []
+            if witness is not acq.call and witness is not acq.stmt:
+                related.append((module.rel_path,
+                                getattr(witness, "lineno", acq.stmt.lineno),
+                                f"escaping path: {why}"))
+            yield self.finding(module, acq.call, msg, related=related)
+
+
+@register
+class UnjoinedThreadRule(Rule):
+    """TPU023 — non-daemon thread started but never joined.
+
+    A non-daemon thread nobody joins blocks interpreter shutdown: the
+    process wedges in ``threading._shutdown`` exactly where the TPU016
+    exit-root machinery proved the teardown path runs. A join counts
+    when it is local, performed on the ``self`` attribute the thread was
+    registered on (any method of the owning module — the registered
+    owner's teardown), or when ownership escapes to a supervisor/ledger.
+    ``daemon=True`` (at construction, via ``t.daemon = True`` or
+    ``setDaemon``) waives the obligation.
+    """
+
+    code = "TPU023"
+    name = "unjoined-non-daemon-thread"
+    severity = Severity.WARNING
+    summary = "non-daemon thread started but joined nowhere"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        model = _rmodel(module)
+        if model is None:
+            return
+        for call, ctor, attr in model.thread_leaks(module):
+            where = (f"stored on self.{attr} but no '.{attr}.join()' "
+                     f"exists in this module" if attr else
+                     "never joined in the creating function")
+            yield self.finding(
+                module, call,
+                f"non-daemon {ctor} is started but {where} — join it on "
+                f"the shutdown path, mark it daemon=True, or hand it to "
+                f"a supervisor that joins it")
+
+
+@register
+class DoubleReleaseRule(Rule):
+    """TPU024 — the same handle released twice on one path.
+
+    Two unconditional releases of one binding in the same statement
+    block, with no rebind between: the second is dead at best
+    (``close()``) and a crash at worst (``BlockPool.release`` raises
+    ``ValueError`` on an unallocated id, so a double block release takes
+    down the serving loop that was supposed to be recovering). Guarded
+    or cross-branch releases are out of scope — only straight-line
+    duplicates are certain enough to gate. Popen ``terminate→wait→kill``
+    escalation chains are exempt by catalog.
+    """
+
+    code = "TPU024"
+    name = "double-release"
+    severity = Severity.ERROR
+    summary = "same handle released twice on one straight-line path"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        model = _rmodel(module)
+        if model is None:
+            return
+        for first, second, name in model.double_releases(module):
+            yield self.finding(
+                module, second,
+                f"'{name}' is already released at line {first.lineno}; "
+                f"this second release on the same path is dead code or "
+                f"a crash (refcounted pools raise on double release)",
+                related=[(module.rel_path, first.lineno,
+                          f"first release of '{name}'")])
+
+
+@register
+class UseAfterReleaseRule(Rule):
+    """TPU025 — handle used after its release on the same path.
+
+    Touching a socket/endpoint after ``close()``, a file after
+    ``close()``, or forking released pool blocks is at best an
+    ``OSError`` at the worst moment and at worst silent corruption (a
+    released block id may already belong to another sequence). Per-kind
+    vocabularies keep the reaping idioms quiet: ``poll``/``wait`` after
+    ``kill`` is how a Popen is reaped; a second ``close`` is TPU024's
+    business, not this rule's.
+    """
+
+    code = "TPU025"
+    name = "use-after-release"
+    severity = Severity.ERROR
+    summary = "handle used after release/close/kill on the same path"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        model = _rmodel(module)
+        if model is None:
+            return
+        for release, use, name, verb in model.use_after_release(module):
+            yield self.finding(
+                module, use,
+                f"'{name}.{verb}()' after '{name}' was released at line "
+                f"{release.lineno} — the handle is dead on this path; "
+                f"reorder the use or re-acquire first",
+                related=[(module.rel_path, release.lineno,
+                          f"'{name}' released here")])

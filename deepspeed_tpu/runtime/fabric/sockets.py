@@ -89,13 +89,21 @@ class SocketEndpoint(Endpoint):
                         f"{self.addr}: {last_err}")
                 RedialPolicy(base=0.05, cap=0.5).sleep(attempt)
                 attempt += 1
-        sock.settimeout(None)
         self._sock = sock
         try:
+            sock.settimeout(None)
             write_frame(sock, {"cmd": "hello", "ident": self.ident,
                                **self._hello})
             welcome = self._read_until_welcome(
                 max(0.1, deadline - time.monotonic()))
+        except ChannelTimeout:
+            # no welcome: the half-open socket must not outlive the
+            # failed handshake — a leaked fd per redial attempt adds up
+            try:
+                sock.close()
+            finally:
+                self._sock = None
+            raise
         except OSError as e:
             try:
                 sock.close()

@@ -114,16 +114,24 @@ def _save_stage_state(ckpt_dir: str, done: int, state) -> None:
     <tag>.tmp, digest + completion marker, atomic publish, latest."""
     import json
     from ...checkpointing import (META_FILE, STAGING_SUFFIX, publish_tag,
-                                  save_tree, write_completion_marker,
-                                  write_latest)
+                                  quarantine_staging, save_tree,
+                                  write_completion_marker, write_latest)
     tag = f"{_TAG}{done}"
     stage_dir = os.path.join(ckpt_dir, tag + STAGING_SUFFIX)
     os.makedirs(stage_dir, exist_ok=True)
-    save_tree(state, os.path.join(stage_dir, "model_states.npz"))
-    with open(os.path.join(stage_dir, META_FILE), "w") as f:
-        json.dump({"step": done, "stage_checkpoint": True}, f)
-    write_completion_marker(stage_dir, num_shards=1)
-    publish_tag(ckpt_dir, tag)
+    try:
+        save_tree(state, os.path.join(stage_dir, "model_states.npz"))
+        with open(os.path.join(stage_dir, META_FILE), "w") as f:
+            json.dump({"step": done, "stage_checkpoint": True}, f)
+        write_completion_marker(stage_dir, num_shards=1)
+        publish_tag(ckpt_dir, tag)
+    except BaseException as e:
+        # a torn save (chaos ckpt.* failpoints, full disk, preemption)
+        # must not strand <tag>.tmp where the next save's makedirs would
+        # merge fresh shards into stale ones — same discipline as the
+        # trainer's save path
+        quarantine_staging(stage_dir, reason=f"stage save failed: {e!r}")
+        raise
     write_latest(ckpt_dir, tag)
 
 

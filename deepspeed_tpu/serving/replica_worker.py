@@ -82,19 +82,7 @@ def cfg_from_dict(d: Dict[str, Any]):
 # ------------------------------------------------------------------- the loop
 
 def run_worker(args) -> int:
-    import jax
-    import jax.numpy as jnp
-
-    from ..exit_codes import PREEMPTION_EXIT_CODE
-    from ..models.transformer import build_model
-    from ..runtime.checkpointing import load_tree
-    from ..runtime.fabric import (ChannelClosed, ChannelTimeout,
-                                  RedialPolicy, SocketEndpoint)
-    from ..runtime.heartbeat import (PHASE_EXIT, PHASE_INIT, PHASE_PREEMPTED,
-                                     PHASE_SERVE, HeartbeatWriter)
-    from ..testing import chaos
-    from .engine import ServingEngine
-    from .scheduler import FINISHED
+    from ..runtime.heartbeat import PHASE_EXIT, HeartbeatWriter
 
     idx = int(args.replica)
     hb = None
@@ -105,6 +93,31 @@ def run_worker(args) -> int:
         hb = HeartbeatWriter(args.hb_dir, rank=idx,
                              min_interval=float(args.hb_interval),
                              refresh_interval=1.0)
+    # everything past the writer's birth runs under its terminal-stamp
+    # finally: a crash during model load / warmup must stamp EXIT, not
+    # strand a stale INIT record the hub has to time out on
+    try:
+        return _run_worker_inner(args, idx, hb)
+    finally:
+        if hb is not None:
+            hb.stamp_terminal(PHASE_EXIT, lock_timeout=2.0)
+
+
+def _run_worker_inner(args, idx, hb) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from ..exit_codes import PREEMPTION_EXIT_CODE
+    from ..models.transformer import build_model
+    from ..runtime.checkpointing import load_tree
+    from ..runtime.fabric import (ChannelClosed, ChannelTimeout,
+                                  RedialPolicy, SocketEndpoint)
+    from ..runtime.heartbeat import PHASE_INIT, PHASE_PREEMPTED, PHASE_SERVE
+    from ..testing import chaos
+    from .engine import ServingEngine
+    from .scheduler import FINISHED
+
+    if hb is not None:
         hb.write(PHASE_INIT, 0, force=True, extra={"pid": os.getpid()})
 
     def on_sigterm(signum, frame):
@@ -140,6 +153,21 @@ def run_worker(args) -> int:
         redial=RedialPolicy(attempts=int(args.redial_attempts),
                             base=0.05, dial_timeout=5.0),
         fence=True)
+    try:
+        return _serve_loop(args, idx, hb, ep, eng, chaos,
+                           ChannelTimeout, ChannelClosed, FINISHED,
+                           PHASE_SERVE)
+    finally:
+        # the ready-send and first stamp can raise too: the endpoint
+        # closes on EVERY exit, not just the serve loop's
+        try:
+            ep.close()
+        except OSError:
+            pass
+
+
+def _serve_loop(args, idx, hb, ep, eng, chaos, ChannelTimeout,
+                ChannelClosed, FINISHED, PHASE_SERVE) -> int:
     ep.send({"cmd": "ready", "pid": os.getpid()}, key=str(idx))
 
     inflight: Dict[int, tuple] = {}    # rid -> (engine req, base)
@@ -250,13 +278,6 @@ def run_worker(args) -> int:
         # hub gone and the redial ladder exhausted: nothing to serve
         # into — exit clean; the hub (if any) holds the requeue ledger
         rc = 0
-    finally:
-        try:
-            ep.close()
-        except OSError:
-            pass
-        if hb is not None:
-            hb.stamp_terminal(PHASE_EXIT, lock_timeout=2.0)
     return rc
 
 

@@ -8,6 +8,8 @@
 #   scripts/lint.sh --sarif out.sarif # additionally write SARIF 2.1.0 (CI PR annotation)
 #   scripts/lint.sh --fix             # apply autofixes, then lint
 #   scripts/lint.sh --timing          # per-rule wall time on stderr
+#   scripts/lint.sh --rules TPU022,TPU023        # only these rules
+#   scripts/lint.sh --exclude-rules TPU016       # all but these
 #
 # The checked-in baseline (.graftlint.json) is applied automatically; a
 # finding not in the baseline and not suppressed inline fails the run.
@@ -26,6 +28,8 @@ while [[ $# -gt 0 ]]; do
     --sarif) EXTRA+=("--sarif" "$2"); shift 2 ;;
     --fix) EXTRA+=("--fix"); shift ;;
     --timing) EXTRA+=("--timing"); shift ;;
+    --rules|--select) EXTRA+=("--select" "$2"); shift 2 ;;
+    --exclude-rules|--ignore) EXTRA+=("--ignore" "$2"); shift 2 ;;
     *) ARGS+=("$1"); shift ;;
   esac
 done

@@ -2459,3 +2459,465 @@ def test_tpu017_baseline_interplay(tmp_path):
     bl.apply(findings)
     (hit,) = [x for x in findings if x.rule == "TPU017"]
     assert hit.baselined and not hit.gating
+
+
+# --------------------------------- resource-lifecycle rules (TPU022–TPU025)
+
+def test_resource_rules_registered():
+    assert {"TPU022", "TPU023", "TPU024", "TPU025"} <= set(RULES)
+
+
+def test_tpu022_positive_raise_before_release(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import socket
+
+        def dial(addr):
+            s = socket.create_connection(addr)
+            if addr is None:
+                raise ValueError("no addr")
+            s.close()
+    """, select={"TPU022"})
+    (hit,) = [f for f in findings if f.rule == "TPU022"]
+    assert hit.gating and "socket" in hit.message
+
+
+def test_tpu022_positive_failpoint_path(tmp_path):
+    # a keyed chaos failpoint IS a raise-capable site: the matrix can
+    # fire it with the handle live
+    findings = lint_snippet(tmp_path, """
+        import socket
+        from deepspeed_tpu.testing import chaos
+
+        def send(addr):
+            s = socket.create_connection(addr)
+            chaos.failpoint("net.send")
+            s.close()
+    """, select={"TPU022"})
+    (hit,) = [f for f in findings if f.rule == "TPU022"]
+    assert "failpoint" in hit.message
+
+
+def test_tpu022_positive_discarded_handle(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        def slurp(p):
+            return open(p).read()
+    """, select={"TPU022"})
+    (hit,) = [f for f in findings if f.rule == "TPU022"]
+    assert "discarded" in hit.message
+
+
+def test_tpu022_negative_handler_release(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import socket
+
+        def dial(addr, hello):
+            s = socket.create_connection(addr)
+            try:
+                s.sendall(hello)
+            except OSError:
+                s.close()
+                raise
+            return s
+    """, select={"TPU022"})
+    assert "TPU022" not in codes(findings, gating_only=False)
+
+
+def test_tpu022_negative_release_via_callee(tmp_path):
+    # interprocedural discharge: the callee provably closes its param
+    findings = lint_snippet(tmp_path, """
+        import socket
+
+        def shutdown(conn):
+            conn.close()
+
+        def run(addr):
+            s = socket.create_connection(addr)
+            shutdown(s)
+            raise RuntimeError("post-release failures are fine")
+    """, select={"TPU022"})
+    assert "TPU022" not in codes(findings, gating_only=False)
+
+
+def test_tpu022_positive_non_discharging_callee(tmp_path):
+    # the callee only LOOKS at the handle — obligation stays here
+    findings = lint_snippet(tmp_path, """
+        import socket
+
+        def remember(conn):
+            _dead = conn is None
+
+        def run(addr):
+            s = socket.create_connection(addr)
+            remember(s)
+            raise RuntimeError("boom")
+    """, select={"TPU022"})
+    assert [f for f in findings if f.rule == "TPU022"]
+
+
+def test_tpu022_negative_ownership_transfers(tmp_path):
+    # stored on self / returned / handed to an unresolvable supervisor:
+    # all three end this function's obligation
+    findings = lint_snippet(tmp_path, """
+        import socket
+
+        class Client:
+            def connect(self, addr):
+                s = socket.create_connection(addr)
+                self._sock = s
+                self.hello()
+
+        def make(addr):
+            s = socket.create_connection(addr)
+            return s
+
+        def spawn(registry, addr):
+            s = socket.create_connection(addr)
+            registry.register(s)
+            raise RuntimeError("registry owns it now")
+    """, select={"TPU022"})
+    assert "TPU022" not in codes(findings, gating_only=False)
+
+
+def test_tpu022_negative_with_statement(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        def read(p):
+            with open(p) as f:
+                return f.read()
+    """, select={"TPU022"})
+    assert "TPU022" not in codes(findings, gating_only=False)
+
+
+def test_tpu022_negative_constituent_release(tmp_path):
+    # wrapper construction: closing the wrapped socket discharges the
+    # wrapper (the procfleet _serve_conn shape)
+    findings = lint_snippet(tmp_path, """
+        import socket
+
+        class HubConn:
+            def __init__(self, sock):
+                self._sock = sock
+
+        def serve(listener):
+            sock, _ = listener.accept()
+            try:
+                conn = HubConn(sock)
+                handshake(conn)
+            except (OSError, ValueError):
+                sock.close()
+                return
+    """, select={"TPU022"})
+    assert "TPU022" not in codes(findings, gating_only=False)
+
+
+def test_tpu022_positive_staging_dir_unprotected(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import os
+        from deepspeed_tpu.testing import chaos
+
+        def save(ckpt_dir, tag):
+            stage_dir = os.path.join(ckpt_dir, tag + ".tmp")
+            os.makedirs(stage_dir, exist_ok=True)
+            chaos.failpoint("ckpt.save")
+            os.replace(stage_dir, os.path.join(ckpt_dir, tag))
+    """, select={"TPU022"})
+    (hit,) = [f for f in findings if f.rule == "TPU022"]
+    assert "staging" in hit.message
+
+
+def test_tpu022_negative_staging_quarantined(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import os
+        from deepspeed_tpu.testing import chaos
+
+        def quarantine_staging(stage_dir, reason=""):
+            pass
+
+        def save(ckpt_dir, tag):
+            stage_dir = os.path.join(ckpt_dir, tag + ".tmp")
+            os.makedirs(stage_dir, exist_ok=True)
+            try:
+                chaos.failpoint("ckpt.save")
+                os.replace(stage_dir, os.path.join(ckpt_dir, tag))
+            except BaseException:
+                quarantine_staging(stage_dir, reason="torn save")
+                raise
+    """, select={"TPU022"})
+    assert "TPU022" not in codes(findings, gating_only=False)
+
+
+def test_tpu023_positive_started_never_joined(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import threading
+
+        def run(work):
+            t = threading.Thread(target=work)
+            t.start()
+            return 1
+    """, select={"TPU023"})
+    (hit,) = [f for f in findings if f.rule == "TPU023"]
+    assert "join" in hit.message
+
+
+def test_tpu023_negative_joined_daemon_or_registered(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import threading
+
+        def run_joined(work):
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+
+        def run_daemon(work):
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+
+        class Owner:
+            def start(self, work):
+                t = threading.Thread(target=work)
+                t.start()
+                self._t = t
+
+            def stop(self):
+                self._t.join()
+    """, select={"TPU023"})
+    assert "TPU023" not in codes(findings, gating_only=False)
+
+
+def test_tpu023_positive_registered_attr_never_joined(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import threading
+
+        class Owner:
+            def start(self, work):
+                t = threading.Thread(target=work)
+                t.start()
+                self._t = t
+    """, select={"TPU023"})
+    (hit,) = [f for f in findings if f.rule == "TPU023"]
+    assert "_t" in hit.message
+
+
+def test_tpu024_positive_double_close(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        def teardown(sock):
+            sock.close()
+            sock.close()
+    """, select={"TPU024"})
+    (hit,) = [f for f in findings if f.rule == "TPU024"]
+    assert hit.severity == Severity.ERROR
+    assert hit.related and hit.related[0][1] == 3
+
+
+def test_tpu024_negative_rebound_between(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        def redial(sock, addr, connect):
+            sock.close()
+            sock = connect(addr)
+            sock.close()
+    """, select={"TPU024"})
+    assert "TPU024" not in codes(findings, gating_only=False)
+
+
+def test_tpu024_negative_cross_branch(tmp_path):
+    # guarded / cross-branch releases are path-dependent: out of scope
+    findings = lint_snippet(tmp_path, """
+        def teardown(sock, hard):
+            if hard:
+                sock.close()
+            else:
+                sock.close()
+    """, select={"TPU024"})
+    assert "TPU024" not in codes(findings, gating_only=False)
+
+
+def test_tpu025_positive_send_after_close(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        def bye(sock, frame):
+            sock.close()
+            sock.send(frame)
+    """, select={"TPU025"})
+    (hit,) = [f for f in findings if f.rule == "TPU025"]
+    assert "send" in hit.message and hit.related
+
+
+def test_tpu025_negative_reap_vocabulary_and_rebind(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        def reap(sock, connect, addr):
+            sock.close()
+            _fd = sock.fileno()
+            sock = connect(addr)
+            sock.send(b"hello")
+    """, select={"TPU025"})
+    assert "TPU025" not in codes(findings, gating_only=False)
+
+
+def test_tpu022_suppression_and_baseline_interplay(tmp_path):
+    src = textwrap.dedent("""
+        import socket
+
+        def dial(addr):
+            s = socket.create_connection(addr)  # graftlint: disable=TPU022 (caller adopts via gc)
+            if addr is None:
+                raise ValueError
+    """)
+    f = tmp_path / "mod.py"
+    f.write_text(src)
+    findings = lint_paths([str(f)], select={"TPU022"}, root=str(tmp_path))
+    (hit,) = [x for x in findings if x.rule == "TPU022"]
+    assert hit.suppressed and not hit.gating
+    # without the suppression the finding gates, and a baseline entry
+    # un-gates it without hiding it
+    f.write_text(src.replace("  # graftlint: disable=TPU022 "
+                             "(caller adopts via gc)", ""))
+    findings = lint_paths([str(f)], select={"TPU022"}, root=str(tmp_path))
+    (hit,) = [x for x in findings if x.rule == "TPU022"]
+    assert hit.gating
+    bl_path = tmp_path / ".graftlint.json"
+    Baseline.write(str(bl_path), [hit])
+    findings = lint_paths([str(f)], select={"TPU022"}, root=str(tmp_path))
+    bl = Baseline.load(str(bl_path))
+    bl.apply(findings)
+    (hit,) = [x for x in findings if x.rule == "TPU022"]
+    assert hit.baselined and not hit.gating
+
+
+def test_package_sweep_is_clean_with_resource_rules():
+    """Tier-1 gate: the full package lints clean with TPU022–TPU025
+    enabled and NO baseline. This pins the PR's runtime fixes: reverting
+    the fabric handshake cleanup (sockets._dial), the stage worker's
+    staging quarantine, or the replica worker's terminal-stamp/endpoint
+    try/finally re-fails it."""
+    findings = lint_paths(
+        [os.path.join(REPO, "deepspeed_tpu")],
+        select={"TPU022", "TPU023", "TPU024", "TPU025"},
+        root=REPO)
+    gating = [(f.path, f.line, f.rule, f.message)
+              for f in findings if f.gating]
+    assert gating == []
+
+
+# ------------------------------------- scope-aware local-def resolution
+
+def test_scoped_resolution_finds_widening_body_among_twins(tmp_path):
+    # two nested defs named `body`: the scan must bind to ITS scope's
+    # def, not whichever the module walk met last
+    findings = lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def widening(xs):
+            def body(c, x):
+                c = (c + x).astype(jnp.float32)
+                return c, x
+            init = jnp.zeros((8,), jnp.bfloat16)
+            return lax.scan(body, init, xs)
+
+        def unrelated():
+            def body(c, x):
+                return c, x
+            return body
+    """)
+    assert [f for f in findings if f.rule == "TPU009"]
+
+
+def test_scoped_resolution_no_fp_from_foreign_twin(tmp_path):
+    # the clean scan must NOT inherit the widening from a same-named
+    # def in another scope (the old defs[-1] collapse)
+    findings = lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def other():
+            def body(c, x):
+                c = (c + x).astype(jnp.float32)
+                return c, x
+            return body
+
+        def clean(xs):
+            def body(c, x):
+                acc = c.astype(jnp.float32) + x
+                return acc.astype(jnp.bfloat16), x
+            init = jnp.zeros((8,), jnp.bfloat16)
+            return lax.scan(body, init, xs)
+    """)
+    assert "TPU009" not in codes(findings, gating_only=False)
+
+
+def test_scoped_resolution_rebinding_prefers_nearest_prior(tmp_path):
+    # module-level rebinding: the reference binds to the def live at the
+    # reference line, not the file's last one
+    findings = lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def body(c, x):
+            c = (c + x).astype(jnp.float32)
+            return c, x
+
+        def run(xs):
+            init = jnp.zeros((8,), jnp.bfloat16)
+            return lax.scan(body, init, xs)
+
+        def body(c, x):  # noqa: F811 — rebinding fixture
+            return c, x
+    """)
+    # `run` references the FIRST body (live at its line): widening found
+    assert [f for f in findings if f.rule == "TPU009"]
+
+
+# ------------------------------------------------ SARIF relatedLocations
+
+def test_sarif_related_locations_shape(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        def teardown(sock):
+            sock.close()
+            sock.close()
+    """))
+    proc = _run_cli([str(bad), "--format", "sarif", "--no-baseline",
+                     "--select", "TPU024"])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    (res,) = json.loads(proc.stdout)["runs"][0]["results"]
+    (rel,) = res["relatedLocations"]
+    assert rel["physicalLocation"]["artifactLocation"]["uri"].endswith(
+        "bad.py")
+    assert rel["physicalLocation"]["region"]["startLine"] == 3
+    assert "first release" in rel["message"]["text"]
+
+
+def test_finding_to_dict_carries_related(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        def teardown(sock):
+            sock.close()
+            sock.close()
+    """, select={"TPU024"})
+    (hit,) = [f for f in findings if f.rule == "TPU024"]
+    d = hit.to_dict()
+    assert d["related"][0]["line"] == 3 and d["related"][0]["path"]
+
+
+# ---------------------------------------------------- CLI rule selection
+
+def test_cli_rules_and_exclude_rules_aliases(tmp_path):
+    f = tmp_path / "two.py"
+    f.write_text(textwrap.dedent("""
+        import threading
+
+        def run(work, sock):
+            t = threading.Thread(target=work)
+            t.start()
+            sock.close()
+            sock.close()
+    """))
+    proc = _run_cli([str(f), "--no-baseline", "--format", "json",
+                     "--rules", "TPU023,TPU024"])
+    got = {x["rule"] for x in json.loads(proc.stdout)["findings"]}
+    assert got == {"TPU023", "TPU024"}
+    proc = _run_cli([str(f), "--no-baseline", "--format", "json",
+                     "--rules", "TPU023,TPU024",
+                     "--exclude-rules", "TPU024"])
+    got = {x["rule"] for x in json.loads(proc.stdout)["findings"]}
+    assert got == {"TPU023"}
+    # unknown codes are a usage error, not a silent no-op
+    proc = _run_cli([str(f), "--rules", "TPU999"])
+    assert proc.returncode == 2
